@@ -1,0 +1,47 @@
+"""flexflow_tpu: a TPU-native auto-parallelizing deep-learning framework.
+
+A ground-up rebuild of the capabilities of FlexFlow/Unity (reference:
+napplesty/FlexFlow) for TPUs: layer-level model API, parallel computation
+graph with per-dim shard/replica degrees, Unity-style joint search over
+graph substitutions and device placements against a calibrated cost
+model + simulator, and execution via XLA/pjit/GSPMD with Pallas kernels
+and ICI/DCN collectives (no CUDA, no Legion, no NCCL).
+"""
+
+from .config import FFConfig, FFIterationConfig
+from .core.types import (
+    ActiMode,
+    AggrMode,
+    CompMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OpType,
+    ParameterSyncOption,
+    ParameterSyncType,
+    PoolType,
+)
+from .model import FFModel, Tensor
+from .runtime.optimizers import AdamOptimizer, Optimizer, SGDOptimizer
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "FFConfig",
+    "FFIterationConfig",
+    "FFModel",
+    "Tensor",
+    "ActiMode",
+    "AggrMode",
+    "CompMode",
+    "DataType",
+    "LossType",
+    "MetricsType",
+    "OpType",
+    "PoolType",
+    "ParameterSyncType",
+    "ParameterSyncOption",
+    "SGDOptimizer",
+    "AdamOptimizer",
+    "Optimizer",
+]

@@ -1,0 +1,280 @@
+"""Parallel computation graph (PCG).
+
+TPU-native analog of PCG::Graph (reference: include/flexflow/graph.h:293-377,
+src/runtime/graph.cc). Nodes are operator instances (OpType + frozen,
+hashable param record); edges carry (src output index, dst input index).
+The graph is pure data — hashable, serializable, separable — because the
+Unity search memoizes on subgraph hashes (reference: graph.cc:1863
+``dp_state_hash``) and the substitution engine rewrites it structurally.
+
+Unlike the reference there is no Legion region attached: physical layout
+comes later from a ParallelStrategy (parallel/strategy.py) and XLA GSPMD.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .tensor import TensorSpec
+from .types import OpType, PARALLEL_OP_TYPES
+
+
+@dataclasses.dataclass(frozen=True)
+class Node:
+    """One operator instance in the PCG (reference: graph.h Node — Op* + guid)."""
+
+    guid: int
+    op_type: OpType
+    params: Any  # frozen dataclass from ops/<op>.py; hashable
+    name: str = ""
+
+    def param_hash(self) -> int:
+        """Structural hash ignoring guid (for memoization / dedup)."""
+        return hash((self.op_type, self.params))
+
+    def __repr__(self):
+        return f"Node({self.guid}:{self.op_type.value}{':' + self.name if self.name else ''})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    """Tensor flow edge (reference: graph.h Edge — srcOp/dstOp + srcIdx/dstIdx)."""
+
+    src: int  # producer node guid
+    dst: int  # consumer node guid
+    src_idx: int = 0  # producer output index
+    dst_idx: int = 0  # consumer input index
+
+
+class PCGraph:
+    """Mutable parallel computation graph.
+
+    Reference: PCG::Graph (graph.h:293). Supports the operations the Unity
+    search needs: add/remove node+edge, topological order, structural
+    hashing, split at a node (graph.h:346 split_at_node), and DOT export.
+    """
+
+    _guid_counter = itertools.count(1000)  # guids globally unique, like reference GUIDs
+
+    def __init__(self):
+        self.nodes: Dict[int, Node] = {}
+        self._in_edges: Dict[int, List[Edge]] = {}
+        self._out_edges: Dict[int, List[Edge]] = {}
+
+    # ---------------------------------------------------------------- build
+    def new_node(self, op_type: OpType, params: Any, name: str = "") -> Node:
+        node = Node(next(PCGraph._guid_counter), op_type, params, name)
+        self.add_node(node)
+        return node
+
+    def add_node(self, node: Node) -> Node:
+        self.nodes[node.guid] = node
+        self._in_edges.setdefault(node.guid, [])
+        self._out_edges.setdefault(node.guid, [])
+        return node
+
+    def add_edge(self, src: Node | int, dst: Node | int, src_idx: int = 0, dst_idx: int = 0):
+        s = src.guid if isinstance(src, Node) else src
+        d = dst.guid if isinstance(dst, Node) else dst
+        if s not in self.nodes or d not in self.nodes:
+            raise KeyError(f"edge endpoints must be in graph: {s}->{d}")
+        e = Edge(s, d, src_idx, dst_idx)
+        self._out_edges[s].append(e)
+        self._in_edges[d].append(e)
+        return e
+
+    def remove_node(self, guid: int):
+        for e in list(self._in_edges.get(guid, [])):
+            self._out_edges[e.src].remove(e)
+        for e in list(self._out_edges.get(guid, [])):
+            self._in_edges[e.dst].remove(e)
+        self._in_edges.pop(guid, None)
+        self._out_edges.pop(guid, None)
+        self.nodes.pop(guid, None)
+
+    def remove_edge(self, e: Edge):
+        self._out_edges[e.src].remove(e)
+        self._in_edges[e.dst].remove(e)
+
+    def replace_edge_src(self, e: Edge, new_src: Node | int, new_src_idx: int = 0):
+        self.remove_edge(e)
+        self.add_edge(new_src, e.dst, new_src_idx, e.dst_idx)
+
+    # ---------------------------------------------------------------- query
+    def in_edges(self, n: Node | int) -> List[Edge]:
+        g = n.guid if isinstance(n, Node) else n
+        return sorted(self._in_edges.get(g, []), key=lambda e: e.dst_idx)
+
+    def out_edges(self, n: Node | int) -> List[Edge]:
+        g = n.guid if isinstance(n, Node) else n
+        return sorted(self._out_edges.get(g, []), key=lambda e: (e.src_idx, e.dst))
+
+    def predecessors(self, n: Node | int) -> List[Node]:
+        return [self.nodes[e.src] for e in self.in_edges(n)]
+
+    def successors(self, n: Node | int) -> List[Node]:
+        return [self.nodes[e.dst] for e in self.out_edges(n)]
+
+    def source_nodes(self) -> List[Node]:
+        return [self.nodes[g] for g in self.nodes if not self._in_edges[g]]
+
+    def sink_nodes(self) -> List[Node]:
+        return [self.nodes[g] for g in self.nodes if not self._out_edges[g]]
+
+    def __len__(self):
+        return len(self.nodes)
+
+    def __contains__(self, n: Node | int):
+        return (n.guid if isinstance(n, Node) else n) in self.nodes
+
+    def topo_order(self) -> List[Node]:
+        """Deterministic topological order (stable across runs: by guid)."""
+        indeg = {g: len(self._in_edges[g]) for g in self.nodes}
+        ready = sorted([g for g, d in indeg.items() if d == 0])
+        order: List[Node] = []
+        while ready:
+            g = ready.pop(0)
+            order.append(self.nodes[g])
+            nxt = []
+            for e in self._out_edges[g]:
+                indeg[e.dst] -= 1
+                if indeg[e.dst] == 0:
+                    nxt.append(e.dst)
+            ready = sorted(set(ready) | set(nxt))
+        if len(order) != len(self.nodes):
+            raise ValueError("graph has a cycle")
+        return order
+
+    # --------------------------------------------------------------- hashing
+    def structural_hash(self) -> int:
+        """Guid-independent hash for DP memoization (reference: graph.cc:1863)."""
+        order = self.topo_order()
+        canon = {n.guid: i for i, n in enumerate(order)}
+        node_sig = tuple((canon[n.guid], n.op_type, n.params) for n in order)
+        edge_sig = tuple(
+            sorted(
+                (canon[e.src], canon[e.dst], e.src_idx, e.dst_idx)
+                for g in self.nodes
+                for e in self._out_edges[g]
+            )
+        )
+        return hash((node_sig, edge_sig))
+
+    # ----------------------------------------------------------------- algos
+    def copy(self) -> "PCGraph":
+        g = PCGraph()
+        g.nodes = dict(self.nodes)
+        g._in_edges = {k: list(v) for k, v in self._in_edges.items()}
+        g._out_edges = {k: list(v) for k, v in self._out_edges.items()}
+        return g
+
+    def subgraph(self, guids: Iterable[int]) -> "PCGraph":
+        keep = set(guids)
+        g = PCGraph()
+        for guid in keep:
+            g.add_node(self.nodes[guid])
+        for guid in keep:
+            for e in self._out_edges[guid]:
+                if e.dst in keep:
+                    g._out_edges[e.src].append(e)
+                    g._in_edges[e.dst].append(e)
+        return g
+
+    def split_at_node(self, bottleneck: Node) -> Tuple["PCGraph", "PCGraph"]:
+        """Split into (ancestors+node, node+descendants) at a bottleneck.
+
+        Reference: Graph::split_at_node (graph.h:346, graph.cc). The
+        bottleneck node appears in both halves (as sink of the first,
+        source of the second), mirroring the reference's convention.
+        """
+        anc = self.ancestors(bottleneck) | {bottleneck.guid}
+        first = self.subgraph(anc)
+        rest = (set(self.nodes) - anc) | {bottleneck.guid}
+        second = self.subgraph(rest)
+        return first, second
+
+    def ancestors(self, n: Node | int) -> set:
+        g = n.guid if isinstance(n, Node) else n
+        seen: set = set()
+        stack = [e.src for e in self._in_edges[g]]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(e.src for e in self._in_edges[cur])
+        return seen
+
+    def descendants(self, n: Node | int) -> set:
+        g = n.guid if isinstance(n, Node) else n
+        seen: set = set()
+        stack = [e.dst for e in self._out_edges[g]]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(e.dst for e in self._out_edges[cur])
+        return seen
+
+    def bottleneck_nodes(self) -> List[Node]:
+        """Nodes whose removal separates the graph into before/after.
+
+        Used by the DP search's sequential split
+        (reference: SearchHelper::find_optimal_sequence_graph_time graph.cc:115).
+        A node is a bottleneck if every other node is either its ancestor
+        or its descendant.
+        """
+        total = set(self.nodes)
+        out = []
+        for n in self.topo_order():
+            anc = self.ancestors(n)
+            desc = self.descendants(n)
+            if len(anc) + len(desc) + 1 == len(total) and not (anc & desc):
+                out.append(n)
+        return out
+
+    # ----------------------------------------------------------------- serde
+    def to_json(self) -> str:
+        order = self.topo_order()
+        nodes = []
+        for n in order:
+            p = dataclasses.asdict(n.params) if dataclasses.is_dataclass(n.params) else n.params
+            nodes.append(
+                {"guid": n.guid, "op_type": n.op_type.value, "name": n.name, "params": _jsonable(p)}
+            )
+        edges = [
+            dataclasses.asdict(e)
+            for g in sorted(self.nodes)
+            for e in self._out_edges[g]
+        ]
+        return json.dumps({"nodes": nodes, "edges": edges}, indent=1)
+
+    def to_dot(self, label_fn: Optional[Callable[[Node], str]] = None) -> str:
+        """DOT export (reference: --compgraph export, graph.h:339)."""
+        lines = ["digraph PCG {"]
+        for n in self.topo_order():
+            label = label_fn(n) if label_fn else f"{n.op_type.value}\\n{n.name or n.guid}"
+            shape = "ellipse" if n.op_type in PARALLEL_OP_TYPES else "box"
+            lines.append(f'  n{n.guid} [label="{label}", shape={shape}];')
+        for g in sorted(self.nodes):
+            for e in self._out_edges[g]:
+                lines.append(f"  n{e.src} -> n{e.dst};")
+        lines.append("}")
+        return "\n".join(lines)
+
+
+def _jsonable(x):
+    if isinstance(x, dict):
+        return {k: _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    if isinstance(x, (OpType,)):
+        return x.value
+    if hasattr(x, "value") and isinstance(x, object) and x.__class__.__module__.endswith("types"):
+        return getattr(x, "value", str(x))
+    if isinstance(x, (int, float, str, bool)) or x is None:
+        return x
+    return str(x)

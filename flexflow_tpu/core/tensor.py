@@ -1,0 +1,141 @@
+"""Logical and parallel tensor specifications.
+
+TPU-native analog of the reference's Tensor / ParallelTensor split
+(reference: include/flexflow/tensor.h, include/flexflow/parallel_tensor.h:36-200).
+
+The reference's ParallelTensor carries, per dimension, a shard ``degree``,
+a ``parallel_idx`` into the machine view, and an ``is_replica_dim`` marker,
+and owns Legion region handles. Here the same per-dim sharding metadata is
+pure data; physical placement is expressed as a mapping from parallel dims
+to ``jax.sharding.Mesh`` axes, and XLA (GSPMD) materializes the layout.
+Tensors are row-major with dim 0 outermost (NumPy order), unlike the
+reference's Legion-style reversed dims.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+from .types import DataType, ParameterSyncOption, ParameterSyncType
+
+MAX_TENSOR_DIM = 6  # parity with reference MAX_TENSOR_DIM
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelDim:
+    """One dimension of a parallel tensor (reference: parallel_tensor.h:36-71).
+
+    size        -- logical (global) extent of this dim
+    degree      -- number of shards this dim is split into
+    mesh_axis   -- mesh axis name carrying the shards (None = unsharded);
+                   replaces the reference's ``parallel_idx`` index into a
+                   MachineView
+    is_replica  -- replica dim: does not exist in the logical tensor, it
+                   encodes pure replication (parallel_tensor.h:70)
+    """
+
+    size: int
+    degree: int = 1
+    mesh_axis: Optional[str] = None
+    is_replica: bool = False
+
+    def __post_init__(self):
+        if self.size <= 0:
+            raise ValueError(f"dim size must be positive, got {self.size}")
+        if self.degree <= 0:
+            raise ValueError(f"degree must be positive, got {self.degree}")
+        if self.size % self.degree != 0:
+            raise ValueError(
+                f"size {self.size} not divisible by degree {self.degree}"
+            )
+        if self.is_replica and self.size != self.degree:
+            raise ValueError("replica dim must have size == degree")
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+    """Logical (unsharded) tensor: shape + dtype (reference: tensor.h TensorBase)."""
+
+    shape: Tuple[int, ...]
+    dtype: DataType = DataType.FLOAT
+
+    def __post_init__(self):
+        object.__setattr__(self, "shape", tuple(int(s) for s in self.shape))
+        if len(self.shape) > MAX_TENSOR_DIM:
+            raise ValueError(f"too many dims: {self.shape}")
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def num_elements(self) -> int:
+        return math.prod(self.shape) if self.shape else 1
+
+    @property
+    def size_bytes(self) -> int:
+        return self.num_elements * self.dtype.size_bytes
+
+    def with_shape(self, shape: Sequence[int]) -> "TensorSpec":
+        return TensorSpec(tuple(shape), self.dtype)
+
+    def with_dtype(self, dtype: DataType) -> "TensorSpec":
+        return TensorSpec(self.shape, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelTensorSpec:
+    """Sharded tensor spec: per-dim shard/replica info + gradient sync.
+
+    Reference: ParallelTensorBase (parallel_tensor.h:134-200) including the
+    fork's per-parameter ``sync_option`` / ``should_add_barrier``
+    (parallel_tensor.h:184-185).
+    """
+
+    dims: Tuple[ParallelDim, ...]
+    dtype: DataType = DataType.FLOAT
+    sync_type: ParameterSyncType = ParameterSyncType.NONE
+    sync_option: ParameterSyncOption = ParameterSyncOption.DEFAULT
+
+    @classmethod
+    def from_spec(cls, spec: TensorSpec) -> "ParallelTensorSpec":
+        return cls(tuple(ParallelDim(s) for s in spec.shape), spec.dtype)
+
+    @property
+    def logical_shape(self) -> Tuple[int, ...]:
+        return tuple(d.size for d in self.dims if not d.is_replica)
+
+    @property
+    def local_shape(self) -> Tuple[int, ...]:
+        """Per-shard shape (what one device holds)."""
+        return tuple(d.size // d.degree for d in self.dims if not d.is_replica)
+
+    @property
+    def total_degree(self) -> int:
+        return math.prod(d.degree for d in self.dims)
+
+    @property
+    def replica_degree(self) -> int:
+        return math.prod(d.degree for d in self.dims if d.is_replica)
+
+    @property
+    def logical_spec(self) -> TensorSpec:
+        return TensorSpec(self.logical_shape, self.dtype)
+
+    @property
+    def num_elements(self) -> int:
+        return math.prod(self.logical_shape) if self.logical_shape else 1
+
+    @property
+    def size_bytes(self) -> int:
+        return self.num_elements * self.dtype.size_bytes
+
+    def get_sharding_tuple(self) -> Tuple[Tuple[Optional[str], ...], ...]:
+        """Per-logical-dim mesh axes, in PartitionSpec form."""
+        out = []
+        for d in self.dims:
+            if d.is_replica:
+                continue
+            out.append((d.mesh_axis,) if d.mesh_axis else ())
+        return tuple(out)

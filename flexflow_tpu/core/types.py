@@ -1,0 +1,202 @@
+"""Core enums and type definitions.
+
+TPU-native analog of the reference's constant universe
+(reference: include/flexflow/ffconst.h) — op types, activation modes,
+loss/metrics types, parameter-sync and allreduce-schedule options.
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+
+class DataType(enum.Enum):
+    """Tensor element types (reference: ffconst.h DataType)."""
+
+    BOOL = "bool"
+    INT32 = "int32"
+    INT64 = "int64"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    FLOAT = "float32"
+    DOUBLE = "float64"
+
+    @property
+    def jnp(self):
+        return jnp.dtype(self.value)
+
+    @property
+    def size_bytes(self) -> int:
+        return jnp.dtype(self.value).itemsize
+
+    @classmethod
+    def from_jnp(cls, dtype) -> "DataType":
+        return cls(jnp.dtype(dtype).name)
+
+
+class ActiMode(enum.Enum):
+    """Fused activation modes (reference: ffconst.h ActiMode)."""
+
+    NONE = "none"
+    RELU = "relu"
+    SIGMOID = "sigmoid"
+    TANH = "tanh"
+    GELU = "gelu"
+
+
+class AggrMode(enum.Enum):
+    """Embedding aggregation (reference: ffconst.h AggrMode)."""
+
+    NONE = "none"
+    SUM = "sum"
+    AVG = "avg"
+
+
+class PoolType(enum.Enum):
+    """Pooling modes (reference: ffconst.h PoolType)."""
+
+    MAX = "max"
+    AVG = "avg"
+
+
+class LossType(enum.Enum):
+    """Loss functions (reference: include/flexflow/loss_functions.h:27)."""
+
+    CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+    SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+    MEAN_SQUARED_ERROR = "mean_squared_error"
+    MEAN_SQUARED_ERROR_AVG_REDUCE = "mean_squared_error_avg_reduce"
+    MEAN_SQUARED_ERROR_SUM_REDUCE = "mean_squared_error_sum_reduce"
+    IDENTITY = "identity"
+
+
+class MetricsType(enum.Enum):
+    """Metrics (reference: include/flexflow/metrics_functions.h:27)."""
+
+    ACCURACY = "accuracy"
+    CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+    SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+    MEAN_SQUARED_ERROR = "mean_squared_error"
+    ROOT_MEAN_SQUARED_ERROR = "root_mean_squared_error"
+    MEAN_ABSOLUTE_ERROR = "mean_absolute_error"
+
+
+class CompMode(enum.Enum):
+    """Compilation mode (reference: ffconst.h:41-44)."""
+
+    TRAINING = "training"
+    INFERENCE = "inference"
+
+
+class ParameterSyncType(enum.Enum):
+    """Gradient sync strategy (reference: ffconst.h:46-50).
+
+    On TPU both lower to XLA collectives over ICI; PS is kept for API
+    parity and maps to a single-host reduce + broadcast pattern.
+    """
+
+    NONE = "none"
+    PS = "ps"
+    NCCL = "allreduce"  # TPU: psum over mesh data axes
+
+
+class ParameterSyncOption(enum.Enum):
+    """Per-parameter allreduce schedule (fork feature, ffconst.h:52-57).
+
+    On the ICI torus the XLA runtime picks the physical algorithm; these
+    options steer the simulator/cost model and the allreduce-schedule
+    optimizer pass (search/allreduce.py).
+    """
+
+    DEFAULT = "default"
+    RING = "ring"
+    BUTTERFLY = "butterfly"
+    DOUBLE_BINARY_TREE = "double_binary_tree"
+
+
+class OpType(enum.Enum):
+    """Every operator the framework supports (reference: ffconst.h OperatorType)."""
+
+    INPUT = "input"
+    WEIGHT = "weight"
+    NOOP = "noop"
+    # dense / matmul family
+    LINEAR = "linear"
+    BATCH_MATMUL = "batch_matmul"
+    # conv family
+    CONV2D = "conv2d"
+    POOL2D = "pool2d"
+    FLAT = "flat"
+    # attention
+    MULTIHEAD_ATTENTION = "multihead_attention"
+    # embedding
+    EMBEDDING = "embedding"
+    # normalization
+    LAYERNORM = "layer_norm"
+    BATCHNORM = "batch_norm"
+    # elementwise binary
+    EW_ADD = "add"
+    EW_SUB = "subtract"
+    EW_MUL = "multiply"
+    EW_DIV = "divide"
+    EW_MAX = "max"
+    EW_MIN = "min"
+    # elementwise unary
+    RELU = "relu"
+    SIGMOID = "sigmoid"
+    TANH = "tanh"
+    ELU = "elu"
+    GELU = "gelu"
+    IDENTITY = "identity"
+    EXP = "exp"
+    SIN = "sin"
+    COS = "cos"
+    RSQRT = "rsqrt"
+    POW = "pow"
+    SCALAR_ADD = "scalar_add"
+    SCALAR_SUB = "scalar_sub"
+    SCALAR_MUL = "scalar_multiply"
+    SCALAR_TRUE_DIV = "scalar_true_div"
+    # shape ops
+    RESHAPE = "reshape"
+    TRANSPOSE = "transpose"
+    REVERSE = "reverse"
+    CONCAT = "concat"
+    SPLIT = "split"
+    # misc
+    SOFTMAX = "softmax"
+    DROPOUT = "dropout"
+    CAST = "cast"
+    GATHER = "gather"
+    REDUCE_SUM = "reduce_sum"
+    MEAN = "mean"
+    # MoE family
+    TOPK = "topk"
+    GROUP_BY = "group_by"
+    AGGREGATE = "aggregate"
+    AGGREGATE_SPEC = "aggregate_spec"
+    CACHE = "cache"
+    # fused
+    FUSED = "fused"
+    # parallel ops (sharding transitions; reference: src/parallel_ops/)
+    REPARTITION = "repartition"
+    COMBINE = "combine"
+    REPLICATE = "replicate"
+    REDUCTION = "reduction"
+    ALLREDUCE = "allreduce"
+    FUSED_PARALLEL = "fused_parallel"
+    PIPELINE = "pipeline"
+
+
+PARALLEL_OP_TYPES = frozenset(
+    {
+        OpType.REPARTITION,
+        OpType.COMBINE,
+        OpType.REPLICATE,
+        OpType.REDUCTION,
+        OpType.ALLREDUCE,
+        OpType.FUSED_PARALLEL,
+        OpType.PIPELINE,
+    }
+)

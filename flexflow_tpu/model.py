@@ -1,0 +1,552 @@
+"""FFModel: the central model-building + training API.
+
+Reference: FFModel (include/flexflow/model.h:328-554 — ~60 layer
+builders; src/runtime/model.cc:5195 LoC). API names and argument orders
+mirror the reference so FlexFlow programs port mechanically; semantics
+are TPU-native: building a layer records a PCG node (the reference's
+lazy Layer graph, src/runtime/layer.cc), and ``compile`` lowers the PCG
+through the Unity search to a single jitted, mesh-sharded train step
+instead of Legion task launches.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import FFConfig, FFIterationConfig
+from .core.graph import Node, PCGraph
+from .core.tensor import TensorSpec
+from .core.types import (
+    ActiMode,
+    AggrMode,
+    CompMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OpType,
+    PoolType,
+)
+from .ops import io_ops, linear as linear_mod, conv as conv_mod
+from .ops.attention import MultiHeadAttentionParams
+from .ops.batch_matmul import BatchMatmulParams
+from .ops.elementwise import ElementBinaryParams, ElementUnaryParams
+from .ops.embedding import EmbeddingParams
+from .ops.moe_ops import (
+    AggregateParams,
+    AggregateSpecParams,
+    CacheParams,
+    GroupByParams,
+    TopKParams,
+)
+from .ops.norm import BatchNormParams, LayerNormParams
+from .ops.reduction_ops import GatherParams, MeanParams, ReduceSumParams
+from .ops.shape_ops import (
+    CastParams,
+    ConcatParams,
+    FlatParams,
+    ReshapeParams,
+    ReverseParams,
+    SplitParams,
+    TransposeParams,
+)
+from .ops.softmax import DropoutParams, SoftmaxParams
+from .parallel.propagation import infer_all_specs
+from .runtime.executor import CompiledExecutor
+from .runtime.metrics import PerfMetrics
+from .runtime.optimizers import Optimizer, SGDOptimizer
+
+
+class Tensor:
+    """Frontend tensor handle: (graph node, output index) + logical spec.
+
+    Reference: the Tensor/TensorBase frontend objects (tensor.h) created
+    eagerly by layer calls and resolved at compile.
+    """
+
+    def __init__(self, model: "FFModel", node: Node, idx: int, spec: TensorSpec):
+        self._model = model
+        self.node = node
+        self.idx = idx
+        self.spec = spec
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.spec.shape
+
+    @property
+    def dtype(self) -> DataType:
+        return self.spec.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self.spec.ndim
+
+    def __repr__(self):
+        return f"Tensor(shape={self.shape}, dtype={self.dtype.value}, node={self.node.guid})"
+
+    # numpy-ish sugar
+    def __add__(self, other):
+        return self._model.add(self, other)
+
+    def __sub__(self, other):
+        return self._model.subtract(self, other)
+
+    def __mul__(self, other):
+        return self._model.multiply(self, other)
+
+
+class FFModel:
+    """Model builder + trainer (reference: model.h:328)."""
+
+    def __init__(self, config: Optional[FFConfig] = None, seed: int = 0):
+        self.config = config or FFConfig()
+        self.graph = PCGraph()
+        self._num_inputs = 0
+        self._seed = seed
+        self.iter_config = FFIterationConfig()
+        self.executor: Optional[CompiledExecutor] = None
+        self.strategy = None
+        self.mesh = None
+        self.label_spec: Optional[TensorSpec] = None
+        self._outputs: List[Tensor] = []
+        self._search_result = None
+
+    # ------------------------------------------------------------ helpers
+    def _add(self, op_type: OpType, params, inputs: Sequence[Tensor], name: str = "") -> List[Tensor]:
+        node = self.graph.new_node(op_type, params, name)
+        for i, t in enumerate(inputs):
+            self.graph.add_edge(t.node, node, t.idx, i)
+        from .ops.base import get_op_def
+
+        out_specs = get_op_def(op_type).infer_output_specs(params, [t.spec for t in inputs])
+        return [Tensor(self, node, i, s) for i, s in enumerate(out_specs)]
+
+    def _one(self, *args, **kw) -> Tensor:
+        return self._add(*args, **kw)[0]
+
+    # ----------------------------------------------------- tensor creation
+    def create_tensor(self, shape: Sequence[int], dtype: DataType = DataType.FLOAT, name: str = "") -> Tensor:
+        """An input placeholder (reference: FFModel::create_tensor)."""
+        params = io_ops.InputParams(tuple(int(s) for s in shape), dtype, self._num_inputs)
+        self._num_inputs += 1
+        return self._one(OpType.INPUT, params, [], name=name or f"input{params.input_index}")
+
+    def create_weight(self, shape: Sequence[int], dtype: DataType = DataType.FLOAT, initializer: str = "glorot_uniform", name: str = "") -> Tensor:
+        params = io_ops.WeightParams(tuple(int(s) for s in shape), dtype, initializer)
+        return self._one(OpType.WEIGHT, params, [], name=name)
+
+    # ------------------------------------------------------------- layers
+    def dense(
+        self,
+        input: Tensor,
+        out_dim: int,
+        activation: ActiMode = ActiMode.NONE,
+        use_bias: bool = True,
+        datatype: DataType = DataType.FLOAT,
+        kernel_initializer: str = "glorot_uniform",
+        bias_initializer: str = "zeros",
+        name: str = "",
+    ) -> Tensor:
+        p = linear_mod.LinearParams(out_dim, use_bias, activation, datatype, kernel_initializer, bias_initializer)
+        return self._one(OpType.LINEAR, p, [input], name=name)
+
+    def conv2d(
+        self,
+        input: Tensor,
+        out_channels: int,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int,
+        padding_w: int,
+        activation: ActiMode = ActiMode.NONE,
+        groups: int = 1,
+        use_bias: bool = True,
+        name: str = "",
+    ) -> Tensor:
+        p = conv_mod.Conv2DParams(
+            out_channels,
+            (kernel_h, kernel_w),
+            (stride_h, stride_w),
+            (padding_h, padding_w),
+            groups,
+            use_bias,
+            activation,
+            input.dtype,
+        )
+        return self._one(OpType.CONV2D, p, [input], name=name)
+
+    def pool2d(
+        self,
+        input: Tensor,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int,
+        padding_w: int,
+        pool_type: PoolType = PoolType.MAX,
+        activation: ActiMode = ActiMode.NONE,
+        name: str = "",
+    ) -> Tensor:
+        p = conv_mod.Pool2DParams((kernel_h, kernel_w), (stride_h, stride_w), (padding_h, padding_w), pool_type, activation)
+        return self._one(OpType.POOL2D, p, [input], name=name)
+
+    def embedding(
+        self,
+        input: Tensor,
+        num_entries: int,
+        out_dim: int,
+        aggr: AggrMode = AggrMode.NONE,
+        datatype: DataType = DataType.FLOAT,
+        kernel_initializer: str = "glorot_uniform",
+        name: str = "",
+    ) -> Tensor:
+        p = EmbeddingParams(num_entries, out_dim, aggr, datatype, kernel_initializer)
+        return self._one(OpType.EMBEDDING, p, [input], name=name)
+
+    def multihead_attention(
+        self,
+        query: Tensor,
+        key: Tensor,
+        value: Tensor,
+        embed_dim: int,
+        num_heads: int,
+        kdim: int = 0,
+        vdim: int = 0,
+        dropout: float = 0.0,
+        bias: bool = False,
+        add_bias_kv: bool = False,
+        add_zero_attn: bool = False,
+        causal: bool = False,
+        name: str = "",
+    ) -> Tensor:
+        if add_bias_kv or add_zero_attn:
+            raise NotImplementedError("add_bias_kv / add_zero_attn are not supported")
+        p = MultiHeadAttentionParams(embed_dim, num_heads, kdim, vdim, dropout, bias, causal, query.dtype)
+        return self._one(OpType.MULTIHEAD_ATTENTION, p, [query, key, value], name=name)
+
+    def layer_norm(
+        self,
+        input: Tensor,
+        axes: Optional[Sequence[int]] = None,
+        elementwise_affine: bool = True,
+        eps: float = 1e-5,
+        name: str = "",
+    ) -> Tensor:
+        if axes is None:
+            axes = [input.ndim - 1]
+        p = LayerNormParams(tuple(axes), elementwise_affine, eps, input.dtype)
+        return self._one(OpType.LAYERNORM, p, [input], name=name)
+
+    def batch_norm(self, input: Tensor, relu: bool = True, name: str = "") -> Tensor:
+        p = BatchNormParams(relu=relu, dtype=input.dtype)
+        return self._one(OpType.BATCHNORM, p, [input], name=name)
+
+    def batch_matmul(
+        self,
+        A: Tensor,
+        B: Tensor,
+        a_seq_length_dim: int = -1,
+        b_seq_length_dim: int = -1,
+        name: str = "",
+    ) -> Tensor:
+        p = BatchMatmulParams(a_seq_length_dim, b_seq_length_dim)
+        return self._one(OpType.BATCH_MATMUL, p, [A, B], name=name)
+
+    # --------------------------------------------------------- elementwise
+    def _binary(self, op: OpType, x: Tensor, y: Tensor, inplace_a: bool = False, name: str = "") -> Tensor:
+        return self._one(op, ElementBinaryParams(op, inplace_a), [x, y], name=name)
+
+    def add(self, x, y, inplace_a=False, name=""):
+        return self._binary(OpType.EW_ADD, x, y, inplace_a, name)
+
+    def subtract(self, x, y, inplace_a=False, name=""):
+        return self._binary(OpType.EW_SUB, x, y, inplace_a, name)
+
+    def multiply(self, x, y, inplace_a=False, name=""):
+        return self._binary(OpType.EW_MUL, x, y, inplace_a, name)
+
+    def divide(self, x, y, inplace_a=False, name=""):
+        return self._binary(OpType.EW_DIV, x, y, inplace_a, name)
+
+    def max(self, x, y, inplace_a=False, name=""):
+        return self._binary(OpType.EW_MAX, x, y, inplace_a, name)
+
+    def min(self, x, y, inplace_a=False, name=""):
+        return self._binary(OpType.EW_MIN, x, y, inplace_a, name)
+
+    def _unary(self, op: OpType, x: Tensor, scalar: float = 0.0, inplace: bool = False, name: str = "") -> Tensor:
+        return self._one(op, ElementUnaryParams(op, scalar, inplace), [x], name=name)
+
+    def relu(self, x, inplace=True, name=""):
+        return self._unary(OpType.RELU, x, inplace=inplace, name=name)
+
+    def sigmoid(self, x, name=""):
+        return self._unary(OpType.SIGMOID, x, name=name)
+
+    def tanh(self, x, name=""):
+        return self._unary(OpType.TANH, x, name=name)
+
+    def elu(self, x, inplace=True, name=""):
+        return self._unary(OpType.ELU, x, inplace=inplace, name=name)
+
+    def gelu(self, x, name=""):
+        return self._unary(OpType.GELU, x, name=name)
+
+    def identity(self, x, name=""):
+        return self._unary(OpType.IDENTITY, x, name=name)
+
+    def exp(self, x, name=""):
+        return self._unary(OpType.EXP, x, name=name)
+
+    def sin(self, x, name=""):
+        return self._unary(OpType.SIN, x, name=name)
+
+    def cos(self, x, name=""):
+        return self._unary(OpType.COS, x, name=name)
+
+    def rsqrt(self, x, name=""):
+        return self._unary(OpType.RSQRT, x, name=name)
+
+    def pow(self, x, exponent: float, name=""):
+        return self._unary(OpType.POW, x, scalar=exponent, name=name)
+
+    def scalar_add(self, x, scalar: float, inplace=True, name=""):
+        return self._unary(OpType.SCALAR_ADD, x, scalar=scalar, inplace=inplace, name=name)
+
+    def scalar_sub(self, x, scalar: float, inplace=True, name=""):
+        return self._unary(OpType.SCALAR_SUB, x, scalar=scalar, inplace=inplace, name=name)
+
+    def scalar_multiply(self, x, scalar: float, inplace=True, name=""):
+        return self._unary(OpType.SCALAR_MUL, x, scalar=scalar, inplace=inplace, name=name)
+
+    def scalar_true_divide(self, x, scalar: float, inplace=True, name=""):
+        return self._unary(OpType.SCALAR_TRUE_DIV, x, scalar=scalar, inplace=inplace, name=name)
+
+    # ----------------------------------------------------------- shape ops
+    def reshape(self, input: Tensor, shape: Sequence[int], name: str = "") -> Tensor:
+        return self._one(OpType.RESHAPE, ReshapeParams(tuple(shape)), [input], name=name)
+
+    def transpose(self, input: Tensor, perm: Sequence[int], name: str = "") -> Tensor:
+        return self._one(OpType.TRANSPOSE, TransposeParams(tuple(perm)), [input], name=name)
+
+    def reverse(self, input: Tensor, axis: int, name: str = "") -> Tensor:
+        return self._one(OpType.REVERSE, ReverseParams(axis), [input], name=name)
+
+    def flat(self, input: Tensor, name: str = "") -> Tensor:
+        return self._one(OpType.FLAT, FlatParams(), [input], name=name)
+
+    def concat(self, tensors: Sequence[Tensor], axis: int, name: str = "") -> Tensor:
+        return self._one(OpType.CONCAT, ConcatParams(axis, len(tensors)), list(tensors), name=name)
+
+    def split(self, input: Tensor, sizes: Union[int, Sequence[int]], axis: int, name: str = "") -> List[Tensor]:
+        if isinstance(sizes, int):
+            total = input.shape[axis]
+            if total % sizes != 0:
+                raise ValueError(f"split: dim {axis} of size {total} not divisible into {sizes} chunks")
+            sizes = [total // sizes] * sizes
+        if sum(sizes) != input.shape[axis]:
+            raise ValueError(f"split sizes {sizes} do not sum to dim size {input.shape[axis]}")
+        return self._add(OpType.SPLIT, SplitParams(tuple(sizes), axis), [input], name=name)
+
+    def cast(self, input: Tensor, dtype: DataType, name: str = "") -> Tensor:
+        return self._one(OpType.CAST, CastParams(dtype), [input], name=name)
+
+    # ---------------------------------------------------------------- misc
+    def softmax(self, input: Tensor, axis: int = -1, name: str = "") -> Tensor:
+        return self._one(OpType.SOFTMAX, SoftmaxParams(axis), [input], name=name)
+
+    def dropout(self, input: Tensor, rate: float, seed: int = 0, name: str = "") -> Tensor:
+        return self._one(OpType.DROPOUT, DropoutParams(rate, seed), [input], name=name)
+
+    def gather(self, input: Tensor, index: Tensor, axis: int, name: str = "") -> Tensor:
+        return self._one(OpType.GATHER, GatherParams(axis), [input, index], name=name)
+
+    def reduce_sum(self, input: Tensor, axes: Sequence[int], keepdims: bool = False, name: str = "") -> Tensor:
+        return self._one(OpType.REDUCE_SUM, ReduceSumParams(tuple(axes), keepdims), [input], name=name)
+
+    def mean(self, input: Tensor, dims: Sequence[int], keepdims: bool = False, name: str = "") -> Tensor:
+        return self._one(OpType.MEAN, MeanParams(tuple(dims), keepdims), [input], name=name)
+
+    # ----------------------------------------------------------- MoE layers
+    def top_k(self, input: Tensor, k: int, sorted: bool = True, name: str = "") -> Tuple[Tensor, Tensor]:
+        outs = self._add(OpType.TOPK, TopKParams(k, sorted), [input], name=name)
+        return outs[0], outs[1]
+
+    def group_by(self, input: Tensor, assign: Tensor, n: int, alpha: float, name: str = "") -> List[Tensor]:
+        return self._add(OpType.GROUP_BY, GroupByParams(n, alpha), [input, assign], name=name)
+
+    def aggregate(
+        self, gate_preds: Tensor, gate_assign: Tensor, exp_preds: Sequence[Tensor], n: int, lambda_bal: float, name: str = ""
+    ) -> Tensor:
+        p = AggregateParams(n, lambda_bal)
+        return self._one(OpType.AGGREGATE, p, [gate_preds, gate_assign] + list(exp_preds), name=name)
+
+    def aggregate_spec(
+        self, gate_preds: Tensor, gate_assign: Tensor, exp_preds: Sequence[Tensor], n: int, lambda_bal: float, name: str = ""
+    ) -> Tensor:
+        p = AggregateSpecParams(n, lambda_bal)
+        return self._one(OpType.AGGREGATE_SPEC, p, [gate_preds, gate_assign] + list(exp_preds), name=name)
+
+    def cache(self, input: Tensor, num_batches: int = 1, trigger_threshold: float = 0.0, name: str = "") -> Tensor:
+        return self._one(OpType.CACHE, CacheParams(num_batches, trigger_threshold), [input], name=name)
+
+    def moe(
+        self,
+        input: Tensor,
+        num_exp: int,
+        num_select: int,
+        expert_hidden_size: int,
+        alpha: float = 2.0,
+        lambda_bal: float = 0.04,
+        name: str = "",
+    ) -> Tensor:
+        """Composite MoE layer (reference: FFModel::moe, src/ops/moe.cc:20):
+        dense gate -> topk -> group_by -> per-expert dense -> aggregate."""
+        gate = self.dense(input, num_exp, ActiMode.NONE, name=f"{name}_gate")
+        gate = self.softmax(gate, name=f"{name}_gate_sm")
+        topk_vals, topk_idx = self.top_k(gate, num_select, name=f"{name}_topk")
+        grouped = self.group_by(input, topk_idx, num_exp, alpha, name=f"{name}_groupby")
+        expert_outs = []
+        for e, g in enumerate(grouped):
+            h = self.dense(g, expert_hidden_size, ActiMode.RELU, name=f"{name}_exp{e}")
+            h = self.dense(h, input.shape[-1], ActiMode.NONE, name=f"{name}_exp{e}_out")
+            expert_outs.append(h)
+        return self.aggregate(topk_vals, topk_idx, expert_outs, num_exp, lambda_bal, name=f"{name}_agg")
+
+    def residual(self, x: Tensor, fx: Tensor, name: str = "") -> Tensor:
+        return self.add(x, fx, name=name)
+
+    # -------------------------------------------------------------- compile
+    def compile(
+        self,
+        optimizer: Optional[Optimizer] = None,
+        loss_type: Optional[LossType] = None,
+        metrics: Sequence[MetricsType] = (),
+        comp_mode: CompMode = CompMode.TRAINING,
+        outputs: Optional[Sequence[Tensor]] = None,
+    ):
+        """Search for a parallelization strategy and build the compiled
+        executable (reference: FFModel::compile, model.cc:2811 — search
+        task, convert_graph_to_operators, NCCL init all collapse into
+        strategy selection + one jit)."""
+        if optimizer is None:
+            optimizer = SGDOptimizer(lr=self.config.learning_rate, weight_decay=self.config.weight_decay)
+        self._outputs = list(outputs) if outputs else [self._default_output()]
+        num_devices = self.config.num_devices
+        from .parallel.mesh import build_mesh
+        from .parallel.strategy import data_parallel_strategy
+
+        if self.config.import_strategy_file:
+            from .parallel.strategy import ParallelStrategy
+
+            with open(self.config.import_strategy_file) as f:
+                self.strategy = ParallelStrategy.from_json(f.read())
+        elif self.config.only_data_parallel or self.config.search_budget <= 0:
+            self.strategy = data_parallel_strategy(self.graph, num_devices)
+        else:
+            try:
+                from .search.unity import unity_optimize
+            except ImportError as e:
+                raise NotImplementedError(
+                    "Unity search requested (search_budget > 0) but the search "
+                    "module is not available; pass only_data_parallel=True"
+                ) from e
+            self.strategy, self._search_result = unity_optimize(self.graph, self.config)
+        if self.config.export_strategy_file:
+            with open(self.config.export_strategy_file, "w") as f:
+                f.write(self.strategy.to_json())
+        if self.config.export_strategy_computation_graph_file:
+            with open(self.config.export_strategy_computation_graph_file, "w") as f:
+                f.write(self.graph.to_dot())
+        self.mesh = build_mesh(self.strategy.axis_sizes)
+        self.executor = CompiledExecutor(
+            graph=self.graph,
+            strategy=self.strategy,
+            mesh=self.mesh,
+            loss_type=loss_type,
+            metric_types=tuple(metrics),
+            optimizer=optimizer if comp_mode == CompMode.TRAINING else None,
+            outputs=[(t.node.guid, t.idx) for t in self._outputs],
+            backend=jax.default_backend(),
+            comp_mode=comp_mode,
+        )
+        self.executor.initialize(jax.random.key(self._seed))
+        return self
+
+    def _default_output(self) -> Tensor:
+        sinks = self.graph.sink_nodes()
+        if len(sinks) != 1:
+            raise ValueError(f"model has {len(sinks)} sink nodes; pass outputs= to compile()")
+        specs = infer_all_specs(self.graph)
+        n = sinks[0]
+        return Tensor(self, n, 0, specs[n.guid][0])
+
+    # ----------------------------------------------------------------- fit
+    def fit(
+        self,
+        x: Union[np.ndarray, Sequence[np.ndarray]],
+        y: np.ndarray,
+        epochs: Optional[int] = None,
+        batch_size: Optional[int] = None,
+        verbose: bool = True,
+    ) -> PerfMetrics:
+        """Training loop (reference: FFModel.fit flexflow_cffi.py:2044;
+        the begin_trace/end_trace pair is subsumed by jit compile cache)."""
+        assert self.executor is not None, "call compile() first"
+        xs = [x] if isinstance(x, (np.ndarray, jnp.ndarray)) else list(x)
+        epochs = epochs or self.config.epochs
+        bs = batch_size or self.config.batch_size
+        n = xs[0].shape[0]
+        steps = n // bs
+        rng = jax.random.key(self._seed + 1)
+        perf = PerfMetrics()
+        t0 = time.time()
+        for epoch in range(epochs):
+            for step in range(steps):
+                lo, hi = step * bs, (step + 1) * bs
+                batch_x = [jnp.asarray(xx[lo:hi]) for xx in xs]
+                batch_y = jnp.asarray(y[lo:hi])
+                rng, sub = jax.random.split(rng)
+                mets = self.executor.train_batch(batch_x, batch_y, sub)
+                perf.update({k: float(v) for k, v in mets.items() if k != "loss"})
+                if verbose and step % max(1, self.config.printing_interval) == 0:
+                    loss = float(mets.get("loss", 0.0))
+                    acc = perf.accuracy
+                    print(f"epoch {epoch} step {step}/{steps} loss {loss:.4f} acc {acc:.4f}")
+        elapsed = time.time() - t0
+        thru = epochs * steps * bs / max(1e-9, elapsed)
+        if verbose:
+            print(f"ELAPSED TIME = {elapsed:.4f}s THROUGHPUT = {thru:.2f} samples/s")
+        self.last_elapsed = elapsed
+        self.last_throughput = thru
+        return perf
+
+    def evaluate(self, x, y, batch_size: Optional[int] = None) -> PerfMetrics:
+        assert self.executor is not None
+        xs = [x] if isinstance(x, (np.ndarray, jnp.ndarray)) else list(x)
+        bs = batch_size or self.config.batch_size
+        n = xs[0].shape[0]
+        perf = PerfMetrics()
+        for step in range(n // bs):
+            lo, hi = step * bs, (step + 1) * bs
+            mets = self.executor.eval_batch([jnp.asarray(xx[lo:hi]) for xx in xs], jnp.asarray(y[lo:hi]))
+            perf.update({k: float(v) for k, v in mets.items() if k != "loss"})
+        return perf
+
+    def predict(self, x) -> jax.Array:
+        xs = [x] if isinstance(x, (np.ndarray, jnp.ndarray)) else list(x)
+        return self.executor.predict([jnp.asarray(xx) for xx in xs])[0]
+
+    # ------------------------------------------------------- introspection
+    def get_output(self) -> Tensor:
+        return self._outputs[0] if self._outputs else self._default_output()
+
+    def num_layers(self) -> int:
+        return sum(1 for n in self.graph.nodes.values() if n.op_type != OpType.INPUT)

@@ -1,0 +1,29 @@
+"""Operator library: registry population.
+
+Importing this package registers every OpDef (analog of the reference's
+``register_flexflow_internal_tasks``, src/runtime/model.cc:4201 — except
+registration here is shape-inference + JAX lowering + cost, not Legion
+task variants).
+"""
+from .base import (  # noqa: F401
+    LowerCtx,
+    OpCost,
+    OpDef,
+    WeightSpec,
+    get_op_def,
+    register_op,
+    registered_ops,
+)
+from . import io_ops  # noqa: F401
+from . import elementwise  # noqa: F401
+from . import linear  # noqa: F401
+from . import batch_matmul  # noqa: F401
+from . import conv  # noqa: F401
+from . import attention  # noqa: F401
+from . import embedding  # noqa: F401
+from . import norm  # noqa: F401
+from . import softmax  # noqa: F401
+from . import shape_ops  # noqa: F401
+from . import reduction_ops  # noqa: F401
+from . import moe_ops  # noqa: F401
+from . import parallel_ops  # noqa: F401

@@ -1,0 +1,146 @@
+"""MultiHeadAttention operator.
+
+Reference: src/ops/attention.cc (926 LoC) lowering to a monolithic
+``cudnnMultiHeadAttnForward`` (src/ops/attention.cu:35) with qkv+output
+projection weights woven into one tensor. TPU-native: explicit q/k/v/o
+projections (MXU matmuls) around a fused attention core — a Pallas
+flash-attention kernel on TPU (ops/kernels/flash_attention.py), falling
+back to the einsum/softmax composition under jit elsewhere. Unlike the
+reference (no causal masking, no long-context support at all — SURVEY
+§2.2), this op supports causal masks and, via the strategy layer,
+sequence-parallel ring attention.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+from ..core.types import DataType, OpType
+from .base import LowerCtx, OpCost, OpDef, WeightSpec, io_cost, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiHeadAttentionParams:
+    embed_dim: int
+    num_heads: int
+    kdim: int = 0  # 0 -> embed_dim // num_heads
+    vdim: int = 0
+    dropout: float = 0.0
+    use_bias: bool = False  # reference: bias flag
+    causal: bool = False  # new capability (absent in reference)
+    dtype: DataType = DataType.FLOAT
+
+    @property
+    def head_dim(self) -> int:
+        return self.kdim or self.embed_dim // self.num_heads
+
+    @property
+    def v_head_dim(self) -> int:
+        return self.vdim or self.embed_dim // self.num_heads
+
+
+@register_op
+class MultiHeadAttentionOp(OpDef):
+    op_type = OpType.MULTIHEAD_ATTENTION
+    params_cls = MultiHeadAttentionParams
+
+    @staticmethod
+    def infer_output_specs(params: MultiHeadAttentionParams, input_specs: List[TensorSpec]):
+        q = input_specs[0]
+        return [TensorSpec(q.shape[:-1] + (params.embed_dim,), params.dtype)]
+
+    @staticmethod
+    def weight_specs(params: MultiHeadAttentionParams, input_specs: List[TensorSpec]) -> List[WeightSpec]:
+        q, k, v = input_specs
+        h, dk, dv, e = params.num_heads, params.head_dim, params.v_head_dim, params.embed_dim
+        dt = params.dtype
+        ws = [
+            WeightSpec("wq", TensorSpec((q.shape[-1], h, dk), dt), "glorot_uniform"),
+            WeightSpec("wk", TensorSpec((k.shape[-1], h, dk), dt), "glorot_uniform"),
+            WeightSpec("wv", TensorSpec((v.shape[-1], h, dv), dt), "glorot_uniform"),
+            WeightSpec("wo", TensorSpec((h, dv, e), dt), "glorot_uniform"),
+        ]
+        if params.use_bias:
+            ws += [
+                WeightSpec("bq", TensorSpec((h, dk), dt), "zeros"),
+                WeightSpec("bk", TensorSpec((h, dk), dt), "zeros"),
+                WeightSpec("bv", TensorSpec((h, dv), dt), "zeros"),
+                WeightSpec("bo", TensorSpec((e,), dt), "zeros"),
+            ]
+        return ws
+
+    @staticmethod
+    def lower(params: MultiHeadAttentionParams, inputs, weights, ctx: LowerCtx):
+        q, k, v = inputs
+        # projections: [B, S, E] x [E, H, D] -> [B, S, H, D]
+        qh = jnp.einsum("bse,ehd->bshd", q, weights["wq"])
+        kh = jnp.einsum("bse,ehd->bshd", k, weights["wk"])
+        vh = jnp.einsum("bse,ehd->bshd", v, weights["wv"])
+        if params.use_bias:
+            qh = qh + weights["bq"]
+            kh = kh + weights["bk"]
+            vh = vh + weights["bv"]
+        ctx_out = attention_core(qh, kh, vh, causal=params.causal, backend=ctx.backend)
+        out = jnp.einsum("bshd,hde->bse", ctx_out, weights["wo"])
+        if params.use_bias:
+            out = out + weights["bo"]
+        if params.dropout > 0.0 and ctx.training:
+            keep = 1.0 - params.dropout
+            mask = jax.random.bernoulli(ctx.node_rng(), keep, out.shape)
+            out = jnp.where(mask, out / keep, 0.0).astype(out.dtype)
+        return [out.astype(params.dtype.jnp)]
+
+    @staticmethod
+    def cost(params: MultiHeadAttentionParams, input_specs, output_specs) -> OpCost:
+        q, k, v = input_specs
+        b, sq = q.shape[0], q.shape[1]
+        sk = k.shape[1]
+        h, dk, dv, e = params.num_heads, params.head_dim, params.v_head_dim, params.embed_dim
+        proj = 2.0 * b * (sq * q.shape[-1] * h * dk + sk * k.shape[-1] * h * dk + sk * v.shape[-1] * h * dv + sq * h * dv * e)
+        core = 2.0 * b * h * sq * sk * (dk + dv)
+        w_elems = q.shape[-1] * h * dk + k.shape[-1] * h * dk + v.shape[-1] * h * dv + h * dv * e
+        w_bytes = w_elems * params.dtype.size_bytes
+        c = io_cost(input_specs, output_specs, flops=proj + core, extra_mem=w_bytes)
+        c.bytes_accessed += w_bytes
+        return c
+
+
+def attention_core(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    backend: str = "tpu",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Scaled dot-product attention over [B, S, H, D] tensors.
+
+    Dispatches to the Pallas flash-attention kernel on TPU backends and to
+    the XLA einsum composition elsewhere (CPU test meshes, interpret mode).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if backend == "tpu" and jax.default_backend() == "tpu":
+        try:
+            from .kernels.flash_attention import flash_attention, supports_shapes
+        except ImportError:
+            flash_attention = None
+        if flash_attention is not None and supports_shapes(q.shape, k.shape):
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+    return reference_attention(q, k, v, causal=causal, scale=scale)
+
+
+def reference_attention(q, k, v, causal=False, scale=None):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)

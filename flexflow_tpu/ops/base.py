@@ -1,0 +1,127 @@
+"""Operator definition registry.
+
+TPU-native analog of the reference's Op class hierarchy
+(reference: include/flexflow/operator.h:51-277, src/ops/*). Where the
+reference gives each op Legion launchers + CUDA kernels + a
+``measure_operator_cost`` hook, here each op provides:
+
+  * a frozen params record (the reference's ``<op>_params.h``),
+  * shape inference (``infer_output_specs``),
+  * weight specs + initializer choice,
+  * a JAX lowering (the kernel — XLA/Pallas instead of cuDNN/cuBLAS),
+  * an analytic cost estimate (flops / bytes) feeding the simulator, in
+    place of on-device CUDA-event measurement (simulator.cc:588-628);
+    measured calibration happens at the cost-model layer.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..core.tensor import TensorSpec
+from ..core.types import DataType, OpType
+
+
+@dataclasses.dataclass(frozen=True)
+class WeightSpec:
+    """A learnable parameter of an op + its initializer."""
+
+    name: str
+    spec: TensorSpec
+    initializer: str = "glorot_uniform"  # name into runtime/initializers.py
+    trainable: bool = True
+
+
+@dataclasses.dataclass
+class OpCost:
+    """Analytic per-op cost (reference: CostMetrics simulator.h:54-88)."""
+
+    flops: float = 0.0
+    bytes_accessed: float = 0.0  # HBM traffic: inputs + outputs + weights
+    memory_bytes: float = 0.0  # resident memory: weights + activations
+
+    def __add__(self, other: "OpCost") -> "OpCost":
+        return OpCost(
+            self.flops + other.flops,
+            self.bytes_accessed + other.bytes_accessed,
+            self.memory_bytes + other.memory_bytes,
+        )
+
+
+@dataclasses.dataclass
+class LowerCtx:
+    """Context threaded through op lowering."""
+
+    training: bool = True
+    rng: Optional[jax.Array] = None  # base PRNG key; fold_in node guid per op
+    node_guid: int = 0
+    backend: str = "tpu"  # "tpu" enables pallas kernels; "cpu" falls back to XLA
+    mesh: Optional[Any] = None  # jax.sharding.Mesh when lowering a sharded strategy
+    seq_length: Optional[int] = None  # iteration-level seq truncation (FFIterationConfig)
+    # functional state written by ops (e.g. batchnorm running stats),
+    # keyed (node_guid, weight_name); merged by the executor after the step
+    state_updates: Dict = dataclasses.field(default_factory=dict)
+    # auxiliary losses appended by ops (e.g. MoE load-balancing, aggregate.cc
+    # lambda_bal); summed into the total loss by the executor
+    aux_losses: List = dataclasses.field(default_factory=list)
+
+    def node_rng(self) -> jax.Array:
+        if self.rng is None:
+            raise ValueError("op requires an RNG but none was provided")
+        return jax.random.fold_in(self.rng, self.node_guid)
+
+
+class OpDef:
+    """Base operator definition; subclasses register per OpType."""
+
+    op_type: OpType = None  # type: ignore
+    params_cls: type = None  # type: ignore
+
+    # --- shape inference -------------------------------------------------
+    @staticmethod
+    def infer_output_specs(params, input_specs: List[TensorSpec]) -> List[TensorSpec]:
+        raise NotImplementedError
+
+    # --- weights ---------------------------------------------------------
+    @staticmethod
+    def weight_specs(params, input_specs: List[TensorSpec]) -> List[WeightSpec]:
+        return []
+
+    # --- lowering --------------------------------------------------------
+    @staticmethod
+    def lower(params, inputs: List[jax.Array], weights: Dict[str, jax.Array], ctx: LowerCtx) -> List[jax.Array]:
+        raise NotImplementedError
+
+    # --- cost ------------------------------------------------------------
+    @staticmethod
+    def cost(params, input_specs: List[TensorSpec], output_specs: List[TensorSpec]) -> OpCost:
+        io_bytes = sum(s.size_bytes for s in input_specs) + sum(s.size_bytes for s in output_specs)
+        return OpCost(flops=0.0, bytes_accessed=io_bytes, memory_bytes=sum(s.size_bytes for s in output_specs))
+
+
+_REGISTRY: Dict[OpType, type] = {}
+
+
+def register_op(cls: type) -> type:
+    if cls.op_type is None:
+        raise ValueError(f"{cls} missing op_type")
+    _REGISTRY[cls.op_type] = cls
+    return cls
+
+
+def get_op_def(op_type: OpType) -> type:
+    if op_type not in _REGISTRY:
+        raise KeyError(f"no OpDef registered for {op_type}")
+    return _REGISTRY[op_type]
+
+
+def registered_ops() -> Dict[OpType, type]:
+    return dict(_REGISTRY)
+
+
+def io_cost(input_specs: Sequence[TensorSpec], output_specs: Sequence[TensorSpec], flops: float = 0.0, extra_mem: float = 0.0) -> OpCost:
+    io = sum(s.size_bytes for s in input_specs) + sum(s.size_bytes for s in output_specs)
+    out_mem = sum(s.size_bytes for s in output_specs)
+    return OpCost(flops=flops, bytes_accessed=io, memory_bytes=out_mem + extra_mem)

@@ -1,0 +1,57 @@
+"""BatchMatmul operator.
+
+Reference: src/ops/batch_matmul.cc (711 LoC) + kernels/batch_matmul.cu
+(cublasGemmStridedBatchedEx). Carries the reference's per-input
+seq-length-dim early-truncation feature (model.h:483-487): at trace time
+a ``seq_length`` in the iteration config slices the marked dims.
+Computes C[b] = A[b] @ B[b] over leading batch dims.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+from ..core.types import OpType
+from .base import LowerCtx, OpCost, OpDef, io_cost, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchMatmulParams:
+    a_seq_length_dim: int = -1
+    b_seq_length_dim: int = -1
+
+
+@register_op
+class BatchMatmulOp(OpDef):
+    op_type = OpType.BATCH_MATMUL
+    params_cls = BatchMatmulParams
+
+    @staticmethod
+    def infer_output_specs(params, input_specs: List[TensorSpec]):
+        a, b = input_specs
+        if a.shape[:-2] != b.shape[:-2]:
+            raise ValueError(f"batch dims mismatch: {a.shape} vs {b.shape}")
+        if a.shape[-1] != b.shape[-2]:
+            raise ValueError(f"contraction mismatch: {a.shape} @ {b.shape}")
+        return [TensorSpec(a.shape[:-1] + (b.shape[-1],), a.dtype)]
+
+    @staticmethod
+    def lower(params: BatchMatmulParams, inputs, weights, ctx: LowerCtx):
+        a, b = inputs
+        seq = getattr(ctx, "seq_length", None)
+        if seq is not None:
+            if params.a_seq_length_dim >= 0:
+                a = jnp.take(a, jnp.arange(seq), axis=params.a_seq_length_dim)
+            if params.b_seq_length_dim >= 0:
+                b = jnp.take(b, jnp.arange(seq), axis=params.b_seq_length_dim)
+        return [jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)]
+
+    @staticmethod
+    def cost(params, input_specs, output_specs) -> OpCost:
+        a, b = input_specs
+        k = a.shape[-1]
+        flops = 2.0 * output_specs[0].num_elements * k
+        return io_cost(input_specs, output_specs, flops=flops)

@@ -1,0 +1,141 @@
+"""Convolution family: Conv2D, Pool2D.
+
+Reference: src/ops/conv_2d.cc (1198 LoC, cudnnConvolution with fwd-algo
+selection + groups) and src/ops/pool_2d.cc (688 LoC, cudnnPooling).
+TPU-native: lax.conv_general_dilated / lax.reduce_window — XLA lowers
+these onto the MXU (convs become implicit GEMMs) with its own algorithm
+selection; the reference's cudnnFindConvolutionForwardAlgorithm has no
+analog because XLA autotunes. Layout is logical NCHW for API parity with
+the reference; XLA relayouts internally for the TPU.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import TensorSpec
+from ..core.types import ActiMode, DataType, OpType, PoolType
+from .base import LowerCtx, OpCost, OpDef, WeightSpec, io_cost, register_op
+from .elementwise import apply_activation
+
+
+def _out_dim(size, kernel, stride, pad):
+    return (size + 2 * pad - kernel) // stride + 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Conv2DParams:
+    out_channels: int
+    kernel: tuple  # (kh, kw)
+    stride: tuple  # (sh, sw)
+    padding: tuple  # (ph, pw)
+    groups: int = 1
+    use_bias: bool = True
+    activation: ActiMode = ActiMode.NONE
+    dtype: DataType = DataType.FLOAT
+    kernel_initializer: str = "glorot_uniform"
+
+
+@register_op
+class Conv2DOp(OpDef):
+    op_type = OpType.CONV2D
+    params_cls = Conv2DParams
+
+    @staticmethod
+    def infer_output_specs(params: Conv2DParams, input_specs: List[TensorSpec]):
+        (x,) = input_specs
+        n, c, h, w = x.shape
+        oh = _out_dim(h, params.kernel[0], params.stride[0], params.padding[0])
+        ow = _out_dim(w, params.kernel[1], params.stride[1], params.padding[1])
+        return [TensorSpec((n, params.out_channels, oh, ow), params.dtype)]
+
+    @staticmethod
+    def weight_specs(params: Conv2DParams, input_specs: List[TensorSpec]) -> List[WeightSpec]:
+        (x,) = input_specs
+        cin = x.shape[1]
+        ws = [
+            WeightSpec(
+                "kernel",
+                TensorSpec((params.out_channels, cin // params.groups) + tuple(params.kernel), params.dtype),
+                params.kernel_initializer,
+            )
+        ]
+        if params.use_bias:
+            ws.append(WeightSpec("bias", TensorSpec((params.out_channels,), params.dtype), "zeros"))
+        return ws
+
+    @staticmethod
+    def lower(params: Conv2DParams, inputs, weights, ctx: LowerCtx):
+        (x,) = inputs
+        y = lax.conv_general_dilated(
+            x,
+            weights["kernel"],
+            window_strides=params.stride,
+            padding=[(params.padding[0], params.padding[0]), (params.padding[1], params.padding[1])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=params.groups,
+            preferred_element_type=jnp.float32,
+        ).astype(params.dtype.jnp)
+        if params.use_bias:
+            y = y + weights["bias"].reshape(1, -1, 1, 1)
+        return [apply_activation(params.activation, y)]
+
+    @staticmethod
+    def cost(params: Conv2DParams, input_specs, output_specs) -> OpCost:
+        (x,) = input_specs
+        (y,) = output_specs
+        cin = x.shape[1]
+        flops = 2.0 * y.num_elements * (cin // params.groups) * params.kernel[0] * params.kernel[1]
+        w_bytes = params.out_channels * (cin // params.groups) * params.kernel[0] * params.kernel[1] * params.dtype.size_bytes
+        c = io_cost(input_specs, output_specs, flops=flops, extra_mem=w_bytes)
+        c.bytes_accessed += w_bytes
+        return c
+
+
+@dataclasses.dataclass(frozen=True)
+class Pool2DParams:
+    kernel: tuple
+    stride: tuple
+    padding: tuple
+    pool_type: PoolType = PoolType.MAX
+    activation: ActiMode = ActiMode.NONE
+
+
+@register_op
+class Pool2DOp(OpDef):
+    op_type = OpType.POOL2D
+    params_cls = Pool2DParams
+
+    @staticmethod
+    def infer_output_specs(params: Pool2DParams, input_specs: List[TensorSpec]):
+        (x,) = input_specs
+        n, c, h, w = x.shape
+        oh = _out_dim(h, params.kernel[0], params.stride[0], params.padding[0])
+        ow = _out_dim(w, params.kernel[1], params.stride[1], params.padding[1])
+        return [TensorSpec((n, c, oh, ow), x.dtype)]
+
+    @staticmethod
+    def lower(params: Pool2DParams, inputs, weights, ctx):
+        (x,) = inputs
+        pads = ((0, 0), (0, 0), (params.padding[0], params.padding[0]), (params.padding[1], params.padding[1]))
+        dims = (1, 1) + tuple(params.kernel)
+        strides = (1, 1) + tuple(params.stride)
+        if params.pool_type == PoolType.MAX:
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            y = lax.reduce_window(x, init, lax.max, dims, strides, pads)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+            # divide by true window size (count_include_pad=False à la cuDNN default)
+            ones = jnp.ones(x.shape[2:], x.dtype)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, tuple(params.kernel), tuple(params.stride), pads[2:])
+            y = s / cnt[None, None]
+        return [apply_activation(params.activation, y)]
+
+    @staticmethod
+    def cost(params: Pool2DParams, input_specs, output_specs):
+        k = params.kernel[0] * params.kernel[1]
+        return io_cost(input_specs, output_specs, flops=float(k) * output_specs[0].num_elements)

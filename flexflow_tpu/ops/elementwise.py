@@ -1,0 +1,150 @@
+"""Elementwise binary / unary / scalar operators.
+
+Reference: src/ops/element_binary.cc (812 LoC, add/sub/mul/div/max/min with
+broadcast + inplace) and src/ops/element_unary.cc (696 LoC,
+relu/sigmoid/tanh/elu/gelu/identity/exp/sin/cos/rsqrt/pow + scalar ops).
+TPU-native: plain jnp ops — XLA fuses entire elementwise chains into the
+neighboring matmul/conv, so the reference's "inplace" optimization
+(model.cc:2904-2938) is subsumed by the compiler.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+from ..core.types import ActiMode, OpType
+from .base import LowerCtx, OpCost, OpDef, io_cost, register_op
+
+
+def apply_activation(mode: ActiMode, x: jax.Array) -> jax.Array:
+    if mode == ActiMode.NONE:
+        return x
+    if mode == ActiMode.RELU:
+        return jax.nn.relu(x)
+    if mode == ActiMode.SIGMOID:
+        return jax.nn.sigmoid(x)
+    if mode == ActiMode.TANH:
+        return jnp.tanh(x)
+    if mode == ActiMode.GELU:
+        return jax.nn.gelu(x)
+    raise ValueError(f"unknown activation {mode}")
+
+
+def broadcast_shape(a, b):
+    return jnp.broadcast_shapes(a, b)
+
+
+_BINARY_FNS = {
+    OpType.EW_ADD: jnp.add,
+    OpType.EW_SUB: jnp.subtract,
+    OpType.EW_MUL: jnp.multiply,
+    OpType.EW_DIV: jnp.divide,
+    OpType.EW_MAX: jnp.maximum,
+    OpType.EW_MIN: jnp.minimum,
+}
+
+_UNARY_FNS = {
+    OpType.RELU: jax.nn.relu,
+    OpType.SIGMOID: jax.nn.sigmoid,
+    OpType.TANH: jnp.tanh,
+    OpType.ELU: jax.nn.elu,
+    OpType.GELU: jax.nn.gelu,
+    OpType.IDENTITY: lambda x: x,
+    OpType.EXP: jnp.exp,
+    OpType.SIN: jnp.sin,
+    OpType.COS: jnp.cos,
+    OpType.RSQRT: jax.lax.rsqrt,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ElementBinaryParams:
+    op: OpType  # one of _BINARY_FNS
+    inplace_a: bool = False  # API parity; XLA handles buffer reuse
+
+
+def _make_binary(op_type: OpType):
+    class _Binary(OpDef):
+        pass
+
+    _Binary.op_type = op_type
+    _Binary.params_cls = ElementBinaryParams
+    _Binary.__name__ = f"ElementBinary_{op_type.value}"
+
+    def infer_output_specs(params, input_specs: List[TensorSpec]):
+        a, b = input_specs
+        return [TensorSpec(broadcast_shape(a.shape, b.shape), a.dtype)]
+
+    def lower(params, inputs, weights, ctx):
+        a, b = inputs
+        return [_BINARY_FNS[op_type](a, b)]
+
+    def cost(params, input_specs, output_specs):
+        return io_cost(input_specs, output_specs, flops=output_specs[0].num_elements)
+
+    _Binary.infer_output_specs = staticmethod(infer_output_specs)
+    _Binary.lower = staticmethod(lower)
+    _Binary.cost = staticmethod(cost)
+    return register_op(_Binary)
+
+
+for _t in _BINARY_FNS:
+    _make_binary(_t)
+
+
+@dataclasses.dataclass(frozen=True)
+class ElementUnaryParams:
+    op: OpType
+    scalar: float = 0.0  # used by scalar_* and pow
+    inplace: bool = False
+
+
+def _make_unary(op_type: OpType):
+    class _Unary(OpDef):
+        pass
+
+    _Unary.op_type = op_type
+    _Unary.params_cls = ElementUnaryParams
+    _Unary.__name__ = f"ElementUnary_{op_type.value}"
+
+    def infer_output_specs(params, input_specs: List[TensorSpec]):
+        return [input_specs[0]]
+
+    def lower(params, inputs, weights, ctx):
+        (x,) = inputs
+        if op_type in _UNARY_FNS:
+            return [_UNARY_FNS[op_type](x)]
+        s = params.scalar
+        if op_type == OpType.POW:
+            return [jnp.power(x, s)]
+        if op_type == OpType.SCALAR_ADD:
+            return [x + s]
+        if op_type == OpType.SCALAR_SUB:
+            return [x - s]
+        if op_type == OpType.SCALAR_MUL:
+            return [x * s]
+        if op_type == OpType.SCALAR_TRUE_DIV:
+            return [x / s]
+        raise ValueError(f"unknown unary {op_type}")
+
+    def cost(params, input_specs, output_specs):
+        return io_cost(input_specs, output_specs, flops=output_specs[0].num_elements)
+
+    _Unary.infer_output_specs = staticmethod(infer_output_specs)
+    _Unary.lower = staticmethod(lower)
+    _Unary.cost = staticmethod(cost)
+    return register_op(_Unary)
+
+
+for _t in list(_UNARY_FNS) + [
+    OpType.POW,
+    OpType.SCALAR_ADD,
+    OpType.SCALAR_SUB,
+    OpType.SCALAR_MUL,
+    OpType.SCALAR_TRUE_DIV,
+]:
+    _make_unary(_t)

@@ -1,0 +1,73 @@
+"""Embedding operator.
+
+Reference: src/ops/embedding.cc (1205 LoC) + kernels/embedding_kernels.cu.
+Supports SUM/AVG aggregation over a bag of indices per sample
+(reference AggrMode) and plain per-token lookup when aggr=NONE.
+TPU-native: jnp.take — XLA lowers gathers efficiently on TPU; for
+attribute-parallel (vocab-sharded) embeddings the strategy layer shards
+the table's vocab dim and XLA inserts the needed collectives.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+from ..core.types import AggrMode, DataType, OpType
+from .base import LowerCtx, OpCost, OpDef, WeightSpec, io_cost, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingParams:
+    num_entries: int
+    out_dim: int
+    aggr: AggrMode = AggrMode.NONE
+    dtype: DataType = DataType.FLOAT
+    initializer: str = "glorot_uniform"
+
+
+@register_op
+class EmbeddingOp(OpDef):
+    op_type = OpType.EMBEDDING
+    params_cls = EmbeddingParams
+
+    @staticmethod
+    def infer_output_specs(params: EmbeddingParams, input_specs: List[TensorSpec]):
+        (idx,) = input_specs
+        if params.aggr == AggrMode.NONE:
+            # per-token lookup: [..., ] -> [..., out_dim]
+            return [TensorSpec(idx.shape + (params.out_dim,), params.dtype)]
+        # bag aggregation over the last dim: [B, bag] -> [B, out_dim]
+        return [TensorSpec(idx.shape[:-1] + (params.out_dim,), params.dtype)]
+
+    @staticmethod
+    def weight_specs(params: EmbeddingParams, input_specs: List[TensorSpec]) -> List[WeightSpec]:
+        return [
+            WeightSpec(
+                "embedding",
+                TensorSpec((params.num_entries, params.out_dim), params.dtype),
+                params.initializer,
+            )
+        ]
+
+    @staticmethod
+    def lower(params: EmbeddingParams, inputs, weights, ctx: LowerCtx):
+        (idx,) = inputs
+        table = weights["embedding"]
+        vecs = jnp.take(table, idx.astype(jnp.int32), axis=0)
+        if params.aggr == AggrMode.SUM:
+            vecs = jnp.sum(vecs, axis=-2)
+        elif params.aggr == AggrMode.AVG:
+            vecs = jnp.mean(vecs, axis=-2)
+        return [vecs]
+
+    @staticmethod
+    def cost(params: EmbeddingParams, input_specs, output_specs) -> OpCost:
+        (idx,) = input_specs
+        gathered = idx.num_elements * params.out_dim * params.dtype.size_bytes
+        table_bytes = params.num_entries * params.out_dim * params.dtype.size_bytes
+        c = io_cost(input_specs, output_specs, flops=float(idx.num_elements * params.out_dim), extra_mem=table_bytes)
+        c.bytes_accessed += gathered
+        return c

@@ -1,0 +1,70 @@
+"""Dense (Linear) operator.
+
+Reference: src/ops/linear.cc (1149 LoC) + kernels/linear_kernels.cu
+(cublasGemmEx at linear_kernels.cu:213). TPU-native: a single jnp.dot —
+XLA tiles it onto the MXU and fuses bias + activation; no hand-written
+GEMM kernel needed. Convention: y = x @ W + b with x[..., in_dim],
+W[in_dim, out_dim] (row-major, batch-first; the reference is column-major).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+from ..core.types import ActiMode, DataType, OpType
+from .base import LowerCtx, OpCost, OpDef, WeightSpec, io_cost, register_op
+from .elementwise import apply_activation
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearParams:
+    out_dim: int
+    use_bias: bool = True
+    activation: ActiMode = ActiMode.NONE
+    dtype: DataType = DataType.FLOAT
+    kernel_initializer: str = "glorot_uniform"
+    bias_initializer: str = "zeros"
+
+
+@register_op
+class LinearOp(OpDef):
+    op_type = OpType.LINEAR
+    params_cls = LinearParams
+
+    @staticmethod
+    def infer_output_specs(params: LinearParams, input_specs: List[TensorSpec]) -> List[TensorSpec]:
+        (x,) = input_specs
+        return [TensorSpec(x.shape[:-1] + (params.out_dim,), params.dtype)]
+
+    @staticmethod
+    def weight_specs(params: LinearParams, input_specs: List[TensorSpec]) -> List[WeightSpec]:
+        (x,) = input_specs
+        in_dim = x.shape[-1]
+        ws = [WeightSpec("kernel", TensorSpec((in_dim, params.out_dim), params.dtype), params.kernel_initializer)]
+        if params.use_bias:
+            ws.append(WeightSpec("bias", TensorSpec((params.out_dim,), params.dtype), params.bias_initializer))
+        return ws
+
+    @staticmethod
+    def lower(params: LinearParams, inputs, weights, ctx: LowerCtx):
+        (x,) = inputs
+        y = jnp.dot(x, weights["kernel"], preferred_element_type=jnp.float32)
+        y = y.astype(params.dtype.jnp)
+        if params.use_bias:
+            y = y + weights["bias"]
+        return [apply_activation(params.activation, y)]
+
+    @staticmethod
+    def cost(params: LinearParams, input_specs, output_specs) -> OpCost:
+        (x,) = input_specs
+        in_dim = x.shape[-1]
+        batch = x.num_elements // in_dim
+        flops = 2.0 * batch * in_dim * params.out_dim
+        w_bytes = in_dim * params.out_dim * params.dtype.size_bytes
+        c = io_cost(input_specs, output_specs, flops=flops, extra_mem=w_bytes)
+        c.bytes_accessed += w_bytes
+        return c

@@ -1,0 +1,214 @@
+"""Mixture-of-Experts operator family: TopK, GroupBy, Aggregate,
+AggregateSpec, Cache.
+
+Reference: src/ops/topk.cc (437), group_by.cc (534), aggregate.cc (569,
+with the lambda_bal load-balancing gradient), aggregate_spec.cc (519),
+cache.cc (291, score-triggered recompile). The reference moves tokens
+with CUDA scatter kernels into per-expert buffers of capacity
+``alpha * k * B / n``. TPU-native: identical static-capacity semantics,
+implemented with one-hot matmuls, cumsum position assignment and
+scatter — all static shapes so XLA can compile them; expert parallelism
+lays experts on a mesh axis and XLA's all_to_all moves the tokens.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+from ..core.types import DataType, OpType
+from .base import LowerCtx, OpCost, OpDef, io_cost, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class TopKParams:
+    k: int
+    sorted: bool = True
+
+
+@register_op
+class TopKOp(OpDef):
+    op_type = OpType.TOPK
+    params_cls = TopKParams
+
+    @staticmethod
+    def infer_output_specs(params: TopKParams, input_specs: List[TensorSpec]):
+        (x,) = input_specs
+        shape = x.shape[:-1] + (params.k,)
+        return [TensorSpec(shape, x.dtype), TensorSpec(shape, DataType.INT32)]
+
+    @staticmethod
+    def lower(params: TopKParams, inputs, weights, ctx):
+        values, indices = jax.lax.top_k(inputs[0], params.k)
+        return [values, indices.astype(jnp.int32)]
+
+    @staticmethod
+    def cost(params, input_specs, output_specs):
+        n = input_specs[0].num_elements
+        return io_cost(input_specs, output_specs, flops=float(n) * math.log2(max(2, input_specs[0].shape[-1])))
+
+
+def expert_capacity(batch: int, k: int, n_experts: int, alpha: float) -> int:
+    """Per-expert token capacity (reference: group_by.cc capacity calc)."""
+    return max(1, int(math.ceil(alpha * k * batch / n_experts)))
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupByParams:
+    n_experts: int
+    alpha: float = 1.0  # capacity factor
+
+
+@register_op
+class GroupByOp(OpDef):
+    """Scatter tokens into per-expert buffers.
+
+    Inputs: data [B, D], assignments [B, K] (int expert ids).
+    Outputs: n_experts tensors [capacity, D]; overflowing tokens are
+    dropped (same drop semantics as the reference's fixed-size buffers).
+    """
+
+    op_type = OpType.GROUP_BY
+    params_cls = GroupByParams
+
+    @staticmethod
+    def infer_output_specs(params: GroupByParams, input_specs: List[TensorSpec]):
+        data, assign = input_specs
+        b, d = data.shape
+        cap = expert_capacity(b, assign.shape[-1], params.n_experts, params.alpha)
+        return [TensorSpec((cap, d), data.dtype) for _ in range(params.n_experts)]
+
+    @staticmethod
+    def lower(params: GroupByParams, inputs, weights, ctx: LowerCtx):
+        data, assign = inputs
+        b, d = data.shape
+        k = assign.shape[-1]
+        n = params.n_experts
+        cap = expert_capacity(b, k, n, params.alpha)
+        flat_assign = assign.reshape(-1).astype(jnp.int32)  # [B*K]
+        # position of each (token, slot) within its expert, via masked cumsum
+        onehot = jax.nn.one_hot(flat_assign, n, dtype=jnp.int32)  # [B*K, n]
+        pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based position per expert
+        pos_in_expert = jnp.sum(pos, axis=-1) - 1  # [B*K]
+        token_idx = jnp.repeat(jnp.arange(b), k)
+        outs = []
+        for e in range(n):
+            sel = (flat_assign == e) & (pos_in_expert < cap)
+            dst = jnp.where(sel, pos_in_expert, cap)  # row `cap` = dropped/overflow
+            buf = jnp.zeros((cap + 1, d), data.dtype).at[dst].set(data[token_idx])[:cap]
+            outs.append(buf)
+        return outs
+
+    @staticmethod
+    def cost(params: GroupByParams, input_specs, output_specs):
+        return io_cost(input_specs, output_specs, flops=2.0 * input_specs[0].num_elements)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateParams:
+    n_experts: int
+    lambda_bal: float = 0.0  # load-balance aux loss weight (aggregate.cc)
+    alpha: float = 1.0
+
+
+@register_op
+class AggregateOp(OpDef):
+    """Gather expert outputs back to token order, weighted by gate scores.
+
+    Inputs: gate_preds [B, K], gate_assign [B, K], then n_experts tensors
+    [capacity, D] (reference aggregate.cc input layout, minus the
+    backward-only full_gate_grads which autodiff makes unnecessary).
+    Output: [B, D].
+    """
+
+    op_type = OpType.AGGREGATE
+    params_cls = AggregateParams
+
+    @staticmethod
+    def infer_output_specs(params: AggregateParams, input_specs: List[TensorSpec]):
+        gate = input_specs[0]
+        d = input_specs[2].shape[-1]
+        return [TensorSpec((gate.shape[0], d), input_specs[2].dtype)]
+
+    @staticmethod
+    def lower(params: AggregateParams, inputs, weights, ctx: LowerCtx):
+        gate_preds, gate_assign = inputs[0], inputs[1]
+        experts = inputs[2:]
+        b, k = gate_preds.shape
+        n = params.n_experts
+        cap = experts[0].shape[0]
+        d = experts[0].shape[1]
+        flat_assign = gate_assign.reshape(-1).astype(jnp.int32)
+        onehot = jax.nn.one_hot(flat_assign, n, dtype=jnp.int32)
+        pos = jnp.cumsum(onehot, axis=0) * onehot
+        pos_in_expert = jnp.sum(pos, axis=-1) - 1  # [B*K]
+        valid = pos_in_expert < cap
+        stacked = jnp.stack(experts)  # [n, cap, D]
+        rows = stacked[flat_assign, jnp.clip(pos_in_expert, 0, cap - 1)]  # [B*K, D]
+        rows = jnp.where(valid[:, None], rows, 0.0)
+        w = gate_preds.reshape(-1)[:, None].astype(rows.dtype)
+        out = jnp.sum((rows * w).reshape(b, k, d), axis=1)
+        if params.lambda_bal > 0.0:
+            # load-balance aux loss (reference: aggregate.cc lambda_bal):
+            # penalize squared per-expert token fractions (Shazeer-style)
+            frac = jnp.mean(jax.nn.one_hot(flat_assign, n, dtype=jnp.float32), axis=0)
+            imp = jnp.mean(
+                jax.nn.one_hot(flat_assign, n, dtype=jnp.float32)
+                * gate_preds.reshape(-1, 1).astype(jnp.float32),
+                axis=0,
+            )
+            ctx.aux_losses.append(params.lambda_bal * n * jnp.sum(frac * imp))
+        return [out]
+
+    @staticmethod
+    def cost(params, input_specs, output_specs):
+        return io_cost(input_specs, output_specs, flops=3.0 * output_specs[0].num_elements)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateSpecParams:
+    n_experts: int
+    lambda_bal: float = 0.0
+    alpha: float = 1.0
+
+
+@register_op
+class AggregateSpecOp(AggregateOp):
+    """Speculative-assignment variant (reference: aggregate_spec.cc) —
+    combines expert outputs under the *true* assignment while gradients
+    flow to the speculative gate scores; forward math matches Aggregate."""
+
+    op_type = OpType.AGGREGATE_SPEC
+    params_cls = AggregateSpecParams
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheParams:
+    num_batches: int = 1
+    trigger_threshold: float = 0.0
+
+
+@register_op
+class CacheOp(OpDef):
+    """Input-caching op (reference: cache.cc): stores recent batches and
+    scores drift to trigger recompilation. Forward is identity; the
+    scoring/trigger logic lives in runtime/recompile.py on host."""
+
+    op_type = OpType.CACHE
+    params_cls = CacheParams
+
+    @staticmethod
+    def infer_output_specs(params, input_specs: List[TensorSpec]):
+        return [input_specs[0]]
+
+    @staticmethod
+    def lower(params, inputs, weights, ctx):
+        return [inputs[0]]
+
+    @staticmethod
+    def cost(params, input_specs, output_specs):
+        return OpCost()

@@ -1,0 +1,127 @@
+"""Normalization operators: LayerNorm, BatchNorm.
+
+Reference: src/ops/layer_norm.cc (601 LoC) + layer_norm.cu,
+src/ops/batch_norm.cc (322 LoC) + batch_norm.cu (cudnnBatchNormalization,
+optional fused relu). TPU-native: jnp reductions — XLA fuses the
+mean/var/normalize chain into one pass. BatchNorm running statistics are
+functional state threaded through LowerCtx.state_updates instead of
+mutable cuDNN tensors.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+from ..core.types import DataType, OpType
+from .base import LowerCtx, OpDef, WeightSpec, io_cost, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerNormParams:
+    axes: tuple  # normalized axes (reference: reversed Legion order; here NumPy order)
+    elementwise_affine: bool = True
+    eps: float = 1e-5
+    dtype: DataType = DataType.FLOAT
+
+
+@register_op
+class LayerNormOp(OpDef):
+    op_type = OpType.LAYERNORM
+    params_cls = LayerNormParams
+
+    @staticmethod
+    def infer_output_specs(params, input_specs: List[TensorSpec]):
+        return [input_specs[0]]
+
+    @staticmethod
+    def weight_specs(params: LayerNormParams, input_specs: List[TensorSpec]) -> List[WeightSpec]:
+        if not params.elementwise_affine:
+            return []
+        (x,) = input_specs
+        shape = tuple(x.shape[a] for a in params.axes)
+        return [
+            WeightSpec("scale", TensorSpec(shape, params.dtype), "ones"),
+            WeightSpec("bias", TensorSpec(shape, params.dtype), "zeros"),
+        ]
+
+    @staticmethod
+    def lower(params: LayerNormParams, inputs, weights, ctx):
+        (x,) = inputs
+        axes = tuple(a % x.ndim for a in params.axes)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + params.eps)
+        if params.elementwise_affine:
+            bshape = [x.shape[a] if a in axes else 1 for a in range(x.ndim)]
+            y = y * weights["scale"].reshape(bshape) + weights["bias"].reshape(bshape)
+        return [y.astype(x.dtype)]
+
+    @staticmethod
+    def cost(params, input_specs, output_specs):
+        return io_cost(input_specs, output_specs, flops=8.0 * output_specs[0].num_elements)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchNormParams:
+    relu: bool = True  # reference batch_norm has fused-relu option
+    eps: float = 1e-5
+    momentum: float = 0.9
+    dtype: DataType = DataType.FLOAT
+
+
+@register_op
+class BatchNormOp(OpDef):
+    """BatchNorm over NCHW input, stats over (N,H,W) per channel."""
+
+    op_type = OpType.BATCHNORM
+    params_cls = BatchNormParams
+
+    @staticmethod
+    def infer_output_specs(params, input_specs: List[TensorSpec]):
+        return [input_specs[0]]
+
+    @staticmethod
+    def weight_specs(params: BatchNormParams, input_specs: List[TensorSpec]) -> List[WeightSpec]:
+        (x,) = input_specs
+        c = (x.shape[1],)
+        return [
+            WeightSpec("scale", TensorSpec(c, params.dtype), "ones"),
+            WeightSpec("bias", TensorSpec(c, params.dtype), "zeros"),
+            WeightSpec("running_mean", TensorSpec(c, params.dtype), "zeros", trainable=False),
+            WeightSpec("running_var", TensorSpec(c, params.dtype), "ones", trainable=False),
+        ]
+
+    @staticmethod
+    def lower(params: BatchNormParams, inputs, weights, ctx: LowerCtx):
+        (x,) = inputs
+        axes = (0, 2, 3) if x.ndim == 4 else (0,)
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        xf = x.astype(jnp.float32)
+        if ctx.training:
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            m = params.momentum
+            ctx.state_updates[(ctx.node_guid, "running_mean")] = (
+                m * weights["running_mean"] + (1 - m) * mean.astype(params.dtype.jnp)
+            )
+            ctx.state_updates[(ctx.node_guid, "running_var")] = (
+                m * weights["running_var"] + (1 - m) * var.astype(params.dtype.jnp)
+            )
+        else:
+            mean = weights["running_mean"].astype(jnp.float32)
+            var = weights["running_var"].astype(jnp.float32)
+        y = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + params.eps)
+        y = y * weights["scale"].reshape(shape) + weights["bias"].reshape(shape)
+        y = y.astype(x.dtype)
+        if params.relu:
+            y = jax.nn.relu(y)
+        return [y]
+
+    @staticmethod
+    def cost(params, input_specs, output_specs):
+        return io_cost(input_specs, output_specs, flops=10.0 * output_specs[0].num_elements)

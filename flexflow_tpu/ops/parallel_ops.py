@@ -1,0 +1,162 @@
+"""Parallel operators — sharding transitions in the PCG.
+
+Reference: src/parallel_ops/{partition,combine,replicate,reduction,
+fused_parallel_op}.cc + their CUDA kernels, which physically copy/reduce
+data between differently-partitioned Legion regions. TPU-native, these
+are *annotations*: each lowers to jax.lax.with_sharding_constraint and
+GSPMD materializes the movement as XLA collectives on ICI —
+  Repartition -> dynamic-slice / all-to-all   (partition.cc)
+  Combine     -> all-gather                   (combine.cc:74)
+  Replicate   -> broadcast                    (replicate.cc)
+  Reduction   -> reduce-scatter / psum        (reduction.cc)
+  AllReduce   -> psum
+  FusedParallelOp -> one combined reshard     (fused_parallel_op.cc)
+Logical shapes are unchanged; what changes is the ParallelTensorSpec
+(dims' degree / mesh_axis), which the strategy layer tracks.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+
+from ..core.tensor import TensorSpec
+from ..core.types import OpType
+from .base import LowerCtx, OpCost, OpDef, register_op
+
+
+def _constrain(x: jax.Array, ctx: LowerCtx, partition_spec) -> jax.Array:
+    """Apply a sharding constraint if we're lowering under a mesh."""
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is None or partition_spec is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = PartitionSpec(*partition_spec)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+class _ParallelOpBase(OpDef):
+    @staticmethod
+    def infer_output_specs(params, input_specs: List[TensorSpec]):
+        return [input_specs[0]]
+
+    @staticmethod
+    def cost(params, input_specs, output_specs) -> OpCost:
+        # communication cost is modeled by the simulator per machine view,
+        # not per-op flops (reference: estimate_xfer_cost simulator.cc:671)
+        return OpCost()
+
+
+@dataclasses.dataclass(frozen=True)
+class RepartitionParams:
+    dim: int  # tensor dim to shard
+    degree: int
+    mesh_axis: Optional[str] = None
+    # full output partition spec (per logical dim, tuple of axis names or None)
+    out_spec: Optional[Tuple] = None
+
+
+@register_op
+class RepartitionOp(_ParallelOpBase):
+    op_type = OpType.REPARTITION
+    params_cls = RepartitionParams
+
+    @staticmethod
+    def lower(params: RepartitionParams, inputs, weights, ctx: LowerCtx):
+        (x,) = inputs
+        spec = params.out_spec
+        if spec is None and params.mesh_axis is not None:
+            spec = tuple(params.mesh_axis if i == params.dim else None for i in range(x.ndim))
+        return [_constrain(x, ctx, spec)]
+
+
+@dataclasses.dataclass(frozen=True)
+class CombineParams:
+    dim: int  # dim being un-sharded (all-gathered)
+    degree: int
+    out_spec: Optional[Tuple] = None
+
+
+@register_op
+class CombineOp(_ParallelOpBase):
+    op_type = OpType.COMBINE
+    params_cls = CombineParams
+
+    @staticmethod
+    def lower(params: CombineParams, inputs, weights, ctx: LowerCtx):
+        (x,) = inputs
+        spec = params.out_spec if params.out_spec is not None else tuple(None for _ in range(x.ndim))
+        return [_constrain(x, ctx, spec)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicateParams:
+    degree: int
+    out_spec: Optional[Tuple] = None
+
+
+@register_op
+class ReplicateOp(_ParallelOpBase):
+    op_type = OpType.REPLICATE
+    params_cls = ReplicateParams
+
+    @staticmethod
+    def lower(params: ReplicateParams, inputs, weights, ctx: LowerCtx):
+        (x,) = inputs
+        return [_constrain(x, ctx, params.out_spec or tuple(None for _ in range(x.ndim)))]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReductionParams:
+    degree: int  # replica-dim partial results being summed
+    out_spec: Optional[Tuple] = None
+
+
+@register_op
+class ReductionOp(_ParallelOpBase):
+    op_type = OpType.REDUCTION
+    params_cls = ReductionParams
+
+    @staticmethod
+    def lower(params: ReductionParams, inputs, weights, ctx: LowerCtx):
+        # Under GSPMD the partial-sum reduction is inserted by XLA where the
+        # producing contraction was sharded; the node pins the output layout.
+        (x,) = inputs
+        return [_constrain(x, ctx, params.out_spec or tuple(None for _ in range(x.ndim)))]
+
+
+@dataclasses.dataclass(frozen=True)
+class AllReduceParams:
+    degree: int
+    out_spec: Optional[Tuple] = None
+
+
+@register_op
+class AllReduceOp(_ParallelOpBase):
+    op_type = OpType.ALLREDUCE
+    params_cls = AllReduceParams
+
+    @staticmethod
+    def lower(params: AllReduceParams, inputs, weights, ctx: LowerCtx):
+        (x,) = inputs
+        return [_constrain(x, ctx, params.out_spec or tuple(None for _ in range(x.ndim)))]
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedParallelParams:
+    # sequence of (kind, dim, degree) transitions fused into one reshard
+    transitions: Tuple = ()
+    out_spec: Optional[Tuple] = None
+
+
+@register_op
+class FusedParallelOp(_ParallelOpBase):
+    op_type = OpType.FUSED_PARALLEL
+    params_cls = FusedParallelParams
+
+    @staticmethod
+    def lower(params: FusedParallelParams, inputs, weights, ctx: LowerCtx):
+        (x,) = inputs
+        return [_constrain(x, ctx, params.out_spec)]

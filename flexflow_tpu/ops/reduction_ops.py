@@ -1,0 +1,95 @@
+"""Reduction-style operators: Gather, ReduceSum, Mean.
+
+Reference: src/ops/gather.cc (424), src/ops/reduce.cc (411, keepdims),
+src/ops/mean.cc (114).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+from ..core.types import OpType
+from .base import OpDef, io_cost, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class GatherParams:
+    axis: int
+
+
+@register_op
+class GatherOp(OpDef):
+    """torch.gather semantics: index tensor same rank as input
+    (reference: gather.cc — index/input shapes match except on `axis`)."""
+
+    op_type = OpType.GATHER
+    params_cls = GatherParams
+
+    @staticmethod
+    def infer_output_specs(params: GatherParams, input_specs: List[TensorSpec]):
+        data, index = input_specs
+        return [TensorSpec(index.shape, data.dtype)]
+
+    @staticmethod
+    def lower(params: GatherParams, inputs, weights, ctx):
+        data, index = inputs
+        return [jnp.take_along_axis(data, index.astype(jnp.int32), axis=params.axis)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReduceSumParams:
+    axes: Tuple[int, ...]
+    keepdims: bool = False
+
+
+@register_op
+class ReduceSumOp(OpDef):
+    op_type = OpType.REDUCE_SUM
+    params_cls = ReduceSumParams
+
+    @staticmethod
+    def infer_output_specs(params: ReduceSumParams, input_specs: List[TensorSpec]):
+        (x,) = input_specs
+        axes = {a % x.ndim for a in params.axes}
+        if params.keepdims:
+            shape = tuple(1 if i in axes else s for i, s in enumerate(x.shape))
+        else:
+            shape = tuple(s for i, s in enumerate(x.shape) if i not in axes)
+        return [TensorSpec(shape, x.dtype)]
+
+    @staticmethod
+    def lower(params: ReduceSumParams, inputs, weights, ctx):
+        return [jnp.sum(inputs[0], axis=params.axes, keepdims=params.keepdims)]
+
+    @staticmethod
+    def cost(params, input_specs, output_specs):
+        return io_cost(input_specs, output_specs, flops=float(input_specs[0].num_elements))
+
+
+@dataclasses.dataclass(frozen=True)
+class MeanParams:
+    axes: Tuple[int, ...]
+    keepdims: bool = False
+
+
+@register_op
+class MeanOp(OpDef):
+    op_type = OpType.MEAN
+    params_cls = MeanParams
+
+    @staticmethod
+    def infer_output_specs(params: MeanParams, input_specs: List[TensorSpec]):
+        return ReduceSumOp.infer_output_specs(
+            ReduceSumParams(params.axes, params.keepdims), input_specs
+        )
+
+    @staticmethod
+    def lower(params: MeanParams, inputs, weights, ctx):
+        return [jnp.mean(inputs[0], axis=params.axes, keepdims=params.keepdims)]
+
+    @staticmethod
+    def cost(params, input_specs, output_specs):
+        return io_cost(input_specs, output_specs, flops=float(input_specs[0].num_elements))

@@ -1,0 +1,182 @@
+"""Shape-manipulation operators: Reshape, Transpose, Reverse, Flat, Concat,
+Split, Cast.
+
+Reference: src/ops/{reshape,transpose,reverse,flat,concat,split,cast}.cc
+with their CUDA copy kernels. TPU-native: all are pure layout/metadata
+ops in XLA (free or fused); costs model the HBM copy the reference pays.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+from ..core.types import DataType, OpType
+from .base import OpDef, io_cost, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshapeParams:
+    shape: Tuple[int, ...]
+
+
+@register_op
+class ReshapeOp(OpDef):
+    op_type = OpType.RESHAPE
+    params_cls = ReshapeParams
+
+    @staticmethod
+    def infer_output_specs(params: ReshapeParams, input_specs: List[TensorSpec]):
+        (x,) = input_specs
+        shape = list(params.shape)
+        if -1 in shape:
+            i = shape.index(-1)
+            rest = math.prod(s for s in shape if s != -1)
+            shape[i] = x.num_elements // rest
+        if math.prod(shape) != x.num_elements:
+            raise ValueError(f"cannot reshape {x.shape} to {params.shape}")
+        return [TensorSpec(tuple(shape), x.dtype)]
+
+    @staticmethod
+    def lower(params, inputs, weights, ctx):
+        out_shape = ReshapeOp.infer_output_specs(params, [TensorSpec(inputs[0].shape, DataType.from_jnp(inputs[0].dtype))])[0].shape
+        return [jnp.reshape(inputs[0], out_shape)]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransposeParams:
+    perm: Tuple[int, ...]
+
+
+@register_op
+class TransposeOp(OpDef):
+    op_type = OpType.TRANSPOSE
+    params_cls = TransposeParams
+
+    @staticmethod
+    def infer_output_specs(params: TransposeParams, input_specs: List[TensorSpec]):
+        (x,) = input_specs
+        return [TensorSpec(tuple(x.shape[p] for p in params.perm), x.dtype)]
+
+    @staticmethod
+    def lower(params, inputs, weights, ctx):
+        return [jnp.transpose(inputs[0], params.perm)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReverseParams:
+    axis: int
+
+
+@register_op
+class ReverseOp(OpDef):
+    op_type = OpType.REVERSE
+    params_cls = ReverseParams
+
+    @staticmethod
+    def infer_output_specs(params, input_specs: List[TensorSpec]):
+        return [input_specs[0]]
+
+    @staticmethod
+    def lower(params, inputs, weights, ctx):
+        return [jnp.flip(inputs[0], params.axis)]
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatParams:
+    pass
+
+
+@register_op
+class FlatOp(OpDef):
+    """Flatten all non-batch dims (reference: src/ops/flat.cc)."""
+
+    op_type = OpType.FLAT
+    params_cls = FlatParams
+
+    @staticmethod
+    def infer_output_specs(params, input_specs: List[TensorSpec]):
+        (x,) = input_specs
+        return [TensorSpec((x.shape[0], math.prod(x.shape[1:])), x.dtype)]
+
+    @staticmethod
+    def lower(params, inputs, weights, ctx):
+        x = inputs[0]
+        return [jnp.reshape(x, (x.shape[0], -1))]
+
+
+@dataclasses.dataclass(frozen=True)
+class ConcatParams:
+    axis: int
+    n_inputs: int
+
+
+@register_op
+class ConcatOp(OpDef):
+    op_type = OpType.CONCAT
+    params_cls = ConcatParams
+
+    @staticmethod
+    def infer_output_specs(params: ConcatParams, input_specs: List[TensorSpec]):
+        ax = params.axis
+        base = list(input_specs[0].shape)
+        base[ax] = sum(s.shape[ax] for s in input_specs)
+        return [TensorSpec(tuple(base), input_specs[0].dtype)]
+
+    @staticmethod
+    def lower(params, inputs, weights, ctx):
+        return [jnp.concatenate(inputs, axis=params.axis)]
+
+
+@dataclasses.dataclass(frozen=True)
+class SplitParams:
+    sizes: Tuple[int, ...]
+    axis: int
+
+
+@register_op
+class SplitOp(OpDef):
+    op_type = OpType.SPLIT
+    params_cls = SplitParams
+
+    @staticmethod
+    def infer_output_specs(params: SplitParams, input_specs: List[TensorSpec]):
+        (x,) = input_specs
+        out = []
+        for sz in params.sizes:
+            shape = list(x.shape)
+            shape[params.axis] = sz
+            out.append(TensorSpec(tuple(shape), x.dtype))
+        return out
+
+    @staticmethod
+    def lower(params, inputs, weights, ctx):
+        splits = []
+        off = 0
+        for sz in params.sizes[:-1]:
+            off += sz
+            splits.append(off)
+        return list(jnp.split(inputs[0], splits, axis=params.axis))
+
+
+@dataclasses.dataclass(frozen=True)
+class CastParams:
+    dtype: DataType
+
+
+@register_op
+class CastOp(OpDef):
+    op_type = OpType.CAST
+    params_cls = CastParams
+
+    @staticmethod
+    def infer_output_specs(params: CastParams, input_specs: List[TensorSpec]):
+        (x,) = input_specs
+        return [TensorSpec(x.shape, params.dtype)]
+
+    @staticmethod
+    def lower(params, inputs, weights, ctx):
+        return [inputs[0].astype(params.dtype.jnp)]

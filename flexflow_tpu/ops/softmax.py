@@ -1,0 +1,71 @@
+"""Softmax and Dropout operators.
+
+Reference: src/ops/softmax.cc (418 LoC, cudnnSoftmaxForward) and
+src/ops/dropout.cc (362 LoC, cudnnDropout with per-op RNG state).
+TPU-native: jax.nn.softmax; dropout uses a per-node folded PRNG key
+(deterministic given the step key — replaces cuDNN dropout descriptors).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+from ..core.types import OpType
+from .base import LowerCtx, OpDef, io_cost, register_op
+
+
+@dataclasses.dataclass(frozen=True)
+class SoftmaxParams:
+    axis: int = -1
+
+
+@register_op
+class SoftmaxOp(OpDef):
+    op_type = OpType.SOFTMAX
+    params_cls = SoftmaxParams
+
+    @staticmethod
+    def infer_output_specs(params, input_specs: List[TensorSpec]):
+        return [input_specs[0]]
+
+    @staticmethod
+    def lower(params: SoftmaxParams, inputs, weights, ctx):
+        return [jax.nn.softmax(inputs[0], axis=params.axis)]
+
+    @staticmethod
+    def cost(params, input_specs, output_specs):
+        return io_cost(input_specs, output_specs, flops=5.0 * output_specs[0].num_elements)
+
+
+@dataclasses.dataclass(frozen=True)
+class DropoutParams:
+    rate: float
+    seed: int = 0
+
+
+@register_op
+class DropoutOp(OpDef):
+    op_type = OpType.DROPOUT
+    params_cls = DropoutParams
+
+    @staticmethod
+    def infer_output_specs(params, input_specs: List[TensorSpec]):
+        return [input_specs[0]]
+
+    @staticmethod
+    def lower(params: DropoutParams, inputs, weights, ctx: LowerCtx):
+        (x,) = inputs
+        if not ctx.training or params.rate <= 0.0:
+            return [x]
+        key = ctx.node_rng()
+        keep = 1.0 - params.rate
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)]
+
+    @staticmethod
+    def cost(params, input_specs, output_specs):
+        return io_cost(input_specs, output_specs, flops=2.0 * output_specs[0].num_elements)

@@ -1,0 +1,29 @@
+"""Shape/spec propagation over the PCG.
+
+Analog of the reference's parallel-dim mapping solve
+(Op::solve_parallel_dim_mappings, model.h:240): walk the graph in
+topological order and infer every node's output TensorSpecs from its
+inputs via the op registry.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..core.graph import PCGraph
+from ..core.tensor import TensorSpec
+from ..ops.base import get_op_def
+
+
+def infer_all_specs(graph: PCGraph) -> Dict[int, List[TensorSpec]]:
+    specs: Dict[int, List[TensorSpec]] = {}
+    for node in graph.topo_order():
+        in_specs: List[TensorSpec] = []
+        for e in graph.in_edges(node):
+            in_specs.append(specs[e.src][e.src_idx])
+        op_def = get_op_def(node.op_type)
+        specs[node.guid] = op_def.infer_output_specs(node.params, in_specs)
+    return specs
+
+
+def node_input_specs(graph: PCGraph, specs: Dict[int, List[TensorSpec]], node) -> List[TensorSpec]:
+    return [specs[e.src][e.src_idx] for e in graph.in_edges(node)]

@@ -1,0 +1,147 @@
+"""Parallelization strategy: per-op sharding assignments.
+
+Reference: the output of Unity's search is a map op -> MachineView
+(graph.cc optimal_views); executing it means inserting parallel ops and
+letting the mapper fan tasks out. TPU-native, a strategy is a map
+node guid -> OpSharding (PartitionSpecs for the op's outputs and
+weights over named mesh axes) plus the mesh axis sizes; execution is
+jit with in_shardings/out_shardings + with_sharding_constraint, and
+GSPMD inserts the collectives the reference's parallel ops performed.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ..core.graph import PCGraph
+from ..core.types import OpType
+from .mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS
+
+# A partition spec as pure data: one entry per tensor dim; each entry is a
+# tuple of mesh axis names (usually 0- or 1-long).
+SpecTuple = Tuple[Tuple[str, ...], ...]
+
+
+def pspec(*axes) -> SpecTuple:
+    """Helper: pspec('data', None, 'model') -> ((('data',), (), ('model',)))."""
+    out = []
+    for a in axes:
+        if a is None:
+            out.append(())
+        elif isinstance(a, str):
+            out.append((a,))
+        else:
+            out.append(tuple(a))
+    return tuple(out)
+
+
+def to_partition_spec(spec: Optional[SpecTuple]):
+    from jax.sharding import PartitionSpec
+
+    if spec is None:
+        return PartitionSpec()
+    args = []
+    for entry in spec:
+        if not entry:
+            args.append(None)
+        elif len(entry) == 1:
+            args.append(entry[0])
+        else:
+            args.append(tuple(entry))
+    return PartitionSpec(*args)
+
+
+@dataclasses.dataclass
+class OpSharding:
+    """Shardings for one PCG node."""
+
+    outputs: List[Optional[SpecTuple]] = dataclasses.field(default_factory=list)
+    weights: Dict[str, Optional[SpecTuple]] = dataclasses.field(default_factory=dict)
+    machine_view_hash: int = 0  # provenance from the search, for export
+
+
+@dataclasses.dataclass
+class ParallelStrategy:
+    """Full strategy: mesh shape + per-node shardings.
+
+    Serializable for parity with the reference's --export-strategy /
+    --import-strategy (config.h:141-142).
+    """
+
+    axis_sizes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    node_shardings: Dict[int, OpSharding] = dataclasses.field(default_factory=dict)
+
+    def output_spec(self, guid: int, idx: int = 0) -> Optional[SpecTuple]:
+        s = self.node_shardings.get(guid)
+        if s is None or idx >= len(s.outputs):
+            return None
+        return s.outputs[idx]
+
+    def weight_spec(self, guid: int, name: str) -> Optional[SpecTuple]:
+        s = self.node_shardings.get(guid)
+        if s is None:
+            return None
+        return s.weights.get(name)
+
+    # ------------------------------------------------------------- serde
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "axis_sizes": self.axis_sizes,
+                "nodes": {
+                    str(g): {
+                        "outputs": [list(map(list, o)) if o is not None else None for o in s.outputs],
+                        "weights": {k: (list(map(list, v)) if v is not None else None) for k, v in s.weights.items()},
+                        "machine_view_hash": s.machine_view_hash,
+                    }
+                    for g, s in self.node_shardings.items()
+                },
+            },
+            indent=1,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "ParallelStrategy":
+        d = json.loads(text)
+        st = cls(axis_sizes=dict(d["axis_sizes"]))
+        for g, s in d["nodes"].items():
+            st.node_shardings[int(g)] = OpSharding(
+                outputs=[tuple(tuple(e) for e in o) if o is not None else None for o in s["outputs"]],
+                weights={
+                    k: (tuple(tuple(e) for e in v) if v is not None else None)
+                    for k, v in s["weights"].items()
+                },
+                machine_view_hash=s.get("machine_view_hash", 0),
+            )
+        return st
+
+
+def data_parallel_strategy(graph: PCGraph, num_devices: int, batch_dim: int = 0) -> ParallelStrategy:
+    """The reference's --only-data-parallel path (graph.cc:1939-1964):
+    shard every activation's batch dim on the "data" axis, replicate all
+    weights; gradient psum over "data" is inserted by XLA."""
+    st = ParallelStrategy(axis_sizes={DATA_AXIS: num_devices})
+    from ..ops.base import get_op_def
+    from .propagation import infer_all_specs
+
+    specs = infer_all_specs(graph)
+    for node in graph.topo_order():
+        out_specs = specs[node.guid]
+        shardings = []
+        for os in out_specs:
+            if os.ndim > batch_dim and os.shape[batch_dim] % num_devices == 0 and node.op_type != OpType.WEIGHT:
+                shardings.append(pspec(*([DATA_AXIS] + [None] * (os.ndim - 1))))
+            else:
+                shardings.append(None)
+        in_edges = graph.in_edges(node)
+        in_specs = []
+        for e in in_edges:
+            in_specs.append(specs[e.src][e.src_idx])
+        op_def = get_op_def(node.op_type)
+        wspecs = op_def.weight_specs(node.params, in_specs)
+        st.node_shardings[node.guid] = OpSharding(
+            outputs=shardings,
+            weights={w.name: None for w in wspecs},  # None -> replicated
+        )
+    return st

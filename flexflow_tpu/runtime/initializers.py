@@ -1,0 +1,76 @@
+"""Weight initializers.
+
+Reference: include/flexflow/initializer.h:33-110 (Glorot/Zero/Uniform/
+Norm/Constant run as Legion GPU tasks, initializer_kernel.cu). TPU-native:
+pure functions of a PRNG key — initialization happens device-side under
+jit when the param pytree is first materialized.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+
+
+def glorot_uniform(key: jax.Array, spec: TensorSpec) -> jax.Array:
+    shape = spec.shape
+    if len(shape) >= 2:
+        fan_in = math.prod(shape[:-1])
+        fan_out = shape[-1]
+    else:
+        fan_in = fan_out = max(1, shape[0] if shape else 1)
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, spec.dtype.jnp, -limit, limit)
+
+
+def zeros(key, spec: TensorSpec) -> jax.Array:
+    return jnp.zeros(spec.shape, spec.dtype.jnp)
+
+
+def ones(key, spec: TensorSpec) -> jax.Array:
+    return jnp.ones(spec.shape, spec.dtype.jnp)
+
+
+def make_uniform(minv: float, maxv: float):
+    def init(key, spec: TensorSpec):
+        return jax.random.uniform(key, spec.shape, spec.dtype.jnp, minv, maxv)
+
+    return init
+
+
+def make_normal(mean: float = 0.0, stddev: float = 1.0):
+    def init(key, spec: TensorSpec):
+        return mean + stddev * jax.random.normal(key, spec.shape, spec.dtype.jnp)
+
+    return init
+
+
+def make_constant(value: float):
+    def init(key, spec: TensorSpec):
+        return jnp.full(spec.shape, value, spec.dtype.jnp)
+
+    return init
+
+
+_REGISTRY: Dict[str, Callable] = {
+    "glorot_uniform": glorot_uniform,
+    "zeros": zeros,
+    "ones": ones,
+    "normal": make_normal(),
+    "uniform": make_uniform(-0.05, 0.05),
+}
+
+
+def get_initializer(name: str) -> Callable:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown initializer {name!r}")
+    return _REGISTRY[name]
+
+
+def register_initializer(name: str, fn: Callable):
+    _REGISTRY[name] = fn

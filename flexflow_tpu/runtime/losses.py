@@ -1,0 +1,54 @@
+"""Loss functions.
+
+Reference: include/flexflow/loss_functions.h:27, src/loss_functions/
+loss_functions.cc:41 (+ loss_functions.cu). The reference's Loss seeds
+output gradients manually with a 1/batch scale factor; here losses are
+scalar-valued and autodiff produces those gradients — the scale factor
+matches (mean over batch).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..core.types import LossType
+
+
+def categorical_crossentropy(logits_or_probs: jax.Array, labels: jax.Array) -> jax.Array:
+    """Labels are one-hot/probability distributions [B, C]. Input is the
+    softmax output (parity: the reference pairs this with a softmax op)."""
+    p = jnp.clip(logits_or_probs.astype(jnp.float32), 1e-8, 1.0)
+    return -jnp.mean(jnp.sum(labels.astype(jnp.float32) * jnp.log(p), axis=-1))
+
+
+def sparse_categorical_crossentropy(probs: jax.Array, labels: jax.Array) -> jax.Array:
+    """Labels are int class ids [B] (or [B, 1]); input is softmax output."""
+    if labels.ndim == probs.ndim:
+        labels = labels[..., 0]
+    p = jnp.clip(probs.astype(jnp.float32), 1e-8, 1.0)
+    ll = jnp.take_along_axis(jnp.log(p), labels.astype(jnp.int32)[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def mean_squared_error(preds: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.square(preds.astype(jnp.float32) - labels.astype(jnp.float32)))
+
+
+def identity_loss(preds: jax.Array, labels: jax.Array) -> jax.Array:
+    """Reference: LOSS_IDENTITY — the model's output *is* the loss."""
+    return jnp.mean(preds.astype(jnp.float32))
+
+
+def get_loss_fn(loss_type: LossType) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    return {
+        LossType.CATEGORICAL_CROSSENTROPY: categorical_crossentropy,
+        LossType.SPARSE_CATEGORICAL_CROSSENTROPY: sparse_categorical_crossentropy,
+        LossType.MEAN_SQUARED_ERROR: mean_squared_error,
+        LossType.MEAN_SQUARED_ERROR_AVG_REDUCE: mean_squared_error,
+        LossType.MEAN_SQUARED_ERROR_SUM_REDUCE: lambda p, l: jnp.sum(
+            jnp.square(p.astype(jnp.float32) - l.astype(jnp.float32))
+        ),
+        LossType.IDENTITY: identity_loss,
+    }[loss_type]

@@ -1,0 +1,72 @@
+"""Metrics.
+
+Reference: include/flexflow/metrics_functions.h:27-44,
+src/metrics_functions/metrics_functions.cc:68 — per-part compute task +
+future-chained reduction (model.cc:3806-3829). TPU-native: metrics are
+computed inside the jitted step (XLA reduces across the mesh); the host
+accumulates scalars across batches, replacing Legion future chaining.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.types import MetricsType
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Accumulated training metrics (reference: PerfMetrics struct)."""
+
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+
+    def update(self, other: Dict[str, float]):
+        self.train_all += int(other.get("count", 0))
+        self.train_correct += int(other.get("correct", 0))
+        for k in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss", "mae_loss"):
+            if k in other:
+                setattr(self, k, getattr(self, k) + float(other[k]))
+
+    @property
+    def accuracy(self) -> float:
+        return self.train_correct / max(1, self.train_all)
+
+
+def compute_metrics(
+    metrics: Sequence[MetricsType], preds: jax.Array, labels: jax.Array
+) -> Dict[str, jax.Array]:
+    """Batch metric computation, run inside the jitted step."""
+    out: Dict[str, jax.Array] = {"count": jnp.asarray(preds.shape[0], jnp.int32)}
+    pf = preds.astype(jnp.float32)
+    for m in metrics:
+        if m == MetricsType.ACCURACY:
+            if labels.ndim == preds.ndim and labels.shape[-1] == preds.shape[-1]:
+                correct = jnp.argmax(pf, -1) == jnp.argmax(labels, -1)
+            else:
+                lab = labels[..., 0] if labels.ndim == preds.ndim else labels
+                correct = jnp.argmax(pf, -1) == lab.astype(jnp.int32)
+            out["correct"] = jnp.sum(correct.astype(jnp.int32))
+        elif m == MetricsType.CATEGORICAL_CROSSENTROPY:
+            p = jnp.clip(pf, 1e-8, 1.0)
+            out["cce_loss"] = -jnp.sum(labels.astype(jnp.float32) * jnp.log(p))
+        elif m == MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            lab = labels[..., 0] if labels.ndim == preds.ndim else labels
+            p = jnp.clip(pf, 1e-8, 1.0)
+            ll = jnp.take_along_axis(jnp.log(p), lab.astype(jnp.int32)[..., None], -1)
+            out["sparse_cce_loss"] = -jnp.sum(ll)
+        elif m == MetricsType.MEAN_SQUARED_ERROR:
+            out["mse_loss"] = jnp.sum(jnp.square(pf - labels.astype(jnp.float32)))
+        elif m == MetricsType.ROOT_MEAN_SQUARED_ERROR:
+            out["rmse_loss"] = jnp.sqrt(jnp.mean(jnp.square(pf - labels.astype(jnp.float32)))) * preds.shape[0]
+        elif m == MetricsType.MEAN_ABSOLUTE_ERROR:
+            out["mae_loss"] = jnp.sum(jnp.abs(pf - labels.astype(jnp.float32)))
+    return out

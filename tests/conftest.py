@@ -1,0 +1,22 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware isn't available in CI; sharding correctness is
+validated on XLA's host platform with 8 virtual devices (the reference
+likewise fakes multi-node with multi-process on one box,
+tests/multinode_helpers/mpi_wrapper1.sh — here XLA gives us real SPMD
+partitioning without processes).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The hosted-TPU sitecustomize force-selects its platform via
+# jax.config.update("jax_platforms", ...); override it back to CPU before
+# any backend initializes so tests get the 8-device virtual mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,100 @@
+"""Core IR tests: tensors, graph algorithms, hashing.
+
+Mirrors the reference's pure-logic unit tests (tests/unit/
+test_dominators.cc, test_machine_view.cc) — search/graph logic testable
+without devices.
+"""
+import pytest
+
+from flexflow_tpu.core.graph import PCGraph
+from flexflow_tpu.core.tensor import ParallelDim, ParallelTensorSpec, TensorSpec
+from flexflow_tpu.core.types import ActiMode, DataType, OpType
+from flexflow_tpu.ops.io_ops import InputParams
+from flexflow_tpu.ops.linear import LinearParams
+from flexflow_tpu.parallel.machine import MachineSpec, MachineView, enumerate_machine_views
+from flexflow_tpu.parallel.propagation import infer_all_specs
+
+
+def build_mlp_graph(depth=3, width=64):
+    g = PCGraph()
+    inp = g.new_node(OpType.INPUT, InputParams((8, 32), DataType.FLOAT))
+    prev = inp
+    for i in range(depth):
+        n = g.new_node(OpType.LINEAR, LinearParams(width, activation=ActiMode.RELU))
+        g.add_edge(prev, n)
+        prev = n
+    return g, inp, prev
+
+
+def test_tensor_spec():
+    t = TensorSpec((4, 8), DataType.FLOAT)
+    assert t.num_elements == 32
+    assert t.size_bytes == 128
+
+
+def test_parallel_dim_validation():
+    with pytest.raises(ValueError):
+        ParallelDim(10, 3)
+    d = ParallelDim(8, 2, "data")
+    assert d.size // d.degree == 4
+
+
+def test_parallel_tensor_spec():
+    pt = ParallelTensorSpec(
+        (ParallelDim(8, 2, "data"), ParallelDim(16), ParallelDim(4, 4, "model", is_replica=True)),
+    )
+    assert pt.logical_shape == (8, 16)
+    assert pt.local_shape == (4, 16)
+    assert pt.total_degree == 8
+    assert pt.replica_degree == 4
+    assert pt.get_sharding_tuple() == (("data",), ())
+
+
+def test_topo_order_and_specs():
+    g, inp, out = build_mlp_graph()
+    order = g.topo_order()
+    assert order[0].guid == inp.guid
+    assert order[-1].guid == out.guid
+    specs = infer_all_specs(g)
+    assert specs[out.guid][0].shape == (8, 64)
+
+
+def test_structural_hash_guid_independent():
+    g1, _, _ = build_mlp_graph()
+    g2, _, _ = build_mlp_graph()
+    assert g1.structural_hash() == g2.structural_hash()
+    g3, _, _ = build_mlp_graph(depth=4)
+    assert g1.structural_hash() != g3.structural_hash()
+
+
+def test_split_at_bottleneck():
+    g, inp, out = build_mlp_graph(depth=3)
+    bns = g.bottleneck_nodes()
+    assert len(bns) == 4  # every node in a chain is a bottleneck
+    mid = bns[2]
+    first, second = g.split_at_node(mid)
+    assert mid.guid in first.nodes and mid.guid in second.nodes
+    assert len(first) + len(second) == len(g) + 1
+
+
+def test_machine_view():
+    v = MachineView(4, (2, 2), (2, 1))
+    assert v.num_parts == 4
+    assert v.device_ids() == [4, 5, 6, 7]
+
+
+def test_enumerate_views():
+    m = MachineSpec(num_nodes=1, devices_per_node=8)
+    views = enumerate_machine_views(m)
+    sizes = {v.num_parts for v in views}
+    assert {1, 2, 4, 8} <= sizes
+    full = [v for v in views if v.num_parts == 8 and len(v.dims) == 1]
+    assert full[0].device_ids() == list(range(8))
+
+
+def test_graph_serde_roundtrip():
+    g, _, _ = build_mlp_graph()
+    js = g.to_json()
+    assert "linear" in js
+    dot = g.to_dot()
+    assert "digraph" in dot
