@@ -1,0 +1,83 @@
+"""Benchmark driver: BERT training throughput on the available TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no absolute numbers (BASELINE.md) — its story is
+searched-strategy vs data-parallel on identical hardware. Single-chip,
+we report training throughput and MFU; vs_baseline is MFU relative to
+the 45%-MFU north star from BASELINE.json.
+"""
+from __future__ import annotations
+
+import json
+import math
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu import DataType, FFConfig, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+
+    backend = jax.default_backend()
+    n_dev = len(jax.devices())
+    # BERT-Base-shaped encoder, bf16 activations, sized for one v5e chip
+    cfg = TransformerConfig(
+        num_layers=12,
+        hidden_size=768,
+        num_heads=12,
+        ff_size=3072,
+        seq_length=128,
+        dtype=DataType.BFLOAT16,
+    )
+    batch = 16 * n_dev
+    config = FFConfig(batch_size=batch)
+    model = build_transformer(config, cfg)
+    model.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=LossType.MEAN_SQUARED_ERROR)
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(batch, cfg.seq_length, cfg.hidden_size), cfg.dtype.jnp)
+    y = jnp.asarray(rs.randn(batch, cfg.seq_length, cfg.hidden_size), cfg.dtype.jnp)
+    rng = jax.random.key(0)
+
+    # warmup (compile)
+    model.executor.train_batch([x], y, rng)
+    jax.block_until_ready(jax.tree.leaves(model.executor.params)[0])
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        model.executor.train_batch([x], y, rng)
+    jax.block_until_ready(jax.tree.leaves(model.executor.params)[0])
+    dt = time.perf_counter() - t0
+
+    samples_per_s = iters * batch / dt
+    # parameter count (trainable)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(model.executor.params))
+    tokens_per_s = samples_per_s * cfg.seq_length
+    train_flops_per_token = 6.0 * n_params
+    achieved_flops = tokens_per_s * train_flops_per_token
+    peak = 394e12 * n_dev if backend != "cpu" else 1e12  # v5e bf16 peak per chip
+    mfu = achieved_flops / peak
+    result = {
+        "metric": "bert_base_seq128_train_throughput",
+        "value": round(samples_per_s, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "backend": backend,
+            "devices": n_dev,
+            "batch": batch,
+            "params": n_params,
+            "step_ms": round(1000 * dt / iters, 2),
+            "mfu": round(mfu, 4),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

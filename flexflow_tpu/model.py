@@ -431,6 +431,7 @@ class FFModel:
         metrics: Sequence[MetricsType] = (),
         comp_mode: CompMode = CompMode.TRAINING,
         outputs: Optional[Sequence[Tensor]] = None,
+        strategy=None,
     ):
         """Search for a parallelization strategy and build the compiled
         executable (reference: FFModel::compile, model.cc:2811 — search
@@ -443,7 +444,9 @@ class FFModel:
         from .parallel.mesh import build_mesh
         from .parallel.strategy import data_parallel_strategy
 
-        if self.config.import_strategy_file:
+        if strategy is not None:
+            self.strategy = strategy
+        elif self.config.import_strategy_file:
             from .parallel.strategy import ParallelStrategy
 
             with open(self.config.import_strategy_file) as f:

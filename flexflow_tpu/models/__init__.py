@@ -1,0 +1,26 @@
+"""Model zoo matching the reference's examples/cpp applications."""
+from .moe import build_moe_mlp
+from .recommender import build_candle_uno, build_dlrm, build_mlp_unify, build_xdl
+from .transformer import (
+    BERT_BASE,
+    BERT_LARGE,
+    TransformerConfig,
+    build_transformer,
+)
+from .vision import build_alexnet, build_inception_v3, build_resnet50, build_resnext50
+
+__all__ = [
+    "BERT_BASE",
+    "BERT_LARGE",
+    "TransformerConfig",
+    "build_transformer",
+    "build_alexnet",
+    "build_resnet50",
+    "build_resnext50",
+    "build_inception_v3",
+    "build_dlrm",
+    "build_xdl",
+    "build_candle_uno",
+    "build_mlp_unify",
+    "build_moe_mlp",
+]

@@ -1,0 +1,32 @@
+"""Mixture-of-Experts example model.
+
+Reference: examples/cpp/mixture_of_experts/moe.cc (MNIST-style 784-dim
+input, moe composite layer with cache + recompile hooks at moe.cc:180,204).
+"""
+from __future__ import annotations
+
+from ..config import FFConfig
+from ..core.types import ActiMode
+from ..model import FFModel
+
+
+def build_moe_mlp(
+    config: FFConfig,
+    in_dim: int = 784,
+    num_classes: int = 10,
+    num_experts: int = 8,
+    num_select: int = 2,
+    expert_hidden: int = 64,
+    alpha: float = 2.0,
+    lambda_bal: float = 0.04,
+    use_cache: bool = False,
+) -> FFModel:
+    model = FFModel(config)
+    x = model.create_tensor((config.batch_size, in_dim), name="input")
+    t = x
+    if use_cache:
+        t = model.cache(t, num_batches=4, name="cache")
+    t = model.moe(t, num_experts, num_select, expert_hidden, alpha, lambda_bal, name="moe")
+    t = model.dense(t, num_classes, name="head")
+    model.softmax(t, name="softmax")
+    return model

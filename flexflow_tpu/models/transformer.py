@@ -1,0 +1,91 @@
+"""Transformer / BERT model family.
+
+Reference: examples/cpp/Transformer/transformer.cc:112 (BERT-style
+encoder stack: per layer, multi-head attention + two dense layers) and
+the BERT-Large OSDI'22 AE config (scripts/osdi22ae/bert.sh). This is the
+framework's flagship benchmark model. TPU-first additions over the
+reference: pre-LN residual blocks, bf16 activations, causal/masked
+attention, and token-embedding front-end — the reference feeds raw
+[batch, seq, hidden] floats.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..config import FFConfig
+from ..core.types import ActiMode, DataType
+from ..model import FFModel, Tensor
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    num_layers: int = 12
+    hidden_size: int = 768
+    num_heads: int = 12
+    ff_size: int = 3072
+    seq_length: int = 512
+    vocab_size: int = 0  # 0 -> raw float inputs like the reference example
+    num_classes: int = 0  # 0 -> LM head over vocab (or identity if no vocab)
+    dropout: float = 0.0
+    causal: bool = False
+    dtype: DataType = DataType.FLOAT
+
+
+# BERT-Large (scripts/osdi22ae/bert.sh target config)
+BERT_LARGE = TransformerConfig(num_layers=24, hidden_size=1024, num_heads=16, ff_size=4096)
+BERT_BASE = TransformerConfig(num_layers=12, hidden_size=768, num_heads=12, ff_size=3072)
+
+
+def attention_encoder_layer(
+    model: FFModel, t: Tensor, cfg: TransformerConfig, idx: int
+) -> Tensor:
+    """One encoder block (reference: create_attention_encoder,
+    transformer.cc — attention + 2 dense; here with pre-LN residuals)."""
+    h = model.layer_norm(t, name=f"l{idx}_ln1")
+    attn = model.multihead_attention(
+        h,
+        h,
+        h,
+        cfg.hidden_size,
+        cfg.num_heads,
+        dropout=cfg.dropout,
+        causal=cfg.causal,
+        name=f"l{idx}_attn",
+    )
+    t = model.add(t, attn, name=f"l{idx}_res1")
+    h = model.layer_norm(t, name=f"l{idx}_ln2")
+    h = model.dense(h, cfg.ff_size, ActiMode.GELU, name=f"l{idx}_ff1")
+    if cfg.dropout > 0:
+        h = model.dropout(h, cfg.dropout, name=f"l{idx}_drop")
+    h = model.dense(h, cfg.hidden_size, name=f"l{idx}_ff2")
+    return model.add(t, h, name=f"l{idx}_res2")
+
+
+def build_transformer(
+    config: FFConfig, cfg: TransformerConfig = BERT_BASE
+) -> FFModel:
+    """Build the full model: inputs -> encoder stack -> head + softmax."""
+    model = FFModel(config)
+    b, s, e = config.batch_size, cfg.seq_length, cfg.hidden_size
+    if cfg.vocab_size > 0:
+        tokens = model.create_tensor((b, s), DataType.INT32, name="tokens")
+        t = model.embedding(tokens, cfg.vocab_size, e, datatype=cfg.dtype, name="tok_embed")
+    else:
+        t = model.create_tensor((b, s, e), cfg.dtype, name="embeddings")
+    for i in range(cfg.num_layers):
+        t = attention_encoder_layer(model, t, cfg, i)
+    t = model.layer_norm(t, name="final_ln")
+    if cfg.num_classes > 0:
+        # classification head over the first position, BERT-CLS style
+        t = model.split(t, [1, cfg.seq_length - 1], axis=1, name="cls_split")[0]
+        t = model.reshape(t, (b, e), name="cls_squeeze")
+        t = model.dense(t, cfg.num_classes, name="cls_head")
+        t = model.softmax(t)
+    elif cfg.vocab_size > 0:
+        t = model.dense(t, cfg.vocab_size, name="lm_head")
+        t = model.softmax(t)
+    else:
+        # parity with the reference example: final dense back to hidden
+        t = model.dense(t, e, name="out_proj")
+    return model

@@ -117,6 +117,82 @@ class ParallelStrategy:
         return st
 
 
+def megatron_strategy(
+    graph: PCGraph,
+    dp: int,
+    tp: int,
+    sp: bool = False,
+    batch_dim: int = 0,
+) -> ParallelStrategy:
+    """Hybrid data + tensor (+ sequence) parallel strategy for
+    transformer-shaped graphs — the TPU-native form of the reference's
+    --enable-parameter-parallel xfers (replicate-linear-combine /
+    partition-linear-combine, substitution.cc:71-77): column-shard the
+    up-projection, row-shard the down-projection, shard attention heads,
+    and (new capability) shard the sequence dim of pre/post-block
+    activations on the "seq"/data axis between attention regions.
+
+    Weight-name heuristics follow models/transformer.py naming; generic
+    graphs degrade gracefully to DP (unmatched weights replicated).
+    """
+    st = ParallelStrategy(axis_sizes={DATA_AXIS: dp, MODEL_AXIS: tp})
+    from ..ops.base import get_op_def
+    from .propagation import infer_all_specs
+
+    specs = infer_all_specs(graph)
+    for node in graph.topo_order():
+        out_specs = specs[node.guid]
+        in_specs = [specs[e.src][e.src_idx] for e in graph.in_edges(node)]
+        op_def = get_op_def(node.op_type)
+        wspecs = op_def.weight_specs(node.params, in_specs)
+        by_name = {w.name: w for w in wspecs}
+        weights: Dict[str, Optional[SpecTuple]] = {w.name: None for w in wspecs}
+
+        def shard_weight(wname: str, dim: int):
+            """Shard weight `wname` dim `dim` on the model axis if it exists
+            and divides evenly; otherwise leave replicated (graceful
+            degradation for odd vocab sizes / head counts)."""
+            w = by_name.get(wname)
+            if w is None or w.spec.shape[dim] % tp != 0:
+                return
+            weights[wname] = pspec(*[MODEL_AXIS if i == dim else None for i in range(w.spec.ndim)])
+
+        name = node.name or ""
+        if node.op_type == OpType.LINEAR and wspecs:
+            if "ff1" in name or "lm_head" in name or name.endswith("_gate"):
+                shard_weight("kernel", 1)  # column parallel
+                shard_weight("bias", 0)
+            elif "ff2" in name or "out_proj" in name:
+                shard_weight("kernel", 0)  # row parallel
+        elif node.op_type == OpType.MULTIHEAD_ATTENTION:
+            # shard heads: wq/wk/wv [E,H,D] on H; wo [H,D,E] on H
+            for wn in ("wq", "wk", "wv", "bq", "bk", "bv"):
+                shard_weight(wn, 1 if wn[0] == "w" else 0)
+            shard_weight("wo", 0)
+        elif node.op_type == OpType.EMBEDDING:
+            shard_weight("embedding", 0)
+        shardings: List[Optional[SpecTuple]] = []
+        for i, os in enumerate(out_specs):
+            spec = None
+            if node.op_type != OpType.WEIGHT and os.ndim > batch_dim and os.shape[batch_dim] % dp == 0:
+                axes: List[Optional[str]] = [None] * os.ndim
+                axes[batch_dim] = DATA_AXIS
+                # sequence parallelism: shard seq dim of 3-D activations on
+                # the model axis outside the attention/ff regions
+                if (
+                    sp
+                    and batch_dim == 0
+                    and os.ndim == 3
+                    and node.op_type in (OpType.LAYERNORM, OpType.EW_ADD)
+                    and os.shape[1] % tp == 0
+                ):
+                    axes[1] = MODEL_AXIS
+                spec = pspec(*axes)
+            shardings.append(spec)
+        st.node_shardings[node.guid] = OpSharding(outputs=shardings, weights=weights)
+    return st
+
+
 def data_parallel_strategy(graph: PCGraph, num_devices: int, batch_dim: int = 0) -> ParallelStrategy:
     """The reference's --only-data-parallel path (graph.cc:1939-1964):
     shard every activation's batch dim on the "data" axis, replicate all

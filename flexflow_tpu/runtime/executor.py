@@ -64,9 +64,14 @@ class CompiledExecutor:
     def initialize(self, rng: jax.Array):
         """Materialize params/state (reference: FFModel::init_operators +
         initializer tasks) and build the jitted step functions."""
+        import zlib
+
         specs = infer_all_specs(self.graph)
         params: Dict[str, Dict[str, jax.Array]] = {}
         state: Dict[str, Dict[str, jax.Array]] = {}
+        # deterministic init independent of process-global guids and
+        # PYTHONHASHSEED: key on canonical topo index + crc32(weight name)
+        canon = {n.guid: i for i, n in enumerate(self.graph.topo_order())}
         for node in self.graph.topo_order():
             op_def = get_op_def(node.op_type)
             in_specs = [specs[e.src][e.src_idx] for e in self.graph.in_edges(node)]
@@ -75,7 +80,7 @@ class CompiledExecutor:
                 continue
             nkey = _node_key(node)
             for w in wspecs:
-                key = jax.random.fold_in(jax.random.fold_in(rng, node.guid), hash(w.name) % (2**31))
+                key = jax.random.fold_in(jax.random.fold_in(rng, canon[node.guid]), zlib.crc32(w.name.encode()))
                 init = initializers.get_initializer(w.initializer)
                 arr = init(key, w.spec)
                 arr = self._place_weight(node.guid, w.name, arr)

@@ -1,0 +1,114 @@
+"""Parallel strategy tests: TP/SP hybrid sharding on the 8-device mesh.
+
+Validates the TPU-native form of the reference's parameter-parallel
+xfers (substitution.cc:71-77): sharded weights + GSPMD collectives give
+the same numbers as the replicated run.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+from flexflow_tpu.models import TransformerConfig, build_transformer
+from flexflow_tpu.parallel.strategy import (
+    ParallelStrategy,
+    data_parallel_strategy,
+    megatron_strategy,
+    pspec,
+)
+
+
+def _build(seed=0):
+    cfg = TransformerConfig(num_layers=2, hidden_size=64, num_heads=4, ff_size=128, seq_length=16)
+    config = FFConfig(batch_size=8)
+    return build_transformer(config, cfg), cfg, config
+
+
+def _train_losses(model, strategy, steps=3):
+    model.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=LossType.MEAN_SQUARED_ERROR, strategy=strategy)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(8, 16, 64), jnp.float32)
+    y = jnp.asarray(rs.randn(8, 16, 64), jnp.float32)
+    losses = []
+    for i in range(steps):
+        mets = model.executor.train_batch([x], y, jax.random.key(42))
+        losses.append(float(mets["loss"]))
+    return losses
+
+
+def test_megatron_matches_dp():
+    # init is deterministic in graph structure (canonical topo index, not
+    # guids), so three identically-built models start from identical
+    # params and this is a true TP/SP-vs-DP numerical parity test
+    m1, _, _ = _build()
+    dp_losses = _train_losses(m1, data_parallel_strategy(m1.graph, 8))
+    m2, _, _ = _build()
+    tp_losses = _train_losses(m2, megatron_strategy(m2.graph, dp=2, tp=4, sp=False))
+    m3, _, _ = _build()
+    sp_losses = _train_losses(m3, megatron_strategy(m3.graph, dp=2, tp=4, sp=True))
+    np.testing.assert_allclose(dp_losses, tp_losses, rtol=1e-3)
+    np.testing.assert_allclose(dp_losses, sp_losses, rtol=1e-3)
+    # losses decrease
+    assert dp_losses[-1] < dp_losses[0]
+
+
+def test_init_deterministic_across_builds():
+    import jax as _jax
+
+    m1, _, _ = _build()
+    m2, _, _ = _build()
+    st1 = data_parallel_strategy(m1.graph, 8)
+    st2 = data_parallel_strategy(m2.graph, 8)
+    m1.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=LossType.MEAN_SQUARED_ERROR, strategy=st1)
+    m2.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=LossType.MEAN_SQUARED_ERROR, strategy=st2)
+    l1 = _jax.tree.leaves(m1.executor.params)
+    l2 = _jax.tree.leaves(m2.executor.params)
+    for a, b in zip(l1, l2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_megatron_graceful_on_indivisible():
+    from flexflow_tpu.models import TransformerConfig as TC, build_transformer as bt
+
+    cfg = TC(num_layers=1, hidden_size=32, num_heads=2, ff_size=64, seq_length=8, vocab_size=102)
+    model = bt(FFConfig(batch_size=8), cfg)
+    # vocab 102 % tp 4 != 0 -> embedding/lm_head stay replicated, no crash
+    st = megatron_strategy(model.graph, dp=2, tp=4)
+    model.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY, strategy=st)
+    rs = np.random.RandomState(0)
+    mets = model.executor.train_batch(
+        [jnp.asarray(rs.randint(0, 102, (8, 8)), jnp.int32)],
+        jnp.asarray(rs.randint(0, 102, (8, 8)), jnp.int32),
+        jax.random.key(0),
+    )
+    assert np.isfinite(float(mets["loss"]))
+
+
+def test_megatron_weight_shardings_applied():
+    model, _, _ = _build()
+    strategy = megatron_strategy(model.graph, dp=2, tp=4)
+    model.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=LossType.MEAN_SQUARED_ERROR, strategy=strategy)
+    params = model.executor.params
+    # find an ff1 kernel: sharded on model axis -> each device holds 1/4
+    for nkey, ws in params.items():
+        if "kernel" in ws and ws["kernel"].shape == (64, 128):
+            shard_shape = ws["kernel"].sharding.shard_shape(ws["kernel"].shape)
+            if shard_shape == (64, 32):
+                break
+    else:
+        raise AssertionError("no model-sharded ff1 kernel found")
+
+
+def test_strategy_serde_roundtrip():
+    model, _, _ = _build()
+    st = megatron_strategy(model.graph, dp=2, tp=4, sp=True)
+    js = st.to_json()
+    st2 = ParallelStrategy.from_json(js)
+    assert st2.axis_sizes == st.axis_sizes
+    g = next(iter(st.node_shardings))
+    assert st2.node_shardings[g].outputs == st.node_shardings[g].outputs
+    assert st2.node_shardings[g].weights == st.node_shardings[g].weights
+
+
+def test_pspec_helper():
+    assert pspec("data", None, "model") == (("data",), (), ("model",))
