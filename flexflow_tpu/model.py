@@ -454,14 +454,14 @@ class FFModel:
         elif self.config.only_data_parallel or self.config.search_budget <= 0:
             self.strategy = data_parallel_strategy(self.graph, num_devices)
         else:
-            try:
-                from .search.unity import unity_optimize
-            except ImportError as e:
-                raise NotImplementedError(
-                    "Unity search requested (search_budget > 0) but the search "
-                    "module is not available; pass only_data_parallel=True"
-                ) from e
+            from .search.unity import unity_optimize
+
             self.strategy, self._search_result = unity_optimize(self.graph, self.config)
+            # adopt the rewritten PCG (reference: convert_graph_to_operators
+            # model.cc:2856-2858); compute-node guids survive rewrites, so
+            # frontend Tensor handles remain valid
+            if self._search_result.graph is not None:
+                self.graph = self._search_result.graph
         if self.config.export_strategy_file:
             with open(self.config.export_strategy_file, "w") as f:
                 f.write(self.strategy.to_json())

@@ -84,7 +84,23 @@ class MultiHeadAttentionOp(OpDef):
             qh = qh + weights["bq"]
             kh = kh + weights["bk"]
             vh = vh + weights["bv"]
-        ctx_out = attention_core(qh, kh, vh, causal=params.causal, backend=ctx.backend)
+        mesh = getattr(ctx, "mesh", None)
+        seq_cp = (
+            mesh is not None
+            and "seq" in mesh.axis_names
+            and mesh.shape["seq"] > 1
+            and qh.shape[1] % mesh.shape["seq"] == 0
+        )
+        if seq_cp:
+            # context parallelism: sequence dim sharded on the "seq" axis,
+            # K/V ride the ICI ring (new capability; reference has none)
+            from .kernels.ring_attention import ring_attention_sharded
+
+            ctx_out = ring_attention_sharded(
+                qh, kh, vh, mesh, seq_axis="seq", causal=params.causal
+            )
+        else:
+            ctx_out = attention_core(qh, kh, vh, causal=params.causal, backend=ctx.backend)
         out = jnp.einsum("bshd,hde->bse", ctx_out, weights["wo"])
         if params.use_bias:
             out = out + weights["bo"]
@@ -124,12 +140,12 @@ def attention_core(
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    if backend == "tpu" and jax.default_backend() == "tpu":
+    if backend == "tpu":
         try:
-            from .kernels.flash_attention import flash_attention, supports_shapes
+            from .kernels.flash_attention import flash_attention, on_tpu, supports_shapes
         except ImportError:
             flash_attention = None
-        if flash_attention is not None and supports_shapes(q.shape, k.shape):
+        if flash_attention is not None and on_tpu() and supports_shapes(q.shape, k.shape):
             return flash_attention(q, k, v, causal=causal, scale=scale)
     return reference_attention(q, k, v, causal=causal, scale=scale)
 

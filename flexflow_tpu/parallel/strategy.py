@@ -52,6 +52,16 @@ def to_partition_spec(spec: Optional[SpecTuple]):
     return PartitionSpec(*args)
 
 
+def shard_weight_entry(weights, by_name, wname: str, dim: int, axis_name: str, axis_size: int):
+    """Shard weight ``wname``'s dim ``dim`` on ``axis_name`` if it exists
+    and divides evenly; otherwise leave it replicated (graceful degradation
+    for odd vocab sizes / head counts). Shared by all strategy builders."""
+    w = by_name.get(wname)
+    if w is None or axis_size < 2 or w.spec.shape[dim] % axis_size != 0:
+        return
+    weights[wname] = pspec(*[axis_name if i == dim else None for i in range(w.spec.ndim)])
+
+
 @dataclasses.dataclass
 class OpSharding:
     """Shardings for one PCG node."""
@@ -149,13 +159,7 @@ def megatron_strategy(
         weights: Dict[str, Optional[SpecTuple]] = {w.name: None for w in wspecs}
 
         def shard_weight(wname: str, dim: int):
-            """Shard weight `wname` dim `dim` on the model axis if it exists
-            and divides evenly; otherwise leave replicated (graceful
-            degradation for odd vocab sizes / head counts)."""
-            w = by_name.get(wname)
-            if w is None or w.spec.shape[dim] % tp != 0:
-                return
-            weights[wname] = pspec(*[MODEL_AXIS if i == dim else None for i in range(w.spec.ndim)])
+            shard_weight_entry(weights, by_name, wname, dim, MODEL_AXIS, tp)
 
         name = node.name or ""
         if node.op_type == OpType.LINEAR and wspecs:
@@ -190,6 +194,50 @@ def megatron_strategy(
                 spec = pspec(*axes)
             shardings.append(spec)
         st.node_shardings[node.guid] = OpSharding(outputs=shardings, weights=weights)
+    return st
+
+
+def context_parallel_strategy(
+    graph: PCGraph,
+    dp: int,
+    cp: int,
+    batch_dim: int = 0,
+    seq_dim: int = 1,
+) -> ParallelStrategy:
+    """Context parallelism for long sequences (NEW capability — the
+    reference has no sequence parallelism, SURVEY §2.2/§5): activations
+    shard their sequence dim on the "seq" mesh axis; attention nodes ride
+    the ICI ring via ring attention (ops/kernels/ring_attention.py),
+    which the attention lowering selects automatically when the mesh has
+    a "seq" axis. Weights are replicated (combine with tensor parallelism
+    via the unity search for hybrid strategies)."""
+    st = ParallelStrategy(axis_sizes={DATA_AXIS: dp, SEQ_AXIS: cp})
+    from ..ops.base import get_op_def
+    from .propagation import infer_all_specs
+
+    specs = infer_all_specs(graph)
+    for node in graph.topo_order():
+        out_specs = specs[node.guid]
+        in_specs = [specs[e.src][e.src_idx] for e in graph.in_edges(node)]
+        op_def = get_op_def(node.op_type)
+        try:
+            wspecs = op_def.weight_specs(node.params, in_specs)
+        except Exception:
+            wspecs = []
+        shardings: List[Optional[SpecTuple]] = []
+        for os in out_specs:
+            if node.op_type == OpType.WEIGHT or os.ndim <= batch_dim:
+                shardings.append(None)
+                continue
+            axes: List[Optional[str]] = [None] * os.ndim
+            if dp > 1 and os.shape[batch_dim] % dp == 0:
+                axes[batch_dim] = DATA_AXIS
+            if cp > 1 and os.ndim > seq_dim and os.shape[seq_dim] % cp == 0:
+                axes[seq_dim] = SEQ_AXIS
+            shardings.append(pspec(*axes) if any(a for a in axes) else None)
+        st.node_shardings[node.guid] = OpSharding(
+            outputs=shardings, weights={w.name: None for w in wspecs}
+        )
     return st
 
 

@@ -1,0 +1,63 @@
+"""Unity-style parallelization search (TPU-native).
+
+Reference: the search stack of SURVEY §2.5 — PCG DP search
+(src/runtime/graph.cc), substitution engine (src/runtime/substitution.cc),
+execution simulator (src/runtime/simulator.cc), machine models
+(src/runtime/machine_model.cc, src/runtime/network.cc), and the fork's
+allreduce-schedule optimizer (src/runtime/simulator.cc:1721+).
+
+TPU-native differences:
+  * op cost comes from an analytic MXU/HBM roofline (optionally calibrated
+    by timing real XLA executables) instead of CUDA-event measurement;
+  * communication cost models the ICI torus + DCN instead of
+    NVLink/PCIe/NIC paths;
+  * the search output is a ParallelStrategy (mesh axes + per-op
+    PartitionSpecs) instead of per-op Legion MachineViews.
+"""
+from .cost_model import CostModel
+from .machine_model import (
+    EnhancedMachineModel,
+    NetworkedMachineModel,
+    NetworkTopology,
+    SimpleMachineModel,
+    build_machine_model,
+)
+from .simulator import (
+    AllreduceHelper,
+    LogicalTaskgraphSimulator,
+    SimTask,
+    Simulator,
+    allreduce_optimize,
+)
+from .substitution import (
+    GraphXfer,
+    OpX,
+    base_optimize,
+    generate_all_pcg_xfers,
+    load_substitution_json,
+)
+from .dp_search import SearchHelper
+from .mcmc import mcmc_optimize
+from .unity import unity_optimize
+
+__all__ = [
+    "CostModel",
+    "SimpleMachineModel",
+    "EnhancedMachineModel",
+    "NetworkedMachineModel",
+    "NetworkTopology",
+    "build_machine_model",
+    "Simulator",
+    "SimTask",
+    "LogicalTaskgraphSimulator",
+    "AllreduceHelper",
+    "allreduce_optimize",
+    "GraphXfer",
+    "OpX",
+    "base_optimize",
+    "generate_all_pcg_xfers",
+    "load_substitution_json",
+    "SearchHelper",
+    "mcmc_optimize",
+    "unity_optimize",
+]

@@ -1,0 +1,264 @@
+"""Per-op and per-collective cost estimation.
+
+Reference: the reference measures op cost by running the real kernel on a
+GPU bracketed with CUDA events (Op::measure_operator_cost per op; generic
+wrapper include/flexflow/operator.h:127 inner_measure_operator_cost),
+cached by (op params, machine view) — src/runtime/simulator.cc:588-628 —
+and uses analytic transfer estimates for parallel ops
+(simulator.cc:630-716 estimate_xfer_cost / repartition cost).
+
+TPU-native: XLA fuses aggressively, so per-op wall-time microbenchmarks
+mis-predict fused graphs (SURVEY §7 hard part 1). The primary model is an
+analytic MXU/HBM roofline over the op's OpCost (flops, bytes), with an
+optional *measured* calibration mode that compiles and times the op's
+jitted lowering on the real device and caches by the same
+(params, n_parts) key the reference uses. Collective costs are closed-form
+ring/tree models over the ICI torus (bandwidth/latency from TPUChipSpec),
+replacing the NVLink/NIC path walk.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.tensor import TensorSpec
+from ..core.types import DataType, OpType, ParameterSyncOption
+from ..ops.base import OpCost, get_op_def
+from ..parallel.machine import MachineSpec, MachineView
+
+# utilization derates: achievable fraction of peak (empirical; roofline
+# models consistently overestimate, see scaling-book style derates)
+MXU_EFFICIENCY = 0.55
+HBM_EFFICIENCY = 0.8
+ICI_EFFICIENCY = 0.85
+KERNEL_OVERHEAD = 2e-6  # fixed per-op launch/fusion-boundary overhead (s)
+
+
+@dataclasses.dataclass
+class CostMetrics:
+    """Per-op simulation record (reference: CostMetrics simulator.h:54-88)."""
+
+    forward_time: float = 0.0
+    backward_time: float = 0.0
+    sync_time: float = 0.0
+    memory_requirement: float = 0.0  # bytes per device
+
+    @property
+    def total_time(self) -> float:
+        return self.forward_time + self.backward_time + self.sync_time
+
+
+class CostModel:
+    """Analytic (optionally calibrated) op + collective cost model."""
+
+    def __init__(self, machine: Optional[MachineSpec] = None, measure: bool = False):
+        self.machine = machine or MachineSpec()
+        self.chip = self.machine.chip
+        self.measure = measure
+        # cache: (op_type, params, shard shapes) -> CostMetrics
+        # (reference: hash_to_operator_cost, simulator.cc:588-628)
+        self._cache: Dict[Tuple, CostMetrics] = {}
+        self._measure_cache: Dict[Tuple, float] = {}
+
+    # ------------------------------------------------------------ op cost
+    def op_cost_metrics(
+        self,
+        op_type: OpType,
+        params,
+        input_specs: Sequence[TensorSpec],
+        output_specs: Sequence[TensorSpec],
+        n_parts: int = 1,
+    ) -> CostMetrics:
+        """Estimate fwd+bwd time for one *shard* of the op when its
+        sample/attr dims are split across ``n_parts`` devices."""
+        key = (
+            op_type,
+            params,
+            tuple(s.shape + (s.dtype,) for s in input_specs),
+            n_parts,
+        )
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        op_def = get_op_def(op_type)
+        cost: OpCost = op_def.cost(params, list(input_specs), list(output_specs))
+        # per-shard work
+        flops = cost.flops / max(1, n_parts)
+        bytes_hbm = cost.bytes_accessed / max(1, n_parts)
+        dtype = input_specs[0].dtype if input_specs else DataType.FLOAT
+        fwd = self._roofline_time(flops, bytes_hbm, dtype)
+        if self.measure:
+            measured = self._try_measure(op_type, params, input_specs, n_parts)
+            if measured is not None:
+                fwd = measured
+        # backward ≈ 2x forward for matmul-dominated ops (dL/dx + dL/dw),
+        # ≈ 1x for elementwise (reference measures separately; same ratio)
+        bwd_factor = 2.0 if cost.flops > 0 else 1.0
+        m = CostMetrics(
+            forward_time=fwd,
+            backward_time=fwd * bwd_factor,
+            memory_requirement=cost.memory_bytes / max(1, n_parts),
+        )
+        self._cache[key] = m
+        return m
+
+    def _roofline_time(self, flops: float, bytes_hbm: float, dtype: DataType) -> float:
+        peak = self.chip.bf16_flops if dtype in (DataType.BFLOAT16, DataType.HALF) else self.chip.f32_flops
+        t_compute = flops / (peak * MXU_EFFICIENCY)
+        t_memory = bytes_hbm / (self.chip.hbm_bandwidth * HBM_EFFICIENCY)
+        return max(t_compute, t_memory) + KERNEL_OVERHEAD
+
+    def _try_measure(self, op_type, params, input_specs, n_parts) -> Optional[float]:
+        """Measured calibration: jit the op's lowering on one device and
+        time it (the reference's inner_measure_operator_cost on TPU)."""
+        key = (op_type, params, tuple((s.shape, s.dtype) for s in input_specs), n_parts)
+        if key in self._measure_cache:
+            return self._measure_cache[key]
+        try:
+            import time
+
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            from ..ops.base import LowerCtx
+
+            op_def = get_op_def(op_type)
+            shard_specs = []
+            for i, s in enumerate(input_specs):
+                shape = list(s.shape)
+                if i == 0 and shape and shape[0] % n_parts == 0:
+                    shape[0] //= n_parts
+                shard_specs.append(TensorSpec(tuple(shape), s.dtype))
+            rs = np.random.RandomState(0)
+            args = [jnp.asarray(rs.randn(*s.shape), s.dtype.jnp) for s in shard_specs]
+            wspecs = op_def.weight_specs(params, shard_specs)
+            weights = {w.name: jnp.asarray(rs.randn(*w.spec.shape), w.spec.dtype.jnp) for w in wspecs}
+
+            def fn(inputs, weights):
+                ctx = LowerCtx(training=False, rng=jax.random.key(0), backend="cpu")
+                return op_def.lower(params, inputs, weights, ctx)
+
+            jitted = jax.jit(fn)
+            out = jitted(args, weights)
+            jax.block_until_ready(out)
+            reps = 5
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = jitted(args, weights)
+            jax.block_until_ready(out)
+            t = (time.perf_counter() - t0) / reps
+            self._measure_cache[key] = t
+            return t
+        except Exception:
+            self._measure_cache[key] = None  # type: ignore
+            return None
+
+    # ------------------------------------------------------- comm costs
+    def link_bandwidth(self, intra_node: bool) -> float:
+        bw = self.chip.ici_bandwidth if intra_node else self.machine.chip.dcn_bandwidth
+        return bw * ICI_EFFICIENCY
+
+    def link_latency(self, intra_node: bool) -> float:
+        return self.chip.ici_latency if intra_node else self.chip.dcn_latency
+
+    def _view_spans_nodes(self, view: Optional[MachineView]) -> bool:
+        if view is None:
+            return self.machine.num_nodes > 1
+        ids = view.device_ids()
+        per = self.machine.devices_per_node
+        return len({i // per for i in ids}) > 1
+
+    def p2p_time(self, nbytes: float, intra_node: bool = True) -> float:
+        return self.link_latency(intra_node) + nbytes / self.link_bandwidth(intra_node)
+
+    def allreduce_time(
+        self,
+        nbytes: float,
+        n: int,
+        option: ParameterSyncOption = ParameterSyncOption.DEFAULT,
+        intra_node: bool = True,
+    ) -> float:
+        """Closed-form allreduce cost over n devices.
+
+        Reference: the fork's AllreduceHelper expands ring / butterfly /
+        double-binary-tree patterns into p2p sends and simulates them
+        (simulator.h:614-651, simulator.cc:2870+). On the ICI torus the
+        same algebra holds with per-hop latency L and link bandwidth B:
+          ring:      2(n-1)/n * bytes/B          + 2(n-1) L
+          butterfly: log2(n) * bytes/B           + log2(n) L  (recursive halving-doubling)
+          DBT:       2 * bytes/B (pipelined)     + 2 log2(n) L
+        """
+        if n <= 1 or nbytes <= 0:
+            return 0.0
+        B = self.link_bandwidth(intra_node)
+        L = self.link_latency(intra_node)
+        if option == ParameterSyncOption.BUTTERFLY:
+            k = math.log2(n) if n > 1 else 1.0
+            return k * L + math.ceil(k) * (nbytes / n) * 2 / B * (n / 2)
+        if option == ParameterSyncOption.DOUBLE_BINARY_TREE:
+            k = math.log2(n) if n > 1 else 1.0
+            return 2 * k * L + 2 * nbytes / B
+        # DEFAULT and RING: bandwidth-optimal ring
+        return 2 * (n - 1) * L + 2 * (n - 1) / n * nbytes / B
+
+    def all_gather_time(self, nbytes_total: float, n: int, intra_node: bool = True) -> float:
+        if n <= 1:
+            return 0.0
+        B = self.link_bandwidth(intra_node)
+        L = self.link_latency(intra_node)
+        return (n - 1) * L + (n - 1) / n * nbytes_total / B
+
+    def reduce_scatter_time(self, nbytes_total: float, n: int, intra_node: bool = True) -> float:
+        return self.all_gather_time(nbytes_total, n, intra_node)
+
+    def all_to_all_time(self, nbytes_total: float, n: int, intra_node: bool = True) -> float:
+        if n <= 1:
+            return 0.0
+        B = self.link_bandwidth(intra_node)
+        L = self.link_latency(intra_node)
+        # each device exchanges (n-1)/n of its shard; torus bisection ~n/4 links
+        bisection = max(1, n // 4)
+        return (n - 1) * L / n + (nbytes_total * (n - 1) / n) / (B * bisection)
+
+    # ------------------------------------------------- parallel-op xfers
+    def xfer_time(
+        self,
+        op_type: OpType,
+        nbytes_total: float,
+        degree: int,
+        intra_node: bool = True,
+    ) -> float:
+        """Analytic resharding cost per parallel op (reference:
+        Simulator::estimate_xfer_cost simulator.cc:671 + the repartition
+        special case :630)."""
+        if degree <= 1 or nbytes_total <= 0:
+            return 0.0
+        if op_type == OpType.REPARTITION:
+            # scatter: each dst gets 1/degree, all moves in parallel over links
+            return self.p2p_time(nbytes_total / degree, intra_node)
+        if op_type == OpType.COMBINE:
+            return self.all_gather_time(nbytes_total, degree, intra_node)
+        if op_type == OpType.REPLICATE:
+            # broadcast along ring: pipelined, ~bytes/B + (d-1)L
+            return (degree - 1) * self.link_latency(intra_node) + nbytes_total / self.link_bandwidth(intra_node)
+        if op_type == OpType.REDUCTION:
+            return self.reduce_scatter_time(nbytes_total, degree, intra_node)
+        if op_type == OpType.ALLREDUCE:
+            return self.allreduce_time(nbytes_total, degree, intra_node=intra_node)
+        if op_type == OpType.FUSED_PARALLEL:
+            return self.all_to_all_time(nbytes_total, degree, intra_node)
+        return self.p2p_time(nbytes_total, intra_node)
+
+    def grad_sync_time(
+        self,
+        weight_bytes: float,
+        view: Optional[MachineView],
+        n_replicas: int,
+        option: ParameterSyncOption = ParameterSyncOption.DEFAULT,
+    ) -> float:
+        """Gradient allreduce for one parameter (reference: nccl_update_task
+        optimizer.cc:261 — allreduce over the weight's machine view)."""
+        intra = not self._view_spans_nodes(view)
+        return self.allreduce_time(weight_bytes, n_replicas, option, intra)

@@ -1,0 +1,281 @@
+"""DP machine-view assignment over the PCG.
+
+Reference: SearchHelper (include/flexflow/graph.h:170-284,
+src/runtime/graph.cc) — recursive graph decomposition:
+  * sequential split at a bottleneck node
+    (find_optimal_sequence_graph_time graph.cc:115),
+  * non-sequential SEQUENTIAL/VERTICAL/HORIZONTAL splits
+    (graph.cc:188-235, 267-321),
+  * memoized by a (subgraph, resource) hash (dp_state_hash graph.cc:1863),
+  * leaf costs from the simulator / per-op measurement
+    (graph_cost graph.cc:1586 -> estimate_xfer_cost + measure_operator_cost).
+
+TPU-native: a "machine view" is a contiguous run of chips (1-D) or a tile
+(2-D) of the slice — enumerate_machine_views restricts to torus-friendly
+power-of-two runs (SURVEY §7 hard part 2: mesh-axis enumeration without
+combinatorial blowup). The DP's VERTICAL/HORIZONTAL resource splits map to
+splitting the device range between independent subgraphs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+from ..core.graph import Node, PCGraph
+from ..core.types import OpType, PARALLEL_OP_TYPES
+from ..ops.base import get_op_def
+from ..parallel.machine import MachineSpec, MachineView
+from ..parallel.propagation import infer_all_specs
+from .cost_model import CostModel
+from .simulator import Simulator
+
+
+@dataclasses.dataclass(frozen=True)
+class MachineResource:
+    """Contiguous device range available to a subgraph
+    (reference: MachineResource machine_view.h:62)."""
+
+    start: int
+    size: int
+
+    def split(self, left_frac: float) -> Tuple["MachineResource", "MachineResource"]:
+        k = max(1, min(self.size - 1, round(self.size * left_frac)))
+        return MachineResource(self.start, k), MachineResource(self.start + k, self.size - k)
+
+
+@dataclasses.dataclass
+class DPResult:
+    cost: float
+    views: Dict[int, MachineView]
+    memory_per_device: float = 0.0
+
+
+class SearchHelper:
+    """Memoized DP over (subgraph, resource) (reference: graph.h:170-284)."""
+
+    def __init__(
+        self,
+        machine: Optional[MachineSpec] = None,
+        cost_model: Optional[CostModel] = None,
+        simulator: Optional[Simulator] = None,
+        max_parallel_degree: Optional[int] = None,
+    ):
+        self.machine = machine or MachineSpec()
+        self.cost_model = cost_model or CostModel(self.machine)
+        self.simulator = simulator or Simulator(self.machine, self.cost_model)
+        self.max_degree = max_parallel_degree or self.machine.num_devices
+        self._memo: Dict[Tuple[int, MachineResource], DPResult] = {}
+
+    # ------------------------------------------------------------- views
+    def candidate_views(self, resource: MachineResource, batch_limit: int = 0) -> List[MachineView]:
+        """1-D power-of-two runs inside the resource (reference:
+        get_valid_machine_views; restricted per SURVEY §7)."""
+        out = []
+        k = 1
+        while k <= resource.size and k <= self.max_degree:
+            if not batch_limit or batch_limit % k == 0:
+                out.append(MachineView(resource.start, (k,), (1,)))
+            k *= 2
+        return out or [MachineView(resource.start, (1,), (1,))]
+
+    # -------------------------------------------------------------- cost
+    def node_cost(self, graph: PCGraph, specs, node: Node, view: MachineView) -> Tuple[float, float]:
+        """(time, per-device bytes) for one op under one view."""
+        in_specs = specs["in"][node.guid]
+        if node.op_type in PARALLEL_OP_TYPES:
+            nbytes = in_specs[0].size_bytes if in_specs else 0
+            deg = getattr(node.params, "degree", view.num_parts)
+            t = self.cost_model.xfer_time(node.op_type, nbytes, deg)
+            return t, 0.0
+        out_specs = specs["out"][node.guid]
+        cm = self.cost_model.op_cost_metrics(
+            node.op_type, node.params, in_specs, out_specs, view.num_parts
+        )
+        t = cm.forward_time + cm.backward_time
+        mem = cm.memory_requirement
+        try:
+            wspecs = get_op_def(node.op_type).weight_specs(node.params, in_specs)
+        except Exception:
+            wspecs = []
+        if wspecs:
+            wbytes = sum(w.spec.size_bytes for w in wspecs)
+            # weights replicated across view parts -> sync cost; 4x for
+            # optimizer state (param + grad + 2 moments, adam-style)
+            t += self.cost_model.grad_sync_time(wbytes, view, view.num_parts)
+            mem += 4 * wbytes
+        return t, mem
+
+    def optimal_cost(
+        self,
+        graph: PCGraph,
+        resource: Optional[MachineResource] = None,
+        specs: Optional[Dict] = None,
+    ) -> DPResult:
+        """Entry point (reference: Graph::generic_optimal_cost
+        graph.cc:1802-1843). ``specs`` (inferred once on the root graph)
+        threads through the recursion because subgraphs cut producers off
+        at split boundaries."""
+        resource = resource or MachineResource(0, self.machine.num_devices)
+        key = (graph.structural_hash(), resource)
+        hit = self._memo.get(key)
+        if hit is not None:
+            return hit
+        if specs is None:
+            out_map = infer_all_specs(graph)
+            specs = {
+                "out": out_map,
+                "in": {
+                    n.guid: [out_map[e.src][e.src_idx] for e in graph.in_edges(n)]
+                    for n in graph.nodes.values()
+                },
+            }
+        result = self._optimal_cost_impl(graph, resource, specs)
+        self._memo[key] = result
+        return result
+
+    def _optimal_cost_impl(self, graph: PCGraph, resource: MachineResource, specs: Dict) -> DPResult:
+        compute_nodes = [
+            n
+            for n in graph.topo_order()
+            if n.op_type not in (OpType.INPUT, OpType.WEIGHT, OpType.NOOP)
+        ]
+        if len(compute_nodes) <= 1:
+            return self._leaf_cost(graph, specs, resource)
+
+        best: Optional[DPResult] = None
+
+        # HORIZONTAL split: independent components run on disjoint devices
+        comps = self._components(graph, compute_nodes)
+        if len(comps) > 1:
+            big, rest = comps[0], [g for c in comps[1:] for g in c]
+            frac = len(big) / max(1, len(big) + len(rest))
+            if resource.size > 1:
+                # disjoint device ranges: branches overlap in time; each
+                # device only hosts its own branch (reference: parallel_cost)
+                r1, r2 = resource.split(frac)
+                a = self.optimal_cost(graph.subgraph(self._with_io(graph, big)), r1, specs)
+                b = self.optimal_cost(graph.subgraph(self._with_io(graph, rest)), r2, specs)
+                cand = DPResult(
+                    max(a.cost, b.cost),
+                    {**a.views, **b.views},
+                    max(a.memory_per_device, b.memory_per_device),
+                )
+            else:
+                # one device: branches serialize and share its HBM
+                a = self.optimal_cost(graph.subgraph(self._with_io(graph, big)), resource, specs)
+                b = self.optimal_cost(graph.subgraph(self._with_io(graph, rest)), resource, specs)
+                cand = DPResult(
+                    a.cost + b.cost,
+                    {**a.views, **b.views},
+                    a.memory_per_device + b.memory_per_device,
+                )
+            # sequential on the full resource is also valid; compared below
+            best = cand
+
+        # SEQUENTIAL split at a bottleneck (reference: graph.cc:115)
+        bottlenecks = [
+            n
+            for n in graph.bottleneck_nodes()
+            if n.op_type not in (OpType.INPUT, OpType.WEIGHT)
+        ]
+        if bottlenecks:
+            mid = bottlenecks[len(bottlenecks) // 2]
+            first, second = graph.split_at_node(mid)
+            if len(first) < len(graph) and len(second) < len(graph):
+                a = self.optimal_cost(first, resource, specs)
+                b = self.optimal_cost(second, resource, specs)
+                views = {**a.views, **b.views}
+                # boundary xfer: if the two halves chose different views for
+                # the bottleneck, charge a reshard of its output
+                va, vb = a.views.get(mid.guid), b.views.get(mid.guid)
+                xfer = 0.0
+                if va is not None and vb is not None and va != vb:
+                    nbytes = specs["out"][mid.guid][0].size_bytes
+                    xfer = self.cost_model.xfer_time(
+                        OpType.FUSED_PARALLEL, nbytes, max(va.num_parts, vb.num_parts)
+                    )
+                # both halves live on the same device range: weights and
+                # optimizer state of the whole chain coexist -> memory adds
+                cand = DPResult(
+                    a.cost + b.cost + xfer, views, a.memory_per_device + b.memory_per_device
+                )
+                if best is None or cand.cost < best.cost:
+                    best = cand
+
+        leaf = self._leaf_cost(graph, specs, resource)
+        if best is None or leaf.cost < best.cost:
+            best = leaf
+        return best
+
+    def _leaf_cost(self, graph: PCGraph, specs, resource: MachineResource) -> DPResult:
+        """No further split: choose one uniform view for the whole subgraph
+        (data-parallel across the resource), picking the degree that
+        minimizes simulated time (reference leaf: per-node view optimization
+        graph.cc:1663)."""
+        batch = 0
+        for n in graph.topo_order():
+            if n.op_type == OpType.INPUT:
+                batch = specs["out"][n.guid][0].shape[0] if specs["out"][n.guid][0].shape else 0
+                break
+        best: Optional[DPResult] = None
+        for view in self.candidate_views(resource, batch_limit=batch):
+            total_t = 0.0
+            total_mem = 0.0
+            views: Dict[int, MachineView] = {}
+            for node in graph.topo_order():
+                if node.op_type in (OpType.INPUT, OpType.WEIGHT, OpType.NOOP):
+                    views[node.guid] = view
+                    continue
+                t, mem = self.node_cost(graph, specs, node, view)
+                total_t += t
+                total_mem += mem
+                views[node.guid] = view
+            cand = DPResult(total_t, views, total_mem)
+            if best is None or cand.cost < best.cost:
+                best = cand
+        assert best is not None
+        return best
+
+    # ------------------------------------------------------------ helpers
+    @staticmethod
+    def _components(graph: PCGraph, compute_nodes: List[Node]) -> List[List[int]]:
+        guids = {n.guid for n in compute_nodes}
+        seen: set = set()
+        comps: List[List[int]] = []
+        for n in compute_nodes:
+            if n.guid in seen:
+                continue
+            comp = []
+            stack = [n.guid]
+            while stack:
+                g = stack.pop()
+                if g in seen or g not in guids:
+                    continue
+                seen.add(g)
+                comp.append(g)
+                for e in graph.in_edges(g):
+                    stack.append(e.src)
+                for e in graph.out_edges(g):
+                    stack.append(e.dst)
+            comps.append(comp)
+        comps.sort(key=len, reverse=True)
+        return comps
+
+    @staticmethod
+    def _with_io(graph: PCGraph, guids: List[int]) -> List[int]:
+        s = set(guids)
+        for g in list(s):
+            for e in graph.in_edges(g):
+                src = graph.nodes[e.src]
+                if src.op_type in (OpType.INPUT, OpType.WEIGHT):
+                    s.add(src.guid)
+        return list(s)
+
+    def graph_cost(self, graph: PCGraph) -> float:
+        """Scalar cost for the substitution search's cost_fn
+        (reference: Graph::optimal_cost graph.cc:1742)."""
+        return self.optimal_cost(graph).cost
+
+    def optimal_views(self, graph: PCGraph) -> Dict[int, MachineView]:
+        return self.optimal_cost(graph).views

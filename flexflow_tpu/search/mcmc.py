@@ -1,0 +1,79 @@
+"""MCMC strategy search (legacy MLSys'19 path).
+
+Reference: FFModel::mcmc_optimize (src/runtime/model.cc:3704-3775) —
+simulated annealing over per-op ParallelConfigs: start from data-parallel,
+propose ``rewrite`` (random op -> random valid config, model.cc:3679),
+score with the event-driven simulator (simulate_runtime), Metropolis
+accept (model.cc:3736-3749). Entry: Simulator::strategy_search_task
+(simulator.h:860), run under --budget with --import/--export strategies.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, Optional, Tuple
+
+from ..core.graph import PCGraph
+from ..core.types import OpType
+from ..parallel.machine import MachineSpec, MachineView
+from .dp_search import MachineResource, SearchHelper
+from .simulator import Simulator
+
+
+def mcmc_optimize(
+    graph: PCGraph,
+    machine: Optional[MachineSpec] = None,
+    budget: int = 200,
+    alpha: float = 0.05,
+    seed: int = 0,
+    simulator: Optional[Simulator] = None,
+    init_views: Optional[Dict[int, MachineView]] = None,
+) -> Tuple[Dict[int, MachineView], float]:
+    """Returns (best views, best simulated step time).
+
+    ``alpha`` is the Metropolis temperature scale (reference uses
+    exp(-alpha * delta) acceptance, model.cc:3741).
+    """
+    machine = machine or MachineSpec()
+    sim = simulator or Simulator(machine)
+    helper = SearchHelper(machine, sim.cost_model, sim)
+    rng = random.Random(seed)
+    resource = MachineResource(0, machine.num_devices)
+
+    # start from data parallel over all devices (reference: model.cc:3712)
+    full = MachineView(0, (machine.num_devices,), (1,))
+    views: Dict[int, MachineView] = init_views or {n.guid: full for n in graph.nodes.values()}
+    candidates = helper.candidate_views(resource)
+    movable = [
+        n.guid
+        for n in graph.nodes.values()
+        if n.op_type not in (OpType.INPUT, OpType.WEIGHT)
+    ]
+
+    def cost(v: Dict[int, MachineView]) -> float:
+        return sim.simulate(graph, v)
+
+    current = best = cost(views)
+    best_views = dict(views)
+    for it in range(budget):
+        if not movable:
+            break
+        guid = rng.choice(movable)
+        old = views.get(guid)
+        new = rng.choice(candidates)
+        if new == old:
+            continue
+        views[guid] = new
+        c = cost(views)
+        delta = c - current
+        if delta < 0 or rng.random() < math.exp(-delta / max(1e-12, alpha * max(current, 1e-9))):
+            current = c
+            if c < best:
+                best = c
+                best_views = dict(views)
+        else:
+            if old is None:
+                views.pop(guid, None)
+            else:
+                views[guid] = old
+    return best_views, best

@@ -1,0 +1,644 @@
+"""TASO-style substitution engine: pattern-based PCG rewriting.
+
+Reference: src/runtime/substitution.cc (3802 LoC) — GraphXfer source/dest
+``OpX`` patterns with parameter constraints (substitution.h:39-111),
+backtracking match (can_match/match/unmatch substitution.h:173-175),
+best-first search ``base_optimize`` with a priority queue and alpha
+pruning (substitution.cc:2229-2311), built-in xfers generated per divisor
+parallel degree (generate_all_pcg_xfers substitution.cc:1726-1840), and
+JSON rule collections (substitution_loader.h/.cc; shipped rules
+substitutions/graph_subst_3_v2.json — format preserved here so the
+reference's rule files load unchanged).
+
+The rewrites insert/remove *parallel ops* (Repartition/Combine/Replicate/
+Reduction) around compute ops; on TPU these lower to sharding constraints
+and GSPMD collectives rather than data-movement kernels, but the search
+algebra is identical.
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..core.graph import Edge, Node, PCGraph
+from ..core.types import ActiMode, OpType
+from ..ops.parallel_ops import (
+    AllReduceParams,
+    CombineParams,
+    RepartitionParams,
+    ReplicateParams,
+    ReductionParams,
+)
+
+# ---------------------------------------------------------------------------
+# pattern structures
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorX:
+    """A tensor in a pattern: output ``ts_idx`` of pattern op ``op_idx``,
+    or an external input when op_idx < 0 (reference: TensorX)."""
+
+    op_idx: int  # index into the pattern's op list; -1 = external input
+    ts_idx: int = 0
+
+
+@dataclasses.dataclass
+class OpX:
+    """One pattern operator (reference: OpX substitution.h:85-111).
+
+    constraints: param-name -> required value, checked against the matched
+    node's params record (reference PMConstraint).
+    make_params: for dest patterns, builds the concrete params given the
+    matched source nodes (reference's dest-op construction).
+    """
+
+    op_type: OpType
+    inputs: Tuple[TensorX, ...] = ()
+    constraints: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    make_params: Optional[Callable[[List[Node]], Any]] = None
+    match_fn: Optional[Callable[[Node], bool]] = None  # extra predicate
+    # dest-only: reuse the guid of matched src op i, so compute nodes keep
+    # their identity across rewrites and strategies stay addressable by the
+    # frontend's node handles (the reference similarly reuses Op instances
+    # via get_or_create caches, model.h:678-706)
+    reuse_src: Optional[int] = None
+
+    def matches(self, node: Node) -> bool:
+        if node.op_type != self.op_type:
+            return False
+        for k, v in self.constraints.items():
+            if getattr(node.params, k, None) != v:
+                return False
+        if self.match_fn is not None and not self.match_fn(node):
+            return False
+        return True
+
+
+@dataclasses.dataclass
+class GraphXfer:
+    """A rewrite rule: src pattern -> dst pattern
+    (reference: GraphXfer substitution.h:169-246)."""
+
+    name: str
+    src_ops: List[OpX]
+    dst_ops: List[OpX]
+    # (src_op_idx, src_ts_idx) -> (dst_op_idx, dst_ts_idx): which dst tensor
+    # replaces each src output consumed outside the pattern
+    mapped_outputs: Dict[Tuple[int, int], Tuple[int, int]] = dataclasses.field(default_factory=dict)
+
+    # ------------------------------------------------------------ matching
+    def find_matches(self, graph: PCGraph, limit: int = 64) -> List[List[Node]]:
+        """All assignments of graph nodes to src pattern ops, respecting
+        op types, constraints, and internal wiring (reference:
+        GraphXfer::run's recursive can_match/match loop)."""
+        matches: List[List[Node]] = []
+        assign: List[Optional[Node]] = [None] * len(self.src_ops)
+        used: set = set()
+
+        def wiring_ok(i: int, node: Node) -> bool:
+            pat = self.src_ops[i]
+            in_edges = graph.in_edges(node)
+            for inp_pos, tx in enumerate(pat.inputs):
+                if tx.op_idx < 0:
+                    continue  # external input: anything goes
+                producer = assign[tx.op_idx]
+                if producer is None:
+                    continue  # not yet assigned; checked later symmetrical
+                e = next((e for e in in_edges if e.dst_idx == inp_pos), None)
+                if e is None or e.src != producer.guid or e.src_idx != tx.ts_idx:
+                    return False
+            # also check edges from this node into already-assigned consumers
+            for j, other in enumerate(self.src_ops):
+                if assign[j] is None:
+                    continue
+                for inp_pos, tx in enumerate(other.inputs):
+                    if tx.op_idx == i:
+                        e = next(
+                            (e for e in graph.in_edges(assign[j]) if e.dst_idx == inp_pos),
+                            None,
+                        )
+                        if e is None or e.src != node.guid or e.src_idx != tx.ts_idx:
+                            return False
+            return True
+
+        nodes = graph.topo_order()
+
+        def rec(i: int):
+            if len(matches) >= limit:
+                return
+            if i == len(self.src_ops):
+                matches.append([assign[k] for k in range(len(self.src_ops))])  # type: ignore
+                return
+            pat = self.src_ops[i]
+            for node in nodes:
+                if node.guid in used or not pat.matches(node):
+                    continue
+                if not wiring_ok(i, node):
+                    continue
+                assign[i] = node
+                used.add(node.guid)
+                rec(i + 1)
+                used.discard(node.guid)
+                assign[i] = None
+
+        rec(0)
+        return matches
+
+    # ------------------------------------------------------------- rewrite
+    def apply(self, graph: PCGraph, match: List[Node]) -> Optional[PCGraph]:
+        """Build the rewritten graph (reference: GraphXfer::create_new_graph).
+
+        External inputs of the src pattern bind to the matched nodes'
+        actual producers; src outputs consumed outside the pattern are
+        re-wired to the mapped dst outputs.
+        """
+        g = graph.copy()
+        matched_guids = {n.guid for n in match}
+        # resolve external inputs: TensorX(-1, k) = the k-th distinct external
+        # producer feeding the pattern, in (src_op, input_pos) order
+        ext_bindings: Dict[int, Tuple[int, int]] = {}  # ext index -> (guid, src_idx)
+        for i, pat in enumerate(self.src_ops):
+            in_edges = graph.in_edges(match[i])
+            for pos, tx in enumerate(pat.inputs):
+                if tx.op_idx >= 0:
+                    continue
+                e = next((e for e in in_edges if e.dst_idx == pos), None)
+                if e is None:
+                    return None
+                key = tx.ts_idx
+                if key in ext_bindings and ext_bindings[key] != (e.src, e.src_idx):
+                    return None  # inconsistent external binding
+                ext_bindings[key] = (e.src, e.src_idx)
+
+        # compute dst params before mutating anything
+        dst_params: List[Any] = []
+        for d in self.dst_ops:
+            params = d.make_params(match) if d.make_params else None
+            if params is None:
+                return None
+            dst_params.append(params)
+        # record escaping consumer edges of the src pattern
+        escapes: List[Tuple[int, Edge]] = []
+        for i, src_node in enumerate(match):
+            for e in graph.out_edges(src_node):
+                if e.dst in matched_guids:
+                    continue
+                if (i, e.src_idx) not in self.mapped_outputs:
+                    return None  # src output escapes but has no replacement
+                escapes.append((i, e))
+        # delete matched nodes (and their edges)
+        for n in match:
+            g.remove_node(n.guid)
+        # instantiate dst ops; reuse_src keeps the original node's guid so
+        # frontend tensor handles stay valid across rewrites
+        new_nodes: List[Node] = []
+        for d, params in zip(self.dst_ops, dst_params):
+            if d.reuse_src is not None:
+                orig = match[d.reuse_src]
+                node = Node(orig.guid, d.op_type, params, orig.name)
+                g.add_node(node)
+            else:
+                node = g.new_node(d.op_type, params, name=f"xfer:{self.name}")
+            new_nodes.append(node)
+        # wire dst inputs
+        for di, d in enumerate(self.dst_ops):
+            for pos, tx in enumerate(d.inputs):
+                if tx.op_idx < 0:
+                    src_guid, src_idx = ext_bindings[tx.ts_idx]
+                else:
+                    src_guid, src_idx = new_nodes[tx.op_idx].guid, tx.ts_idx
+                g.add_edge(src_guid, new_nodes[di].guid, src_idx, pos)
+        # re-route escaped consumers to the mapped dst outputs
+        for i, e in escapes:
+            d_op, d_ts = self.mapped_outputs[(i, e.src_idx)]
+            g.add_edge(new_nodes[d_op].guid, e.dst, d_ts, e.dst_idx)
+        return g
+
+    def run(self, graph: PCGraph) -> List[PCGraph]:
+        """All single-application rewrites of this xfer on the graph."""
+        out = []
+        for m in self.find_matches(graph):
+            ng = self.apply(graph, m)
+            if ng is not None:
+                out.append(ng)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# built-in xfers (reference: substitution.cc:61-121, 1726-1840)
+# ---------------------------------------------------------------------------
+
+
+def _x(op_type, *inputs, **kw):
+    return OpX(op_type, tuple(inputs), **kw)
+
+
+def create_replicate_linear_combine(degree: int, activation: Optional[ActiMode] = None) -> GraphXfer:
+    """Linear(x) => Combine(Linear(Replicate(x))) — tensor parallelism on
+    the output dim (reference: create_replicate_linear_combine
+    substitution.cc:71, 1756)."""
+
+    def linear_params(match: List[Node]):
+        return match[0].params  # same Linear params; sharding via neighbors
+
+    constraints = {}
+    if activation is not None:
+        constraints["activation"] = activation
+    src = [_x(OpType.LINEAR, TensorX(-1, 0), constraints=constraints)]
+    dst = [
+        _x(OpType.REPLICATE, TensorX(-1, 0), make_params=lambda m: ReplicateParams(degree)),
+        _x(OpType.LINEAR, TensorX(0, 0), make_params=linear_params, reuse_src=0),
+        _x(
+            OpType.COMBINE,
+            TensorX(1, 0),
+            make_params=lambda m: CombineParams(dim=-1, degree=degree),
+        ),
+    ]
+    return GraphXfer(
+        name=f"replicate_linear_combine_{degree}",
+        src_ops=src,
+        dst_ops=dst,
+        mapped_outputs={(0, 0): (2, 0)},
+    )
+
+
+def create_partition_linear_combine(degree: int, activation: Optional[ActiMode] = None) -> GraphXfer:
+    """Linear(x) => Reduction(Linear(Repartition(x, last dim))) — row
+    parallelism on the input dim (reference: create_partition_linear_combine
+    substitution.cc:77)."""
+    constraints = {}
+    if activation is not None:
+        constraints["activation"] = activation
+    src = [_x(OpType.LINEAR, TensorX(-1, 0), constraints=constraints)]
+    dst = [
+        _x(
+            OpType.REPARTITION,
+            TensorX(-1, 0),
+            make_params=lambda m: RepartitionParams(dim=-1, degree=degree),
+        ),
+        _x(OpType.LINEAR, TensorX(0, 0), make_params=lambda m: m[0].params, reuse_src=0),
+        _x(
+            OpType.REDUCTION,
+            TensorX(1, 0),
+            make_params=lambda m: ReductionParams(degree=degree),
+        ),
+    ]
+    return GraphXfer(
+        name=f"partition_linear_combine_{degree}",
+        src_ops=src,
+        dst_ops=dst,
+        mapped_outputs={(0, 0): (2, 0)},
+    )
+
+
+def create_replicate_embedding_combine(degree: int) -> GraphXfer:
+    """Embedding(x) => Combine(Embedding(Replicate(x))) — column parallelism
+    over the embedding out_dim (reference: embedding is
+    attribute-parallelizable, SURVEY §2.4 / src/ops/embedding.cc)."""
+
+    def ok(node: Node) -> bool:
+        return getattr(node.params, "out_dim", 0) % degree == 0
+
+    src = [_x(OpType.EMBEDDING, TensorX(-1, 0), match_fn=ok)]
+    dst = [
+        _x(OpType.REPLICATE, TensorX(-1, 0), make_params=lambda m: ReplicateParams(degree)),
+        _x(OpType.EMBEDDING, TensorX(0, 0), make_params=lambda m: m[0].params, reuse_src=0),
+        _x(
+            OpType.COMBINE,
+            TensorX(1, 0),
+            make_params=lambda m: CombineParams(dim=-1, degree=degree),
+        ),
+    ]
+    return GraphXfer(
+        name=f"replicate_embedding_combine_{degree}",
+        src_ops=src,
+        dst_ops=dst,
+        mapped_outputs={(0, 0): (2, 0)},
+    )
+
+
+def create_partition_attention_combine(degree: int) -> GraphXfer:
+    """MHA => Combine(MHA(Replicate(q,k,v))) — head parallelism
+    (reference: create_partition_attention_combine substitution.cc:1768)."""
+
+    def ok(node: Node) -> bool:
+        return getattr(node.params, "num_heads", 0) % degree == 0
+
+    src = [
+        _x(
+            OpType.MULTIHEAD_ATTENTION,
+            TensorX(-1, 0),
+            TensorX(-1, 1),
+            TensorX(-1, 2),
+            match_fn=ok,
+        )
+    ]
+    dst = [
+        _x(OpType.REPLICATE, TensorX(-1, 0), make_params=lambda m: ReplicateParams(degree)),
+        _x(OpType.REPLICATE, TensorX(-1, 1), make_params=lambda m: ReplicateParams(degree)),
+        _x(OpType.REPLICATE, TensorX(-1, 2), make_params=lambda m: ReplicateParams(degree)),
+        _x(
+            OpType.MULTIHEAD_ATTENTION,
+            TensorX(0, 0),
+            TensorX(1, 0),
+            TensorX(2, 0),
+            make_params=lambda m: m[0].params,
+            reuse_src=0,
+        ),
+        _x(
+            OpType.REDUCTION,
+            TensorX(3, 0),
+            make_params=lambda m: ReductionParams(degree=degree),
+        ),
+    ]
+    return GraphXfer(
+        name=f"partition_attention_combine_{degree}",
+        src_ops=src,
+        dst_ops=dst,
+        mapped_outputs={(0, 0): (4, 0)},
+    )
+
+
+def _partition_unary_combine(op_type: OpType, degree: int, dim: int = 0) -> GraphXfer:
+    """<op>(x) => Combine(<op>(Repartition(x))) for ops that commute with
+    batch partitioning (reference: create_partition_relu_combine /
+    partition_softmax_combine etc., substitution.cc:1797-1830)."""
+    src = [_x(op_type, TensorX(-1, 0))]
+    dst = [
+        _x(
+            OpType.REPARTITION,
+            TensorX(-1, 0),
+            make_params=lambda m: RepartitionParams(dim=dim, degree=degree),
+        ),
+        _x(op_type, TensorX(0, 0), make_params=lambda m: m[0].params, reuse_src=0),
+        _x(
+            OpType.COMBINE,
+            TensorX(1, 0),
+            make_params=lambda m: CombineParams(dim=dim, degree=degree),
+        ),
+    ]
+    return GraphXfer(
+        name=f"partition_{op_type.value}_combine_{degree}_d{dim}",
+        src_ops=src,
+        dst_ops=dst,
+        mapped_outputs={(0, 0): (2, 0)},
+    )
+
+
+def create_partition_add_combine(degree: int, dim: int = 0) -> GraphXfer:
+    src = [_x(OpType.EW_ADD, TensorX(-1, 0), TensorX(-1, 1))]
+    dst = [
+        _x(OpType.REPARTITION, TensorX(-1, 0), make_params=lambda m: RepartitionParams(dim=dim, degree=degree)),
+        _x(OpType.REPARTITION, TensorX(-1, 1), make_params=lambda m: RepartitionParams(dim=dim, degree=degree)),
+        _x(OpType.EW_ADD, TensorX(0, 0), TensorX(1, 0), make_params=lambda m: m[0].params, reuse_src=0),
+        _x(OpType.COMBINE, TensorX(2, 0), make_params=lambda m: CombineParams(dim=dim, degree=degree)),
+    ]
+    return GraphXfer(
+        name=f"partition_add_combine_{degree}",
+        src_ops=src,
+        dst_ops=dst,
+        mapped_outputs={(0, 0): (3, 0)},
+    )
+
+
+def create_combine_inception(degree: int, num_branches: int = 2) -> GraphXfer:
+    """Concat of partitioned branches: hoist the Combine past the Concat
+    (reference: combine_inception/concat xfers substitution.cc:109-121).
+    Simplified to 2 branches: Concat(Combine(a), Combine(b)) =>
+    Combine(Concat(a, b))."""
+    src = [
+        _x(OpType.COMBINE, TensorX(-1, 0)),
+        _x(OpType.COMBINE, TensorX(-1, 1)),
+        _x(OpType.CONCAT, TensorX(0, 0), TensorX(1, 0)),
+    ]
+    dst = [
+        _x(OpType.CONCAT, TensorX(-1, 0), TensorX(-1, 1), make_params=lambda m: m[2].params, reuse_src=2),
+        _x(
+            OpType.COMBINE,
+            TensorX(0, 0),
+            make_params=lambda m: m[0].params,
+        ),
+    ]
+    return GraphXfer(
+        name=f"combine_concat_{degree}",
+        src_ops=src,
+        dst_ops=dst,
+        mapped_outputs={(2, 0): (1, 0)},
+    )
+
+
+def create_linear_relu_fusion() -> GraphXfer:
+    """Relu(Linear(x)) => Linear(x, activation=relu) (reference:
+    leading linear+relu fusion xfer substitution.cc:96-105). On TPU XLA
+    fuses this anyway; the xfer still shrinks the search graph."""
+
+    def fused_params(match: List[Node]):
+        p = match[0].params
+        if getattr(p, "activation", None) != ActiMode.NONE:
+            return None
+        return dataclasses.replace(p, activation=ActiMode.RELU)
+
+    src = [
+        _x(OpType.LINEAR, TensorX(-1, 0), constraints={"activation": ActiMode.NONE}),
+        _x(OpType.RELU, TensorX(0, 0)),
+    ]
+    dst = [_x(OpType.LINEAR, TensorX(-1, 0), make_params=fused_params, reuse_src=0)]
+    return GraphXfer(
+        name="linear_relu_fusion",
+        src_ops=src,
+        dst_ops=dst,
+        mapped_outputs={(1, 0): (0, 0)},
+    )
+
+
+def generate_all_pcg_xfers(
+    degrees: Sequence[int],
+    enable_parameter_parallel: bool = True,
+    enable_attribute_parallel: bool = False,
+) -> List[GraphXfer]:
+    """All built-in xfers for the given shard degrees (reference:
+    generate_all_pcg_xfers substitution.cc:1726-1840, generated per
+    divisor of the device count)."""
+    xfers: List[GraphXfer] = [create_linear_relu_fusion()]
+    for d in degrees:
+        if d < 2:
+            continue
+        if enable_parameter_parallel:
+            xfers.append(create_replicate_linear_combine(d))
+            xfers.append(create_partition_linear_combine(d))
+            xfers.append(create_partition_attention_combine(d))
+            xfers.append(create_replicate_embedding_combine(d))
+        xfers.append(create_partition_add_combine(d))
+        xfers.append(_partition_unary_combine(OpType.RELU, d))
+        xfers.append(_partition_unary_combine(OpType.SOFTMAX, d))
+        xfers.append(create_combine_inception(d))
+        if enable_attribute_parallel:
+            # partition spatial dims of conv/pool (reference:
+            # create_mapping_xfers<Conv2D/Pool2D> substitution.cc:1797-1800)
+            xfers.append(_partition_unary_combine(OpType.CONV2D, d, dim=2))
+            xfers.append(_partition_unary_combine(OpType.POOL2D, d, dim=2))
+    return xfers
+
+
+# ---------------------------------------------------------------------------
+# JSON rule loading (reference: substitution_loader.cc; format of
+# substitutions/graph_subst_3_v2.json)
+# ---------------------------------------------------------------------------
+
+_JSON_OP_MAP = {
+    "OP_LINEAR": OpType.LINEAR,
+    "OP_CONV2D": OpType.CONV2D,
+    "OP_POOL2D_MAX": OpType.POOL2D,
+    "OP_POOL2D_AVG": OpType.POOL2D,
+    "OP_RELU": OpType.RELU,
+    "OP_SIGMOID": OpType.SIGMOID,
+    "OP_TANH": OpType.TANH,
+    "OP_EW_ADD": OpType.EW_ADD,
+    "OP_EW_MUL": OpType.EW_MUL,
+    "OP_CONCAT": OpType.CONCAT,
+    "OP_SPLIT": OpType.SPLIT,
+    "OP_RESHAPE": OpType.RESHAPE,
+    "OP_TRANSPOSE": OpType.TRANSPOSE,
+    "OP_SOFTMAX": OpType.SOFTMAX,
+    "OP_MATMUL": OpType.BATCH_MATMUL,
+    "OP_BATCHNORM": OpType.BATCHNORM,
+    "OP_DROPOUT": OpType.DROPOUT,
+    "OP_MULTIHEAD_ATTENTION": OpType.MULTIHEAD_ATTENTION,
+    "OP_PARTITION": OpType.REPARTITION,
+    "OP_COMBINE": OpType.COMBINE,
+    "OP_REPLICATE": OpType.REPLICATE,
+    "OP_REDUCE": OpType.REDUCTION,
+    "OP_EMBEDDING": OpType.EMBEDDING,
+    "OP_NOOP": OpType.NOOP,
+}
+
+_PARALLEL_PARAM_MAKERS = {
+    OpType.REPARTITION: lambda dim, deg: RepartitionParams(dim=dim, degree=deg),
+    OpType.COMBINE: lambda dim, deg: CombineParams(dim=dim, degree=deg),
+    OpType.REPLICATE: lambda dim, deg: ReplicateParams(degree=deg),
+    OpType.REDUCTION: lambda dim, deg: ReductionParams(degree=deg),
+}
+
+
+def load_substitution_json(path: str) -> List[GraphXfer]:
+    """Load a reference-format rule collection (--substitution-json,
+    config.h:146; serde substitution_loader.cc create_xfers).
+
+    Rules whose op types have no analog here are skipped, mirroring the
+    reference's partial support for TASO exports.
+    """
+    with open(path) as f:
+        data = json.load(f)
+    rules = data["rule"] if isinstance(data, dict) else data
+    out: List[GraphXfer] = []
+    for rule in rules:
+        xfer = _rule_to_xfer(rule)
+        if xfer is not None:
+            out.append(xfer)
+    return out
+
+
+def _rule_to_xfer(rule: dict) -> Optional[GraphXfer]:
+    def parse_ops(op_list, is_dst: bool) -> Optional[List[OpX]]:
+        ops: List[OpX] = []
+        for op in op_list:
+            ot = _JSON_OP_MAP.get(op["type"])
+            if ot is None:
+                return None
+            inputs = tuple(
+                TensorX(t["opId"], t["tsId"]) if t["opId"] >= 0 else TensorX(-1, t["tsId"])
+                for t in op.get("input", [])
+            )
+            para = {p["key"]: p["value"] for p in op.get("para", [])}
+            dim = para.get("PM_PARALLEL_DIM", 0)
+            deg = para.get("PM_PARALLEL_DEGREE", 1)
+            make = None
+            if is_dst:
+                maker = _PARALLEL_PARAM_MAKERS.get(ot)
+                if maker is not None:
+                    make = (lambda mk, d_, g_: (lambda m: mk(d_, g_)))(maker, dim, deg)
+                else:
+                    # dest compute op: reuse params from the first matched
+                    # src op of the same type
+                    make = (lambda ot_: (
+                        lambda m: next((n.params for n in m if n.op_type == ot_), None)
+                    ))(ot)
+            constraints = {}
+            if not is_dst and ot in _PARALLEL_PARAM_MAKERS:
+                if "PM_PARALLEL_DEGREE" in para:
+                    constraints["degree"] = deg
+            ops.append(OpX(ot, inputs, constraints=constraints, make_params=make))
+        return ops
+
+    src = parse_ops(rule.get("srcOp", []), is_dst=False)
+    dst = parse_ops(rule.get("dstOp", []), is_dst=True)
+    if not src or not dst:
+        return None
+    mapped = {}
+    for mo in rule.get("mappedOutput", []):
+        mapped[(mo["srcOpId"], mo["srcTsId"])] = (mo["dstOpId"], mo["dstTsId"])
+    return GraphXfer(rule.get("name", "json_rule"), src, dst, mapped)
+
+
+# ---------------------------------------------------------------------------
+# best-first substitution search (reference: base_optimize
+# substitution.cc:2229-2311)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SearchStats:
+    candidates_explored: int = 0
+    best_cost: float = float("inf")
+    iterations: int = 0
+
+
+def base_optimize(
+    graph: PCGraph,
+    xfers: Sequence[GraphXfer],
+    cost_fn: Callable[[PCGraph], float],
+    budget: int = 100,
+    alpha: float = 1.05,
+    max_num_ops: Optional[int] = None,
+) -> Tuple[PCGraph, SearchStats]:
+    """Best-first search over substitution applications.
+
+    Reference semantics (substitution.cc:2229-2311): priority queue ordered
+    by cost; pop best, try every xfer at every match; candidates costing
+    more than alpha * best are pruned; stop after ``budget`` pops.
+    """
+    stats = SearchStats()
+    best_graph = graph
+    best_cost = cost_fn(graph)
+    stats.best_cost = best_cost
+    max_ops = max_num_ops or max(64, 2 * len(graph))
+    counter = itertools.count()
+    pq: List[Tuple[float, int, PCGraph]] = [(best_cost, next(counter), graph)]
+    seen = {graph.structural_hash()}
+    while pq and stats.iterations < budget:
+        cost, _, g = heapq.heappop(pq)
+        stats.iterations += 1
+        if cost > alpha * best_cost:
+            continue  # alpha pruning
+        for xfer in xfers:
+            for candidate in xfer.run(g):
+                if len(candidate) > max_ops:
+                    continue
+                h = candidate.structural_hash()
+                if h in seen:
+                    continue
+                seen.add(h)
+                stats.candidates_explored += 1
+                c = cost_fn(candidate)
+                if c < best_cost:
+                    best_cost = c
+                    best_graph = candidate
+                    stats.best_cost = c
+                if c < alpha * best_cost:
+                    heapq.heappush(pq, (c, next(counter), candidate))
+    return best_graph, stats

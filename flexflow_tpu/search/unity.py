@@ -1,0 +1,388 @@
+"""Unity search entry: substitution search + DP view assignment +
+memory-aware refinement, producing an executable ParallelStrategy.
+
+Reference call stack (SURVEY §3.1): FFModel::compile ->
+GRAPH_OPTIMIZE_TASK_ID -> PCG::Graph::graph_optimize_task (graph.cc:2047)
+-> GraphSearchHelper::graph_optimize (substitution.cc:1898) ->
+base_optimize (substitution.cc:2229) scored by Graph::optimal_cost
+(graph.cc:1742, recursive DP + simulator), with λ binary search for
+--memory-search (graph.cc:2075-2131, try_one_lambda :1883), then
+convert_graph_to_operators + per-weight NCCL communicator setup.
+
+TPU-native: the final (rewritten PCG, per-op views) pair is lowered to a
+ParallelStrategy — global mesh axis sizes (data, model) + per-node
+PartitionSpecs — by propagating shard state through the parallel ops.
+GSPMD then materializes the collectives the reference's parallel-op
+kernels performed.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+from ..config import FFConfig
+from ..core.graph import PCGraph
+from ..core.types import OpType, PARALLEL_OP_TYPES, ParameterSyncOption
+from ..ops.base import get_op_def
+from ..parallel.machine import MachineSpec, MachineView
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+from ..parallel.propagation import infer_all_specs
+from ..parallel.strategy import OpSharding, ParallelStrategy, SpecTuple, pspec, shard_weight_entry
+from .cost_model import CostModel
+from .dp_search import SearchHelper
+from .machine_model import build_machine_model
+from .mcmc import mcmc_optimize
+from .simulator import Simulator, allreduce_optimize
+from .substitution import base_optimize, generate_all_pcg_xfers, load_substitution_json
+
+
+@dataclasses.dataclass
+class SearchResult:
+    """What the search found (reference: GraphOptimalViewSerialized)."""
+
+    graph: Optional[PCGraph] = None  # rewritten PCG (with parallel ops)
+    views: Dict[int, MachineView] = dataclasses.field(default_factory=dict)
+    best_cost: float = 0.0  # simulated step seconds
+    candidates_explored: int = 0
+    memory_per_device: float = 0.0
+    lambda_used: float = 1.0
+    sync_options: Dict[int, ParameterSyncOption] = dataclasses.field(default_factory=dict)
+    allreduce_saved: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# shard-state propagation: PCG with parallel ops -> PartitionSpecs
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _ShardState:
+    """Degrees per logical dim + replica degree (the in-flight analog of
+    ParallelTensorBase's per-dim degree/is_replica_dim)."""
+
+    dims: List[int]
+    replica: int = 1
+
+    def copy(self) -> "_ShardState":
+        return _ShardState(list(self.dims), self.replica)
+
+
+def strategy_from_pcg(
+    graph: PCGraph,
+    views: Dict[int, MachineView],
+    num_devices: int,
+) -> ParallelStrategy:
+    """Lower (rewritten PCG, views) to mesh axes + per-node PartitionSpecs.
+
+    Batch-dim shard degrees ride the "data" axis; replica/parameter shard
+    degrees ride the "model" axis (reference: replica dims in
+    parallel_tensor.h:70 + the mapper's view fan-out; here the mapping is
+    direct to GSPMD).
+    """
+    specs = infer_all_specs(graph)
+    state: Dict[Tuple[int, int], _ShardState] = {}
+
+    def in_states(node) -> List[_ShardState]:
+        out = []
+        for e in graph.in_edges(node):
+            s = state.get((e.src, e.src_idx))
+            if s is None:
+                s = _ShardState([1] * len(specs[e.src][e.src_idx].shape))
+            out.append(s.copy())
+        return out
+
+    dp = 1
+    tp = 1
+    col_parallel_linears: set = set()
+    row_parallel_linears: set = set()
+    head_parallel_attn: set = set()
+    sharded_embeddings: set = set()
+
+    for node in graph.topo_order():
+        out_specs = specs[node.guid]
+        ins = in_states(node)
+        view = views.get(node.guid)
+        nparts = view.num_parts if view else 1
+        if node.op_type == OpType.INPUT or node.op_type == OpType.WEIGHT:
+            st = _ShardState([1] * len(out_specs[0].shape))
+            if node.op_type == OpType.INPUT and st.dims and nparts > 1:
+                if out_specs[0].shape[0] % nparts == 0:
+                    st.dims[0] = nparts
+                    dp = max(dp, nparts)
+            state[(node.guid, 0)] = st
+            continue
+        if node.op_type == OpType.REPARTITION:
+            st = ins[0] if ins else _ShardState([1])
+            dim = node.params.dim if node.params.dim >= 0 else len(st.dims) + node.params.dim
+            st.dims[dim] *= node.params.degree
+            if dim == 0:
+                dp = max(dp, st.dims[0])
+            else:
+                tp = max(tp, node.params.degree)
+                if dim == len(st.dims) - 1:
+                    # input-dim partition feeding a linear -> row parallel
+                    for e in graph.out_edges(node):
+                        if graph.nodes[e.dst].op_type == OpType.LINEAR:
+                            row_parallel_linears.add(e.dst)
+            state[(node.guid, 0)] = st
+            continue
+        if node.op_type == OpType.COMBINE:
+            st = ins[0] if ins else _ShardState([1])
+            dim = node.params.dim if node.params.dim >= 0 else len(st.dims) + node.params.dim
+            st.dims[dim] = 1
+            state[(node.guid, 0)] = st
+            continue
+        if node.op_type == OpType.REPLICATE:
+            st = ins[0] if ins else _ShardState([1])
+            st.replica *= node.params.degree
+            tp = max(tp, node.params.degree)
+            for e in graph.out_edges(node):
+                dst = graph.nodes[e.dst]
+                if dst.op_type == OpType.LINEAR:
+                    col_parallel_linears.add(e.dst)
+                elif dst.op_type == OpType.MULTIHEAD_ATTENTION:
+                    head_parallel_attn.add(e.dst)
+                elif dst.op_type == OpType.EMBEDDING:
+                    sharded_embeddings.add(e.dst)
+            state[(node.guid, 0)] = st
+            continue
+        if node.op_type in (OpType.REDUCTION, OpType.ALLREDUCE):
+            st = ins[0] if ins else _ShardState([1])
+            st.replica = max(1, st.replica // node.params.degree)
+            state[(node.guid, 0)] = st
+            continue
+        if node.op_type == OpType.FUSED_PARALLEL:
+            st = ins[0] if ins else _ShardState([1])
+            state[(node.guid, 0)] = st
+            continue
+        # compute ops
+        if node.op_type == OpType.LINEAR and ins:
+            st_in = ins[0]
+            st = _ShardState([1] * len(out_specs[0].shape))
+            for i in range(min(len(st_in.dims), len(st.dims)) - 1):
+                st.dims[i] = st_in.dims[i]
+            if st_in.replica > 1:  # column parallel: out dim sharded
+                st.dims[-1] = st_in.replica
+            if st_in.dims and st_in.dims[-1] > 1:  # row parallel: partials
+                st.replica = st_in.dims[-1]
+            state[(node.guid, 0)] = st
+            continue
+        if node.op_type == OpType.EMBEDDING and ins:
+            st_in = ins[0]
+            st = _ShardState([1] * len(out_specs[0].shape))
+            for i in range(min(len(st_in.dims), len(st.dims)) - 1):
+                st.dims[i] = st_in.dims[i]
+            if st_in.replica > 1:  # column parallel over the embedding dim
+                st.dims[-1] = st_in.replica
+            state[(node.guid, 0)] = st
+            continue
+        if node.op_type == OpType.MULTIHEAD_ATTENTION and ins:
+            st_in = ins[0]
+            st = _ShardState([1] * len(out_specs[0].shape))
+            for i in range(min(len(st_in.dims), len(st.dims)) - 1):
+                st.dims[i] = st_in.dims[i]
+            if st_in.replica > 1:  # head parallel -> partial sums after wo
+                st.replica = st_in.replica
+            state[(node.guid, 0)] = st
+            continue
+        # default: elementwise/shape ops propagate input 0's state per dim
+        st = ins[0].copy() if ins else _ShardState([1] * len(out_specs[0].shape))
+        nd = len(out_specs[0].shape)
+        if len(st.dims) != nd:
+            carry = st.dims[0] if st.dims else 1
+            st = _ShardState([carry] + [1] * (nd - 1), st.replica)
+        for i, o in enumerate(range(len(out_specs))):
+            state[(node.guid, i)] = st.copy()
+        state[(node.guid, 0)] = st
+        continue
+
+    # fit mesh: dp * tp <= num_devices
+    tp = max(1, tp)
+    if tp > num_devices:
+        tp = 1
+    dp = max(1, min(dp, num_devices // tp))
+    strategy = ParallelStrategy(axis_sizes={DATA_AXIS: dp, MODEL_AXIS: tp})
+
+    for node in graph.topo_order():
+        out_specs = specs[node.guid]
+        in_specs = [specs[e.src][e.src_idx] for e in graph.in_edges(node)]
+        try:
+            wspecs = get_op_def(node.op_type).weight_specs(node.params, in_specs)
+        except Exception:
+            wspecs = []
+        weights: Dict[str, Optional[SpecTuple]] = {w.name: None for w in wspecs}
+        by_name = {w.name: w for w in wspecs}
+
+        def shard_weight(wname: str, dim: int):
+            shard_weight_entry(weights, by_name, wname, dim, MODEL_AXIS, tp)
+
+        if node.guid in col_parallel_linears:
+            shard_weight("kernel", 1)
+            shard_weight("bias", 0)
+        elif node.guid in row_parallel_linears:
+            shard_weight("kernel", 0)
+        elif node.guid in head_parallel_attn:
+            for wn in ("wq", "wk", "wv"):
+                shard_weight(wn, 1)
+            for wn in ("bq", "bk", "bv"):
+                shard_weight(wn, 0)
+            shard_weight("wo", 0)
+        elif node.guid in sharded_embeddings:
+            shard_weight("embedding", 1)  # column parallel over out_dim
+
+        outputs: List[Optional[SpecTuple]] = []
+        for idx, os in enumerate(out_specs):
+            st = state.get((node.guid, idx))
+            if st is None or node.op_type == OpType.WEIGHT:
+                outputs.append(None)
+                continue
+            if st.replica > 1:
+                # partial-sum tensor: leave unconstrained, GSPMD resolves at
+                # the downstream Reduction (reference: replica dims)
+                outputs.append(None)
+                continue
+            axes: List[Optional[str]] = [None] * os.ndim
+            used_model = False
+            for i, deg in enumerate(st.dims[: os.ndim]):
+                if deg <= 1:
+                    continue
+                if i == 0 and dp > 1 and os.shape[0] % dp == 0:
+                    axes[0] = DATA_AXIS
+                elif not used_model and tp > 1 and os.shape[i] % tp == 0:
+                    axes[i] = MODEL_AXIS
+                    used_model = True
+            if any(a is not None for a in axes):
+                outputs.append(pspec(*axes))
+            else:
+                outputs.append(None)
+        strategy.node_shardings[node.guid] = OpSharding(
+            outputs=outputs,
+            weights=weights,
+            machine_view_hash=views.get(node.guid, MachineView(0, (1,), (1,))).to_hash(),
+        )
+    return strategy
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def unity_optimize(
+    graph: PCGraph,
+    config: FFConfig,
+    machine: Optional[MachineSpec] = None,
+) -> Tuple[ParallelStrategy, SearchResult]:
+    """Full Unity search (reference: graph_optimize_task graph.cc:2047).
+
+    1. generate xfers for every power-of-two degree dividing num_devices;
+    2. best-first substitution search scored by the DP + simulator;
+    3. memory-aware λ binary search when --memory-search
+       (graph.cc:2075-2131);
+    4. (fork) allreduce-schedule optimization when a topo file is given
+       (model.cc:3081-3089);
+    5. lower the winner to a ParallelStrategy.
+    """
+    num_devices = config.num_devices
+    if machine is None:
+        per_node = max(1, num_devices // max(1, config.num_nodes))
+        machine = MachineSpec(num_nodes=config.num_nodes, devices_per_node=per_node)
+    if config.search_num_nodes > 0 or config.search_num_workers > 0:
+        machine = MachineSpec(
+            num_nodes=config.search_num_nodes if config.search_num_nodes > 0 else machine.num_nodes,
+            devices_per_node=config.search_num_workers
+            if config.search_num_workers > 0
+            else machine.devices_per_node,
+            chip=machine.chip,
+        )
+        num_devices = machine.num_devices
+
+    cost_model = CostModel(machine)
+    machine_model = build_machine_model(
+        machine,
+        version=config.machine_model_version,
+        machine_model_file=config.machine_model_file,
+        topo_file=config.topo_file,
+    )
+    simulator = Simulator(
+        machine,
+        cost_model,
+        machine_model,
+        segment_size=config.simulator_segment_size,
+        max_num_segments=config.simulator_max_num_segments,
+    )
+    helper = SearchHelper(machine, cost_model, simulator)
+
+    degrees = []
+    d = 2
+    while d <= num_devices:
+        if num_devices % d == 0:
+            degrees.append(d)
+        d *= 2
+    xfers = generate_all_pcg_xfers(
+        degrees,
+        enable_parameter_parallel=config.enable_parameter_parallel
+        or not config.only_data_parallel,
+        enable_attribute_parallel=config.enable_attribute_parallel,
+    )
+    if config.substitution_json_path:
+        xfers = xfers + load_substitution_json(config.substitution_json_path)
+
+    def runtime_cost(g: PCGraph) -> float:
+        return helper.optimal_cost(g).cost
+
+    budget = config.search_budget if config.search_budget > 0 else 10
+    best_graph, stats = base_optimize(
+        graph,
+        xfers,
+        runtime_cost,
+        budget=budget,
+        alpha=config.search_alpha,
+        max_num_ops=max(64, config.base_optimize_threshold * max(1, len(graph))),
+    )
+    result_dp = helper.optimal_cost(best_graph)
+    lam = 1.0
+
+    # memory-aware λ search (reference: graph.cc:2075-2131): if the
+    # runtime-optimal strategy exceeds per-device HBM, binary-search a
+    # runtime/memory tradeoff weight and re-run the substitution search
+    if config.memory_search:
+        capacity = machine.chip.hbm_capacity
+        if result_dp.memory_per_device > capacity:
+            lo, hi = 0.0, 1.0
+            for _ in range(8):
+                lam = (lo + hi) / 2
+
+                def blended(g: PCGraph) -> float:
+                    r = helper.optimal_cost(g)
+                    return lam * r.cost + (1 - lam) * (r.memory_per_device / capacity) * r.cost
+
+                cand_graph, cand_stats = base_optimize(
+                    graph, xfers, blended, budget=budget, alpha=config.search_alpha
+                )
+                cand_dp = helper.optimal_cost(cand_graph)
+                if cand_dp.memory_per_device <= capacity:
+                    best_graph, result_dp = cand_graph, cand_dp
+                    lo = lam  # try weighting runtime more
+                else:
+                    hi = lam
+
+    views = result_dp.views
+    sync_options: Dict[int, ParameterSyncOption] = {}
+    saved = 0.0
+    if config.topo_file or config.allreduce_optimize:
+        sync_options, saved = allreduce_optimize(best_graph, views, machine_model, cost_model)
+
+    strategy = strategy_from_pcg(best_graph, views, num_devices)
+    result = SearchResult(
+        graph=best_graph,
+        views=views,
+        best_cost=result_dp.cost,
+        candidates_explored=stats.candidates_explored,
+        memory_per_device=result_dp.memory_per_device,
+        lambda_used=lam,
+        sync_options=sync_options,
+        allreduce_saved=saved,
+    )
+    return strategy, result
