@@ -1,0 +1,1 @@
+"""Frontends: keras, torch (fx), onnx (reference: python/flexflow/)."""

@@ -1,0 +1,54 @@
+"""flexflow_tpu.frontends.keras — tf.keras-style frontend.
+
+Reference: python/flexflow/keras/ (~4000 LoC): Sequential + functional
+Model over the FFModel graph API. Importable as
+``from flexflow_tpu.frontends import keras`` with the usual
+``keras.layers`` / ``keras.models`` / ... submodule layout.
+"""
+from . import callbacks, datasets, initializers, layers, losses, metrics, models, optimizers
+from .layers import (
+    Activation,
+    Add,
+    AveragePooling2D,
+    BatchNormalization,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    Input,
+    InputLayer,
+    LayerNormalization,
+    Maximum,
+    MaxPooling2D,
+    Minimum,
+    Multiply,
+    Permute,
+    Reshape,
+    Subtract,
+    add,
+    concatenate,
+    multiply,
+    subtract,
+)
+from .models import Model, Sequential
+from .optimizers import SGD, Adam
+from .tensor import KerasTensor
+
+__all__ = [
+    "Model",
+    "Sequential",
+    "Input",
+    "KerasTensor",
+    "SGD",
+    "Adam",
+    "layers",
+    "models",
+    "optimizers",
+    "losses",
+    "metrics",
+    "callbacks",
+    "initializers",
+    "datasets",
+]

@@ -23,8 +23,14 @@ from .base import LowerCtx, OpCost, OpDef, WeightSpec, io_cost, register_op
 from .elementwise import apply_activation
 
 
+def _pad2(p):
+    """Padding entry: int (symmetric) or (before, after) pair."""
+    return (p, p) if isinstance(p, int) else tuple(p)
+
+
 def _out_dim(size, kernel, stride, pad):
-    return (size + 2 * pad - kernel) // stride + 1
+    lo, hi = _pad2(pad)
+    return (size + lo + hi - kernel) // stride + 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -75,7 +81,7 @@ class Conv2DOp(OpDef):
             x,
             weights["kernel"],
             window_strides=params.stride,
-            padding=[(params.padding[0], params.padding[0]), (params.padding[1], params.padding[1])],
+            padding=[_pad2(params.padding[0]), _pad2(params.padding[1])],
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=params.groups,
             preferred_element_type=jnp.float32,
@@ -121,7 +127,7 @@ class Pool2DOp(OpDef):
     @staticmethod
     def lower(params: Pool2DParams, inputs, weights, ctx):
         (x,) = inputs
-        pads = ((0, 0), (0, 0), (params.padding[0], params.padding[0]), (params.padding[1], params.padding[1]))
+        pads = ((0, 0), (0, 0), _pad2(params.padding[0]), _pad2(params.padding[1]))
         dims = (1, 1) + tuple(params.kernel)
         strides = (1, 1) + tuple(params.stride)
         if params.pool_type == PoolType.MAX:

@@ -77,8 +77,7 @@ def ring_attention(
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, t):
-        kc, vc, m, l, acc = carry
+    def attend(m, l, acc, kc, vc, t):
         src_chunk = (my - t) % n  # which global chunk we currently hold
         if causal:
             k_pos = src_chunk * sk_local + jnp.arange(sk_local)
@@ -92,14 +91,22 @@ def ring_attention(
         c_new = jnp.where(mc <= NEG_INF / 2, 0.0, jnp.exp(mc - m_new))
         l_out = l * c_old + lc * c_new
         acc_out = acc * jnp.swapaxes(c_old, 1, 2)[..., None] + oc * jnp.swapaxes(c_new, 1, 2)[..., None]
+        return m_new, l_out, acc_out
+
+    def step(carry, t):
+        kc, vc, m, l, acc = carry
+        m, l, acc = attend(m, l, acc, kc, vc, t)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        return (kc, vc, m_new, l_out, acc_out), None
+        return (kc, vc, m, l, acc), None
 
     m0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s_local), jnp.float32)
     acc0 = jnp.zeros((b, s_local, h, d), jnp.float32)
-    (kc, vc, m, l, acc), _ = jax.lax.scan(step, (k, v, m0, l0, acc0), jnp.arange(n))
+    # n-1 rotating steps, then attend to the last-held chunk without the
+    # final ppermute pair (whose result would be discarded)
+    (kc, vc, m, l, acc), _ = jax.lax.scan(step, (k, v, m0, l0, acc0), jnp.arange(n - 1))
+    m, l, acc = attend(m, l, acc, kc, vc, n - 1)
     l = jnp.maximum(l, 1e-30)
     out = acc / jnp.swapaxes(l, 1, 2)[..., None]
     return out.astype(q.dtype)

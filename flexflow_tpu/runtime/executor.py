@@ -183,6 +183,13 @@ class CompiledExecutor:
             self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     # ---------------------------------------------------------------- API
+    def set_learning_rate(self, lr: float) -> None:
+        """Adjust lr in-place (it lives in opt_state as a traced scalar, so
+        this does not invalidate the jit cache — reference:
+        flexflow_c.cc set_learning_rate / keras LearningRateScheduler)."""
+        if self.opt_state is not None and "lr" in self.opt_state:
+            self.opt_state["lr"] = jnp.asarray(lr, jnp.float32)
+
     def train_batch(self, inputs: Sequence[jax.Array], label: jax.Array, rng: jax.Array) -> Dict[str, Any]:
         inputs = self._shard_inputs(inputs)
         self.params, self.opt_state, self.state, mets = self._train_step(

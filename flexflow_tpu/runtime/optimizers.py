@@ -44,35 +44,41 @@ class SGDOptimizer(Optimizer):
     weight_decay: float = 0.0
 
     def init_state(self, params):
+        # lr lives in opt_state (a traced scalar) so LR schedules/callbacks
+        # can adjust it without invalidating the jit cache
+        lr = jnp.asarray(self.lr, jnp.float32)
         if self.momentum == 0.0:
-            return {"v": None, "step": jnp.zeros((), jnp.int32)}
+            return {"v": None, "step": jnp.zeros((), jnp.int32), "lr": lr}
         return {
             "v": jax.tree.map(jnp.zeros_like, params),
             "step": jnp.zeros((), jnp.int32),
+            "lr": lr,
         }
 
     def apply(self, params, grads, opt_state):
+        lr = opt_state.get("lr", self.lr)
+
         def upd(p, g, v):
             g = g + self.weight_decay * p
             if self.momentum > 0.0:
                 v = self.momentum * v + g
                 g = g + self.momentum * v if self.nesterov else v
-            return (p - self.lr * g).astype(p.dtype), v
+            return (p - lr * g).astype(p.dtype), v
 
         if self.momentum == 0.0:
             new_params = jax.tree.map(
-                lambda p, g: (p - self.lr * (g + self.weight_decay * p)).astype(p.dtype),
+                lambda p, g: (p - lr * (g + self.weight_decay * p)).astype(p.dtype),
                 params,
                 grads,
             )
-            return new_params, {"v": None, "step": opt_state["step"] + 1}
+            return new_params, {"v": None, "step": opt_state["step"] + 1, "lr": lr}
         flat_p, treedef = jax.tree.flatten(params)
         flat_g = treedef.flatten_up_to(grads)
         flat_v = treedef.flatten_up_to(opt_state["v"])
         out = [upd(p, g, v) for p, g, v in zip(flat_p, flat_g, flat_v)]
         new_params = treedef.unflatten([o[0] for o in out])
         new_v = treedef.unflatten([o[1] for o in out])
-        return new_params, {"v": new_v, "step": opt_state["step"] + 1}
+        return new_params, {"v": new_v, "step": opt_state["step"] + 1, "lr": lr}
 
 
 @dataclasses.dataclass
@@ -94,12 +100,14 @@ class AdamOptimizer(Optimizer):
             "m": jax.tree.map(jnp.zeros_like, params),
             "v": jax.tree.map(jnp.zeros_like, params),
             "step": jnp.zeros((), jnp.int32),
+            "lr": jnp.asarray(self.alpha, jnp.float32),
         }
 
     def apply(self, params, grads, opt_state):
         t = opt_state["step"] + 1
         tf = t.astype(jnp.float32)
-        alpha_t = self.alpha * jnp.sqrt(1.0 - self.beta2**tf) / (1.0 - self.beta1**tf)
+        alpha = opt_state.get("lr", self.alpha)
+        alpha_t = alpha * jnp.sqrt(1.0 - self.beta2**tf) / (1.0 - self.beta1**tf)
 
         def upd(p, g, m, v):
             g = g + self.weight_decay * p
@@ -116,4 +124,4 @@ class AdamOptimizer(Optimizer):
         new_params = treedef.unflatten([o[0] for o in out])
         new_m = treedef.unflatten([o[1] for o in out])
         new_v = treedef.unflatten([o[2] for o in out])
-        return new_params, {"m": new_m, "v": new_v, "step": t}
+        return new_params, {"m": new_m, "v": new_v, "step": t, "lr": alpha}

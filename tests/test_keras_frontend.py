@@ -1,0 +1,145 @@
+"""Keras frontend tests.
+
+Reference analog: examples/python/keras/ (func_mnist_mlp.py,
+seq_mnist_cnn.py, func_cifar10_cnn_concat.py etc.) — Sequential and
+functional models built through the keras API must train end-to-end.
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.frontends import keras
+
+
+def small_config(bs=32):
+    return FFConfig(batch_size=bs, epochs=1, printing_interval=1000)
+
+
+def test_sequential_mlp_trains():
+    (x, y), _ = keras.datasets.mnist.load_data(n_train=256, n_test=8)
+    x = x.reshape(256, 784).astype(np.float32) / 255.0
+    y = y.astype(np.int32)
+    model = keras.Sequential(
+        [
+            keras.Dense(64, activation="relu", input_shape=(784,)),
+            keras.Dense(10),
+            keras.Activation("softmax"),
+        ]
+    )
+    model.compile(
+        optimizer=keras.SGD(learning_rate=0.05),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy", "sparse_categorical_crossentropy"],
+        config=small_config(),
+    )
+    hist = model.fit(x, y, epochs=2, batch_size=32, verbose=False)
+    assert len(hist) == 2
+    perf = model.evaluate(x, y, batch_size=32)
+    assert 0.0 <= perf.accuracy <= 1.0
+
+
+def test_functional_cnn_concat():
+    cfg = small_config(bs=16)
+    inp = keras.Input(shape=(3, 16, 16))
+    a = keras.Conv2D(8, 3, padding="same", activation="relu")(inp)
+    b = keras.Conv2D(8, 3, padding="same", activation="relu")(inp)
+    c = keras.Concatenate(axis=1)([a, b])
+    c = keras.MaxPooling2D()(c)
+    c = keras.Flatten()(c)
+    out = keras.Dense(10, activation="softmax")(c)
+    model = keras.Model(inp, out)
+    model.compile(
+        optimizer=keras.Adam(learning_rate=0.001),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+        config=cfg,
+    )
+    x = np.random.RandomState(0).rand(64, 3, 16, 16).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, size=(64,)).astype(np.int32)
+    model.fit(x, y, epochs=1, batch_size=16, verbose=False)
+    preds = model.predict(x[:16])
+    assert preds.shape == (16, 10)
+
+
+def test_merge_layers_and_summary(capsys):
+    inp = keras.Input(shape=(8,))
+    d1 = keras.Dense(8)(inp)
+    d2 = keras.Dense(8)(inp)
+    s = keras.Add()([d1, d2])
+    m = keras.Multiply()([d1, d2])
+    out = keras.Dense(2, activation="softmax")(keras.Subtract()([s, m]))
+    model = keras.Model(inp, out)
+    model.compile(optimizer=keras.SGD(), loss="mse", config=small_config(bs=8))
+    model.summary()
+    captured = capsys.readouterr()
+    assert "dense" in captured.out
+    x = np.random.rand(16, 8).astype(np.float32)
+    y = np.random.rand(16, 2).astype(np.float32)
+    model.fit(x, y, epochs=1, batch_size=8, verbose=False)
+
+
+def test_lr_scheduler_callback():
+    model = keras.Sequential([keras.Dense(4, input_shape=(4,)), keras.Activation("softmax")])
+    model.compile(optimizer=keras.SGD(learning_rate=0.1), loss="mse", config=small_config(bs=8))
+    seen = []
+
+    def schedule(epoch):
+        lr = 0.1 / (epoch + 1)
+        seen.append(lr)
+        return lr
+
+    x = np.random.rand(16, 4).astype(np.float32)
+    y = np.random.rand(16, 4).astype(np.float32)
+    model.fit(x, y, epochs=3, batch_size=8, verbose=False, callbacks=[keras.callbacks.LearningRateScheduler(schedule)])
+    assert seen == [0.1, 0.05, 0.1 / 3]
+    assert abs(float(model.ffmodel.executor.opt_state["lr"]) - 0.1 / 3) < 1e-7
+
+
+def test_embedding_reuters_mlp():
+    (x, y), _ = keras.datasets.reuters.load_data(num_words=100, maxlen=16, n_train=64, n_test=8)
+    model = keras.Sequential(
+        [
+            keras.InputLayer(shape=(16,), dtype="int32"),
+            keras.Embedding(100, 8),
+            keras.Flatten(),
+            keras.Dense(46, activation="softmax"),
+        ]
+    )
+    model.compile(
+        optimizer=keras.Adam(),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+        config=small_config(bs=16),
+    )
+    model.fit(x, y.astype(np.int32), epochs=1, batch_size=16, verbose=False)
+
+
+def test_weights_survive_batch_size_change():
+    model = keras.Sequential([keras.Dense(4, input_shape=(4,)), keras.Activation("softmax")])
+    model.compile(optimizer=keras.SGD(learning_rate=0.1), loss="mse", config=small_config(bs=8))
+    x = np.random.RandomState(0).rand(16, 4).astype(np.float32)
+    y = np.random.RandomState(1).rand(16, 4).astype(np.float32)
+    model.fit(x, y, epochs=1, batch_size=8, verbose=False)
+    w_before = model.layers[0].get_weights(model)
+    preds = model.predict(x[:12])  # different batch size -> rebuild
+    assert preds.shape == (12, 4)
+    w_after = model.layers[0].get_weights(model)
+    assert set(w_before) == {"kernel", "bias"}
+    np.testing.assert_allclose(w_before["kernel"], w_after["kernel"])
+
+
+def test_shared_layer_raises():
+    d = keras.Dense(4)
+    inp = keras.Input(shape=(4,))
+    d(inp)
+    with pytest.raises(NotImplementedError):
+        d(inp)
+
+
+def test_same_padding_matches_keras_shapes():
+    # pool 2 stride 2 on 32: Keras gives 16 (not 17)
+    inp = keras.Input(shape=(3, 32, 32))
+    out = keras.MaxPooling2D(pool_size=2, strides=2, padding="same")(inp)
+    assert out.shape == (None, 3, 16, 16)
+    out2 = keras.Conv2D(4, 3, strides=2, padding="same")(inp)
+    assert out2.shape == (None, 4, 16, 16)
