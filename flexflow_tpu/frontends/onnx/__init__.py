@@ -1,0 +1,7 @@
+"""flexflow_tpu.frontends.onnx — ONNX graph importer.
+
+Reference: python/flexflow/onnx/model.py (375 LoC).
+"""
+from .model import ONNXModel, onnx_to_flexflow
+
+__all__ = ["ONNXModel", "onnx_to_flexflow"]

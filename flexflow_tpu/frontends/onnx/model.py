@@ -1,0 +1,238 @@
+"""ONNX graph -> FFModel importer.
+
+Reference: python/flexflow/onnx/model.py — per-op ``handleX`` dispatch
+over a ModelProto's graph (handleConv :149, handleDense/Gemm :194,
+handleMaxPool :202, Add/Sub/Mul/Concat/Split/Softmax/Reshape/... ).
+
+The ``onnx`` package is not in this image, so the importer accepts any
+object with the ModelProto structure (graph.node / graph.input /
+graph.initializer, nodes with op_type/input/output/attribute). Real
+.onnx files load when onnx is installed; tests exercise the dispatch
+with lightweight mock protos.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...core.types import DataType, PoolType
+
+try:
+    import onnx
+
+    HAS_ONNX = True
+except Exception:
+    onnx = None
+    HAS_ONNX = False
+
+# ONNX TensorProto elem_type codes (onnx.TensorProto enum values)
+_ELEM_TYPE = {1: DataType.FLOAT, 6: DataType.INT32, 7: DataType.INT64, 10: DataType.HALF, 11: DataType.DOUBLE, 16: DataType.BFLOAT16}
+
+
+def _attrs(node) -> Dict[str, object]:
+    out = {}
+    for a in node.attribute:
+        # AttributeProto: type 1=FLOAT 2=INT 3=STRING 6=FLOATS 7=INTS
+        if a.type == 2:
+            out[a.name] = int(a.i)
+        elif a.type == 1:
+            out[a.name] = float(a.f)
+        elif a.type == 7:
+            out[a.name] = [int(v) for v in a.ints]
+        elif a.type == 6:
+            out[a.name] = [float(v) for v in a.floats]
+        elif a.type == 3:
+            out[a.name] = a.s.decode() if isinstance(a.s, bytes) else str(a.s)
+    return out
+
+
+class ONNXModel:
+    """Reference: ONNXModel (onnx/model.py:56)."""
+
+    def __init__(self, model):
+        """model: a loaded ModelProto, a mock with the same structure, or
+        a path to a .onnx file (requires the onnx package)."""
+        if isinstance(model, str):
+            assert HAS_ONNX, "onnx package not available to parse files"
+            model = onnx.load(model)
+        self.model = model
+        self.inputs: Dict[str, object] = {}
+        self.initializers: Dict[str, np.ndarray] = {}
+
+    def apply(self, ffmodel, input_tensors: Dict[str, object]) -> List:
+        """Replay the graph; input_tensors maps graph input name -> ff
+        Tensor. Returns the graph outputs (reference: ONNXModel.apply)."""
+        graph = self.model.graph
+        env: Dict[str, object] = dict(input_tensors)
+        for init in graph.initializer:
+            self.initializers[init.name] = _to_numpy(init)
+        for node in graph.node:
+            handler = getattr(self, f"handle{node.op_type}", None)
+            if handler is None:
+                raise NotImplementedError(f"unsupported ONNX op {node.op_type}")
+            outs = handler(ffmodel, node, env)
+            if outs is None:
+                continue
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            for name, t in zip(node.output, outs):
+                env[name] = t
+        return [env[o.name] for o in graph.output]
+
+    # -- elementwise --------------------------------------------------
+    def handleAdd(self, ff, node, env):
+        return ff.add(env[node.input[0]], env[node.input[1]], name=node.name)
+
+    def handleSub(self, ff, node, env):
+        return ff.subtract(env[node.input[0]], env[node.input[1]], name=node.name)
+
+    def handleMul(self, ff, node, env):
+        return ff.multiply(env[node.input[0]], env[node.input[1]], name=node.name)
+
+    def handleDiv(self, ff, node, env):
+        return ff.divide(env[node.input[0]], env[node.input[1]], name=node.name)
+
+    def handleRelu(self, ff, node, env):
+        return ff.relu(env[node.input[0]], name=node.name)
+
+    def handleSigmoid(self, ff, node, env):
+        return ff.sigmoid(env[node.input[0]], name=node.name)
+
+    def handleTanh(self, ff, node, env):
+        return ff.tanh(env[node.input[0]], name=node.name)
+
+    def handleElu(self, ff, node, env):
+        return ff.elu(env[node.input[0]], name=node.name)
+
+    def handleExp(self, ff, node, env):
+        return ff.exp(env[node.input[0]], name=node.name)
+
+    def handleSoftmax(self, ff, node, env):
+        axis = _attrs(node).get("axis", -1)
+        return ff.softmax(env[node.input[0]], axis=axis, name=node.name)
+
+    # -- shape ops ----------------------------------------------------
+    def handleConcat(self, ff, node, env):
+        axis = _attrs(node).get("axis", 0)
+        return ff.concat([env[i] for i in node.input], axis, name=node.name)
+
+    def handleSplit(self, ff, node, env):
+        at = _attrs(node)
+        axis = at.get("axis", 0)
+        sizes = at.get("split")
+        if sizes is None and len(node.input) > 1 and node.input[1] in self.initializers:
+            sizes = [int(v) for v in self.initializers[node.input[1]]]
+        assert sizes is not None, "Split without sizes unsupported"
+        return ff.split(env[node.input[0]], sizes, axis, name=node.name)
+
+    def handleFlatten(self, ff, node, env):
+        return ff.flat(env[node.input[0]], name=node.name)
+
+    def handleReshape(self, ff, node, env):
+        shape = self.initializers.get(node.input[1])
+        assert shape is not None, "Reshape shape must be a constant initializer"
+        shape = [int(s) for s in shape]
+        x = env[node.input[0]]
+        if -1 in shape or 0 in shape:
+            total = int(np.prod(x.shape))
+            shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+            known = int(np.prod([s for s in shape if s != -1]))
+            shape = [total // known if s == -1 else s for s in shape]
+        return ff.reshape(x, tuple(shape), name=node.name)
+
+    def handleTranspose(self, ff, node, env):
+        perm = _attrs(node)["perm"]
+        return ff.transpose(env[node.input[0]], tuple(perm), name=node.name)
+
+    def handleCast(self, ff, node, env):
+        to = _ELEM_TYPE[_attrs(node)["to"]]
+        return ff.cast(env[node.input[0]], to, name=node.name)
+
+    def handleDropout(self, ff, node, env):
+        rate = _attrs(node).get("ratio", 0.5)
+        return ff.dropout(env[node.input[0]], rate, name=node.name)
+
+    def handleIdentity(self, ff, node, env):
+        return ff.identity(env[node.input[0]], name=node.name)
+
+    # -- conv/pool/norm ----------------------------------------------
+    def handleConv(self, ff, node, env):
+        at = _attrs(node)
+        w = self.initializers.get(node.input[1])
+        assert w is not None, "Conv weight must be an initializer"
+        out_c, _, kh, kw = w.shape
+        strides = at.get("strides", [1, 1])
+        pads = at.get("pads", [0, 0, 0, 0])  # [top, left, bottom, right]
+        ph = (pads[0], pads[2]) if pads[0] != pads[2] else pads[0]
+        pw = (pads[1], pads[3]) if pads[1] != pads[3] else pads[1]
+        groups = at.get("group", 1)
+        use_bias = len(node.input) > 2
+        return ff.conv2d(
+            env[node.input[0]], out_c, kh, kw, strides[0], strides[1], ph, pw,
+            groups=groups, use_bias=use_bias, name=node.name,
+        )
+
+    def _pool(self, ff, node, env, pool_type):
+        at = _attrs(node)
+        k = at["kernel_shape"]
+        strides = at.get("strides", k)
+        pads = at.get("pads", [0, 0, 0, 0])
+        ph = (pads[0], pads[2]) if pads[0] != pads[2] else pads[0]
+        pw = (pads[1], pads[3]) if pads[1] != pads[3] else pads[1]
+        return ff.pool2d(env[node.input[0]], k[0], k[1], strides[0], strides[1], ph, pw, pool_type=pool_type, name=node.name)
+
+    def handleMaxPool(self, ff, node, env):
+        return self._pool(ff, node, env, PoolType.MAX)
+
+    def handleAveragePool(self, ff, node, env):
+        return self._pool(ff, node, env, PoolType.AVG)
+
+    def handleGlobalAveragePool(self, ff, node, env):
+        x = env[node.input[0]]
+        h, w = x.shape[2], x.shape[3]
+        return ff.pool2d(x, h, w, 1, 1, 0, 0, pool_type=PoolType.AVG, name=node.name)
+
+    def handleBatchNormalization(self, ff, node, env):
+        return ff.batch_norm(env[node.input[0]], relu=False, name=node.name)
+
+    # -- linear -------------------------------------------------------
+    def handleGemm(self, ff, node, env):
+        """Gemm(x, W, b): W is [out, in] when transB=1 (the common export)."""
+        at = _attrs(node)
+        w = self.initializers.get(node.input[1])
+        assert w is not None
+        out_dim = w.shape[0] if at.get("transB", 0) else w.shape[1]
+        use_bias = len(node.input) > 2
+        return ff.dense(env[node.input[0]], out_dim, use_bias=use_bias, name=node.name)
+
+    def handleMatMul(self, ff, node, env):
+        """MatMul with constant rhs = dense; tensor×tensor = batch_matmul
+        (reference: onnx/model.py:309)."""
+        rhs = node.input[1]
+        if rhs in self.initializers:
+            w = self.initializers[rhs]
+            return ff.dense(env[node.input[0]], w.shape[-1], use_bias=False, name=node.name)
+        return ff.batch_matmul(env[node.input[0]], env[rhs], name=node.name)
+
+
+def _to_numpy(init) -> np.ndarray:
+    """TensorProto -> ndarray (uses onnx.numpy_helper when available,
+    raw_data/float_data fields on mocks otherwise)."""
+    if HAS_ONNX and isinstance(init, onnx.TensorProto):
+        from onnx import numpy_helper
+
+        return numpy_helper.to_array(init)
+    if getattr(init, "numpy", None) is not None:
+        arr = init.numpy
+        return arr() if callable(arr) else arr
+    if getattr(init, "float_data", None):
+        return np.array(init.float_data, np.float32).reshape(list(init.dims))
+    if getattr(init, "int64_data", None):
+        return np.array(init.int64_data, np.int64).reshape(list(init.dims))
+    raise ValueError(f"cannot convert initializer {getattr(init, 'name', '?')}")
+
+
+def onnx_to_flexflow(model, ffmodel, input_tensors: Dict[str, object]) -> List:
+    """Convenience wrapper (reference: onnx README usage)."""
+    return ONNXModel(model).apply(ffmodel, input_tensors)

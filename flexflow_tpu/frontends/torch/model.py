@@ -1,0 +1,314 @@
+"""torch.fx -> FFModel importer.
+
+Reference: python/flexflow/torch/model.py — the reference traces with
+torch.fx, serializes per-node records to a ``.ff`` file, and replays them
+into FFModel (`PyTorchModel.apply`). Here tracing and replay happen in
+one pass (no intermediate file; a serialized form is available via
+``to_records``), and ``copy_weights`` ports the torch parameters into
+the compiled executor so imported models predict identically on TPU.
+
+Layout notes: torch Linear stores weight [out, in]; our Linear kernel is
+[in, out] (y = x @ W). torch Conv2d weight is OIHW, matching Conv2DOp.
+"""
+from __future__ import annotations
+
+import operator
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...core.types import PoolType
+
+try:  # torch is in the image (cpu build); keep the import soft anyway
+    import torch
+    import torch.fx
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    HAS_TORCH = True
+except Exception:  # pragma: no cover
+    HAS_TORCH = False
+
+
+class PyTorchModel:
+    """Reference: PyTorchModel (torch/model.py) — wraps a traced module."""
+
+    def __init__(self, module, seq_length: Optional[int] = None):
+        assert HAS_TORCH, "torch is not available"
+        self.module = module
+        self.seq_length = seq_length
+        self.traced = torch.fx.symbolic_trace(module)
+        # fx node name -> ff node name used when porting weights
+        self.name_map: Dict[str, str] = {}
+
+    # -- the importer -------------------------------------------------
+    def torch_to_ff(self, ffmodel, input_tensors: Sequence) -> List:
+        """Replay the traced graph into ``ffmodel``; returns output tensors.
+
+        ``input_tensors`` are FFModel tensors matching the module's
+        placeholders in order (reference: PyTorchModel.apply).
+        """
+        env: Dict[str, object] = {}
+        placeholders = [n for n in self.traced.graph.nodes if n.op == "placeholder"]
+        assert len(placeholders) == len(input_tensors), (
+            f"model takes {len(placeholders)} inputs, got {len(input_tensors)}"
+        )
+        outputs: List = []
+        for node in self.traced.graph.nodes:
+            if node.op == "placeholder":
+                env[node.name] = input_tensors[placeholders.index(node)]
+            elif node.op == "get_attr":
+                raise NotImplementedError(f"get_attr ({node.target}) not supported; register it as a buffer-free module")
+            elif node.op == "call_module":
+                mod = self.traced.get_submodule(node.target)
+                env[node.name] = self._module(ffmodel, node, mod, env)
+                self.name_map[node.target] = node.name
+            elif node.op == "call_function":
+                env[node.name] = self._function(ffmodel, node, env)
+            elif node.op == "call_method":
+                env[node.name] = self._method(ffmodel, node, env)
+            elif node.op == "output":
+                args = node.args[0]
+                outs = args if isinstance(args, (tuple, list)) else [args]
+                outputs = [env[a.name] for a in outs]
+        return outputs
+
+    # -- call_module dispatch ----------------------------------------
+    def _module(self, ff, node, mod, env):
+        x = [env[a.name] for a in node.args if isinstance(a, torch.fx.Node)]
+        name = node.name
+        if isinstance(mod, nn.Linear):
+            return ff.dense(x[0], mod.out_features, use_bias=mod.bias is not None, name=name)
+        if isinstance(mod, nn.Conv2d):
+            assert mod.padding_mode == "zeros"
+            pad = mod.padding if isinstance(mod.padding, tuple) else (mod.padding, mod.padding)
+            return ff.conv2d(
+                x[0], mod.out_channels, mod.kernel_size[0], mod.kernel_size[1],
+                mod.stride[0], mod.stride[1], pad[0], pad[1],
+                groups=mod.groups, use_bias=mod.bias is not None, name=name,
+            )
+        if isinstance(mod, (nn.MaxPool2d, nn.AvgPool2d)):
+            k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else (mod.kernel_size,) * 2
+            s = mod.stride if isinstance(mod.stride, tuple) else (mod.stride or mod.kernel_size,) * 2
+            p = mod.padding if isinstance(mod.padding, tuple) else (mod.padding, mod.padding)
+            pt = PoolType.MAX if isinstance(mod, nn.MaxPool2d) else PoolType.AVG
+            return ff.pool2d(x[0], k[0], k[1], s[0], s[1], p[0], p[1], pool_type=pt, name=name)
+        if isinstance(mod, nn.AdaptiveAvgPool2d):
+            # reference AdaptivePool2dNode: supported when it reduces to a
+            # realizable fixed-kernel pool; (1,1) is global average
+            h, w = x[0].shape[2], x[0].shape[3]
+            oh, ow = mod.output_size if isinstance(mod.output_size, tuple) else (mod.output_size,) * 2
+            assert h % oh == 0 and w % ow == 0, "adaptive pool must divide input"
+            kh, kw = h // oh, w // ow
+            return ff.pool2d(x[0], kh, kw, kh, kw, 0, 0, pool_type=PoolType.AVG, name=name)
+        if isinstance(mod, nn.BatchNorm2d):
+            return ff.batch_norm(x[0], relu=False, name=name)
+        if isinstance(mod, nn.LayerNorm):
+            axes = list(range(x[0].ndim - len(mod.normalized_shape), x[0].ndim))
+            return ff.layer_norm(x[0], axes=axes, elementwise_affine=mod.elementwise_affine, eps=mod.eps, name=name)
+        if isinstance(mod, nn.Softmax):
+            return ff.softmax(x[0], axis=mod.dim, name=name)
+        if isinstance(mod, nn.Dropout):
+            return ff.dropout(x[0], mod.p, name=name)
+        if isinstance(mod, nn.Flatten):
+            assert mod.start_dim == 1
+            return ff.flat(x[0], name=name)
+        if isinstance(mod, nn.ReLU):
+            return ff.relu(x[0], name=name)
+        if isinstance(mod, nn.GELU):
+            return ff.gelu(x[0], name=name)
+        if isinstance(mod, nn.Sigmoid):
+            return ff.sigmoid(x[0], name=name)
+        if isinstance(mod, nn.Tanh):
+            return ff.tanh(x[0], name=name)
+        if isinstance(mod, nn.ELU):
+            return ff.elu(x[0], name=name)
+        if isinstance(mod, nn.Identity):
+            return ff.identity(x[0], name=name)
+        if isinstance(mod, nn.Embedding):
+            return ff.embedding(x[0], mod.num_embeddings, mod.embedding_dim, name=name)
+        if isinstance(mod, nn.MultiheadAttention):
+            assert mod.batch_first, "only batch_first MultiheadAttention is supported"
+            q, k, v = (x + [x[0], x[0]])[:3]
+            return ff.multihead_attention(q, k, v, mod.embed_dim, mod.num_heads, bias=mod.in_proj_bias is not None, name=name)
+        raise NotImplementedError(f"unsupported module {type(mod).__name__}")
+
+    # -- call_function dispatch --------------------------------------
+    def _function(self, ff, node, env):
+        t = node.target
+        name = node.name
+
+        def get(a):
+            return env[a.name] if isinstance(a, torch.fx.Node) else a
+
+        args = [get(a) for a in node.args]
+        if t in (operator.add, torch.add):
+            return self._bin_or_scalar(ff, ff.add, ff.scalar_add, args, name)
+        if t in (operator.sub, torch.sub):
+            return self._bin_or_scalar(ff, ff.subtract, ff.scalar_sub, args, name)
+        if t in (operator.mul, torch.mul):
+            return self._bin_or_scalar(ff, ff.multiply, ff.scalar_multiply, args, name)
+        if t in (operator.truediv, torch.div):
+            return self._bin_or_scalar(ff, ff.divide, ff.scalar_true_divide, args, name)
+        if t in (F.relu, torch.relu):
+            return ff.relu(args[0], name=name)
+        if t is F.gelu:
+            return ff.gelu(args[0], name=name)
+        if t in (F.sigmoid, torch.sigmoid):
+            return ff.sigmoid(args[0], name=name)
+        if t in (F.tanh, torch.tanh):
+            return ff.tanh(args[0], name=name)
+        if t in (torch.exp,):
+            return ff.exp(args[0], name=name)
+        if t in (torch.sin,):
+            return ff.sin(args[0], name=name)
+        if t in (torch.cos,):
+            return ff.cos(args[0], name=name)
+        if t in (torch.pow, operator.pow):
+            return ff.pow(args[0], float(args[1]), name=name)
+        if t is torch.rsqrt:
+            return ff.rsqrt(args[0], name=name)
+        if t in (torch.cat, torch.concat):
+            tensors = args[0]
+            axis = node.kwargs.get("dim", args[1] if len(args) > 1 else 0)
+            return ff.concat(list(tensors), axis, name=name)
+        if t is torch.split:
+            axis = node.kwargs.get("dim", args[2] if len(args) > 2 else 0)
+            return ff.split(args[0], args[1], axis, name=name)
+        if t is torch.flatten:
+            return ff.flat(args[0], name=name)
+        if t in (torch.matmul, torch.bmm):
+            return ff.batch_matmul(args[0], args[1], name=name)
+        if t is F.softmax:
+            axis = node.kwargs.get("dim", args[1] if len(args) > 1 else -1)
+            return ff.softmax(args[0], axis=axis, name=name)
+        if t is F.dropout:
+            p = node.kwargs.get("p", args[1] if len(args) > 1 else 0.5)
+            return ff.dropout(args[0], p, name=name)
+        if t is torch.mean:
+            dims = node.kwargs.get("dim", args[1] if len(args) > 1 else None)
+            keep = node.kwargs.get("keepdim", False)
+            dims = [dims] if isinstance(dims, int) else list(dims)
+            return ff.mean(args[0], dims, keepdims=keep, name=name)
+        if t is torch.transpose:
+            return self._transpose(ff, args[0], args[1], args[2], name)
+        if t is operator.getitem:
+            seq, idx = args
+            return seq[idx]
+        if t is torch.reshape:
+            return ff.reshape(args[0], tuple(args[1]), name=name)
+        raise NotImplementedError(f"unsupported function {t}")
+
+    # -- call_method dispatch ----------------------------------------
+    def _method(self, ff, node, env):
+        name = node.name
+
+        def get(a):
+            return env[a.name] if isinstance(a, torch.fx.Node) else a
+
+        args = [get(a) for a in node.args]
+        m = node.target
+        if m == "view" or m == "reshape":
+            shape = args[1:] if not isinstance(args[1], (tuple, list)) else list(args[1])
+            shape = [s for s in shape]
+            if -1 in shape:
+                known = int(np.prod([s for s in shape if s != -1]))
+                total = int(np.prod(args[0].shape))
+                shape[shape.index(-1)] = total // known
+            return ff.reshape(args[0], tuple(shape), name=name)
+        if m == "flatten":
+            return ff.flat(args[0], name=name)
+        if m == "transpose":
+            return self._transpose(ff, args[0], args[1], args[2], name)
+        if m == "permute":
+            perm = args[1:] if not isinstance(args[1], (tuple, list)) else list(args[1])
+            return ff.transpose(args[0], tuple(perm), name=name)
+        if m == "contiguous":
+            return args[0]
+        if m == "relu":
+            return ff.relu(args[0], name=name)
+        if m == "split":
+            axis = node.kwargs.get("dim", args[2] if len(args) > 2 else 0)
+            return ff.split(args[0], args[1], axis, name=name)
+        if m == "mean":
+            dims = [args[1]] if isinstance(args[1], int) else list(args[1])
+            return ff.mean(args[0], dims, keepdims=node.kwargs.get("keepdim", False), name=name)
+        if m in ("add", "sub", "mul", "div"):
+            fn = {"add": (ff.add, ff.scalar_add), "sub": (ff.subtract, ff.scalar_sub), "mul": (ff.multiply, ff.scalar_multiply), "div": (ff.divide, ff.scalar_true_divide)}[m]
+            return self._bin_or_scalar(ff, fn[0], fn[1], args, name)
+        raise NotImplementedError(f"unsupported method {m}")
+
+    @staticmethod
+    def _bin_or_scalar(ff, bin_fn, scalar_fn, args, name):
+        a, b = args[0], args[1]
+        if isinstance(b, (int, float)):
+            return scalar_fn(a, float(b), name=name)
+        if isinstance(a, (int, float)):
+            return scalar_fn(b, float(a), name=name)
+        return bin_fn(a, b, name=name)
+
+    @staticmethod
+    def _transpose(ff, x, d0, d1, name):
+        perm = list(range(x.ndim))
+        perm[d0], perm[d1] = perm[d1], perm[d0]
+        return ff.transpose(x, tuple(perm), name=name)
+
+    # -- serialized form (reference's .ff file analog) ----------------
+    def to_records(self) -> List[str]:
+        recs = []
+        for node in self.traced.graph.nodes:
+            ins = ",".join(a.name for a in node.all_input_nodes)
+            recs.append(f"{node.name};{ins};{node.op};{node.target}")
+        return recs
+
+
+def torch_to_flexflow(module, ffmodel, input_tensors, seq_length=None):
+    """Reference: flexflow.torch.fx.torch_to_flexflow (README.md:10-17)."""
+    m = PyTorchModel(module, seq_length=seq_length)
+    return m.torch_to_ff(ffmodel, input_tensors), m
+
+
+def copy_weights(torch_module, ffmodel, name_map: Dict[str, str]) -> None:
+    """Port torch parameters into the compiled executor.
+
+    name_map: fx submodule target -> ff node name (PyTorchModel.name_map).
+    The reference's align tests do this via ParallelTensor::set_tensor
+    (parallel_tensor.h:165); here we overwrite executor params.
+    """
+    from ...runtime.executor import _node_key
+
+    assert HAS_TORCH, "torch is not available"
+    ex = ffmodel.executor
+    assert ex is not None, "compile() the ffmodel first"
+    by_name = {n.name: n for n in ffmodel.graph.nodes.values() if n.name}
+    for target, ff_name in name_map.items():
+        mod = torch_module.get_submodule(target)
+        node = by_name.get(ff_name)
+        if node is None:
+            continue
+        key = _node_key(node)
+        if key not in ex.params:
+            continue
+        ws = dict(ex.params[key])
+        sd = {k: v.detach().cpu().numpy() for k, v in mod.state_dict().items()}
+        if isinstance(mod, nn.Linear):
+            ws["kernel"] = ex._place_weight(node.guid, "kernel", np.ascontiguousarray(sd["weight"].T))
+            if "bias" in sd and "bias" in ws:
+                ws["bias"] = ex._place_weight(node.guid, "bias", sd["bias"])
+        elif isinstance(mod, nn.Conv2d):
+            ws["kernel"] = ex._place_weight(node.guid, "kernel", sd["weight"])
+            if "bias" in sd and "bias" in ws:
+                ws["bias"] = ex._place_weight(node.guid, "bias", sd["bias"])
+        elif isinstance(mod, (nn.LayerNorm, nn.BatchNorm2d)):
+            ws["scale"] = ex._place_weight(node.guid, "scale", sd["weight"])
+            ws["bias"] = ex._place_weight(node.guid, "bias", sd["bias"])
+            if "running_mean" in sd and key in ex.state:  # non-trainable -> state
+                st = dict(ex.state[key])
+                st["running_mean"] = ex._place_weight(node.guid, "running_mean", sd["running_mean"])
+                st["running_var"] = ex._place_weight(node.guid, "running_var", sd["running_var"])
+                ex.state[key] = st
+        elif isinstance(mod, nn.Embedding):
+            ws["embedding"] = ex._place_weight(node.guid, "embedding", sd["weight"])
+        else:
+            continue
+        ex.params[key] = ws

@@ -439,6 +439,10 @@ class FFModel:
         strategy selection + one jit)."""
         if optimizer is None:
             optimizer = SGDOptimizer(lr=self.config.learning_rate, weight_decay=self.config.weight_decay)
+        self.optimizer = optimizer
+        self.loss_type = loss_type
+        self.metrics = list(metrics)
+        self.comp_mode = comp_mode
         self._outputs = list(outputs) if outputs else [self._default_output()]
         num_devices = self.config.num_devices
         from .parallel.mesh import build_mesh
@@ -546,6 +550,46 @@ class FFModel:
     def predict(self, x) -> jax.Array:
         xs = [x] if isinstance(x, (np.ndarray, jnp.ndarray)) else list(x)
         return self.executor.predict([jnp.asarray(xx) for xx in xs])[0]
+
+    # --------------------------------------------- checkpoint / dataloader
+    def save_checkpoint(self, path: str, step: int = 0) -> None:
+        """Save weights + optimizer state + strategy (new capability vs the
+        reference, which only had weight get/set — SURVEY.md §5)."""
+        from .runtime.checkpoint import save_checkpoint
+
+        assert self.executor is not None, "compile() first"
+        save_checkpoint(path, self.executor, step=step, strategy=self.strategy)
+
+    def load_checkpoint(self, path: str) -> int:
+        from .runtime.checkpoint import restore_checkpoint
+
+        assert self.executor is not None, "compile() first"
+        return restore_checkpoint(path, self.executor)
+
+    def create_data_loader(self, x, y, batch_size: Optional[int] = None, shuffle: bool = True):
+        """Reference: FFModel.create_data_loader (flexflow_cffi.py:2178).
+        Batches land pre-sharded per the compiled strategy when available."""
+        from .runtime.dataloader import DataLoader
+
+        xs = [x] if isinstance(x, (np.ndarray, jnp.ndarray)) else list(x)
+        shardings = label_sharding = None
+        if self.executor is not None:
+            shardings, label_sharding = self.executor.input_shardings()
+        return DataLoader(
+            xs,
+            y,
+            batch_size or self.config.batch_size,
+            shuffle=shuffle,
+            shardings=shardings,
+            label_sharding=label_sharding,
+        )
+
+    def recompile_on_condition(self, trigger, alter):
+        """Reference: FFModel::recompile_on_condition (model.cc:2430)."""
+        from .runtime.recompile import RecompileState
+
+        assert self.executor is not None, "call compile() first"
+        return RecompileState(trigger, alter, self)
 
     # ------------------------------------------------------- introspection
     def get_output(self) -> Tensor:

@@ -1,0 +1,148 @@
+"""Checkpoint / resume of training state.
+
+The reference has NO model checkpointing (SURVEY.md §5): the closest it
+gets is ParallelTensor set_tensor/get_tensor for numpy weight dumps and
+--import/--export of the parallelization strategy (config.h:141-142).
+This module fills that gap TPU-natively with orbax (async-capable,
+sharding-aware), saving {params, opt_state, state, step} plus the
+strategy JSON so a run resumes with both weights and the searched
+parallelization.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def _canon_map(executor) -> Dict[str, str]:
+    """Executor node-key -> canonical key stable across process restarts.
+
+    Node keys embed guids from a process-global counter; checkpoints use
+    '<topo index>.<op type>[.<name>]' instead so a rebuilt identical model
+    restores cleanly.
+    """
+    from .executor import _node_key
+
+    out = {}
+    for i, node in enumerate(executor.graph.topo_order()):
+        canon = f"{i:04d}.{node.op_type.value}" + (f".{node.name}" if node.name else "")
+        out[_node_key(node)] = canon
+    return out
+
+
+def _rekey(tree: Any, mapping: Dict[str, str]) -> Any:
+    """Rename the node-key level of params/state-shaped dicts."""
+    if not isinstance(tree, dict):
+        return tree
+    return {mapping.get(k, k): v for k, v in tree.items()}
+
+
+def _opt_rekey(opt_state: Any, mapping: Dict[str, str]) -> Any:
+    if not isinstance(opt_state, dict):
+        return opt_state
+    out = dict(opt_state)
+    for field in ("v", "m"):
+        if isinstance(out.get(field), dict):
+            out[field] = _rekey(out[field], mapping)
+    return out
+
+
+def save_checkpoint(path: str, executor, step: int = 0, strategy=None) -> None:
+    """Write a checkpoint directory: orbax pytree + strategy.json."""
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    fwd = _canon_map(executor)
+    tree = {
+        "params": _rekey(executor.params, fwd),
+        "opt_state": _opt_rekey(executor.opt_state, fwd) if executor.opt_state is not None else {},
+        "state": _rekey(executor.state, fwd) if executor.state is not None else {},
+        "step": np.int64(step),
+    }
+    ckpt = _ocp().PyTreeCheckpointer()
+    ckpt.save(os.path.join(path, "train_state"), tree, force=True)
+    if strategy is not None:
+        with open(os.path.join(path, "strategy.json"), "w") as f:
+            f.write(strategy.to_json())
+
+
+def restore_checkpoint(path: str, executor) -> int:
+    """Restore into a compiled executor; returns the saved step.
+
+    The target structure comes from the executor's freshly initialized
+    pytree (canonically rekeyed) so orbax restores with matching
+    shardings/dtypes regardless of this process's guid counter.
+    """
+    path = os.path.abspath(path)
+    fwd = _canon_map(executor)
+    rev = {v: k for k, v in fwd.items()}
+    tree = {
+        "params": _rekey(executor.params, fwd),
+        "opt_state": _opt_rekey(executor.opt_state, fwd) if executor.opt_state is not None else {},
+        "state": _rekey(executor.state, fwd) if executor.state is not None else {},
+        "step": np.int64(0),
+    }
+    ckpt = _ocp().PyTreeCheckpointer()
+    restored = ckpt.restore(os.path.join(path, "train_state"), item=tree)
+    executor.params = _rekey(restored["params"], rev)
+    if executor.opt_state is not None and restored.get("opt_state"):
+        executor.opt_state = _opt_rekey(restored["opt_state"], rev)
+    if restored.get("state"):
+        executor.state = _rekey(restored["state"], rev)
+    return int(restored["step"])
+
+
+def load_strategy(path: str):
+    """Load the strategy saved next to a checkpoint, if present."""
+    from ..parallel.strategy import ParallelStrategy
+
+    sp = os.path.join(os.path.abspath(path), "strategy.json")
+    if not os.path.exists(sp):
+        return None
+    with open(sp) as f:
+        return ParallelStrategy.from_json(f.read())
+
+
+class CheckpointManager:
+    """Rolling checkpoints with max_to_keep, orbax-style."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _steps(self):
+        out = []
+        for d in os.listdir(self.directory):
+            if d.startswith("step_") and d[5:].isdigit():
+                out.append(int(d[5:]))
+        return sorted(out)
+
+    def save(self, executor, step: int, strategy=None) -> str:
+        p = os.path.join(self.directory, f"step_{step}")
+        save_checkpoint(p, executor, step=step, strategy=strategy)
+        for s in self._steps()[: -self.max_to_keep]:
+            import shutil
+
+            shutil.rmtree(os.path.join(self.directory, f"step_{s}"), ignore_errors=True)
+        return p
+
+    def latest_step(self) -> Optional[int]:
+        steps = self._steps()
+        return steps[-1] if steps else None
+
+    def restore_latest(self, executor) -> Optional[int]:
+        s = self.latest_step()
+        if s is None:
+            return None
+        restore_checkpoint(os.path.join(self.directory, f"step_{s}"), executor)
+        return s

@@ -209,20 +209,32 @@ class CompiledExecutor:
             rng = jax.random.key(0)
         return self._forward(self.params, self.state, tuple(inputs), rng)
 
-    def _shard_inputs(self, inputs: Sequence[jax.Array]) -> List[jax.Array]:
+    def input_shardings(self):
+        """(per-input NamedShardings, label sharding). Labels share the
+        first input's batch-axis sharding. None when there is no mesh."""
         if self.mesh is None:
-            return [jnp.asarray(x) for x in inputs]
-        from jax.sharding import NamedSharding
+            return None, None
+        from jax.sharding import NamedSharding, PartitionSpec
 
         input_nodes = sorted(
             (n for n in self.graph.nodes.values() if n.op_type == OpType.INPUT),
             key=lambda n: n.params.input_index,
         )
-        out = []
-        for node, x in zip(input_nodes, inputs):
+        shardings = []
+        for node in input_nodes:
             spec = self.strategy.output_spec(node.guid, 0) if self.strategy else None
-            out.append(jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, to_partition_spec(spec))))
-        return out
+            shardings.append(NamedSharding(self.mesh, to_partition_spec(spec)))
+        label = None
+        if shardings:
+            pspec = shardings[0].spec
+            label = NamedSharding(self.mesh, PartitionSpec(pspec[0] if len(pspec) else None))
+        return shardings, label
+
+    def _shard_inputs(self, inputs: Sequence[jax.Array]) -> List[jax.Array]:
+        if self.mesh is None:
+            return [jnp.asarray(x) for x in inputs]
+        shardings, _ = self.input_shardings()
+        return [jax.device_put(jnp.asarray(x), s) for x, s in zip(inputs, shardings)]
 
 
 def _apply_state_updates(state, updates: Dict, graph: PCGraph):

@@ -1,0 +1,67 @@
+"""Conditional mid-training recompilation.
+
+Reference: RecompileState (include/flexflow/recompile.h:26-41) +
+FFModel::recompile_on_condition (src/runtime/model.cc:2430): a
+trigger functor inspects runtime signals (e.g. the MoE Cache op's score,
+cache.cc) and an alter functor mutates the model, after which ops are
+re-initialized. TPU-native: alter mutates the PCG / config and a fresh
+jit compile replaces Legion task re-registration; trained weights carry
+over by node name.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+
+class RecompileState:
+    """Reference: recompile.h:26 (trigger_func, alter_func, ffmodel)."""
+
+    def __init__(self, trigger: Callable[["RecompileState"], bool], alter: Callable[["RecompileState"], None], model):
+        self.trigger = trigger
+        self.alter = alter
+        self.model = model
+        self.recompilations = 0
+        # runtime signals the trigger may inspect (reference: Cache score)
+        self.cache_score: float = 0.0
+        self.last_metrics: dict = {}
+
+    def trigger_and_alter(self) -> bool:
+        """One check (reference: FFModel::recompile_on_condition)."""
+        if not self.trigger(self):
+            return False
+        self.alter(self)
+        self._recompile()
+        self.recompilations += 1
+        return True
+
+    def _recompile(self):
+        """Re-lower + re-jit the (possibly altered) graph, preserving
+        weights for nodes whose names survive the alteration."""
+        model = self.model
+        old_executor = model.executor
+        old_graph = model.graph
+        outs = model._outputs if model._outputs else None
+        if outs and any(t.node.guid not in model.graph.nodes for t in outs):
+            outs = None  # alter removed an output node; fall back to sink
+        model.compile(
+            optimizer=model.optimizer,
+            loss_type=model.loss_type,
+            metrics=model.metrics,
+            comp_mode=model.comp_mode,
+            outputs=outs,
+        )
+        if old_executor is None:
+            return
+        new_ex = model.executor
+        from .executor import _node_key
+
+        old_by_name = {n.name: _node_key(n) for n in old_graph.nodes.values() if n.name}
+        for node in model.graph.nodes.values():
+            ok = old_by_name.get(node.name)
+            nk = _node_key(node)
+            if ok and ok in old_executor.params and nk in new_ex.params:
+                old_ws = old_executor.params[ok]
+                if all(k in old_ws and old_ws[k].shape == v.shape for k, v in new_ex.params[nk].items()):
+                    new_ex.params[nk] = {
+                        k: new_ex._place_weight(node.guid, k, old_ws[k]) for k in new_ex.params[nk]
+                    }

@@ -1,0 +1,127 @@
+"""ONNX importer tests using mock protos (the onnx package is not in the
+image; the importer consumes anything with the ModelProto structure —
+reference: python/flexflow/onnx/model.py).
+"""
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from flexflow_tpu import DataType, FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.frontends.onnx import ONNXModel
+
+
+@dataclasses.dataclass
+class Attr:
+    name: str
+    type: int
+    i: int = 0
+    f: float = 0.0
+    s: bytes = b""
+    ints: tuple = ()
+    floats: tuple = ()
+
+
+@dataclasses.dataclass
+class NodeProto:
+    op_type: str
+    input: List[str]
+    output: List[str]
+    name: str = ""
+    attribute: List[Attr] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ValueInfo:
+    name: str
+
+
+@dataclasses.dataclass
+class Init:
+    name: str
+    numpy: np.ndarray
+
+
+@dataclasses.dataclass
+class GraphProto:
+    node: List[NodeProto]
+    input: List[ValueInfo]
+    output: List[ValueInfo]
+    initializer: List[Init]
+
+
+@dataclasses.dataclass
+class ModelProto:
+    graph: GraphProto
+
+
+def ints(name, vals):
+    return Attr(name, 7, ints=tuple(vals))
+
+
+def test_onnx_mlp_graph():
+    w1 = Init("w1", np.zeros((32, 16), np.float32))  # transB Gemm weight [out, in]
+    g = GraphProto(
+        node=[
+            NodeProto("Gemm", ["x", "w1", "b1"], ["h"], "gemm1", [Attr("transB", 2, i=1)]),
+            NodeProto("Relu", ["h"], ["hr"], "relu1"),
+            NodeProto("Gemm", ["hr", "w2", "b2"], ["logits"], "gemm2", [Attr("transB", 2, i=1)]),
+            NodeProto("Softmax", ["logits"], ["probs"], "sm", [Attr("axis", 2, i=-1)]),
+        ],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("probs")],
+        initializer=[w1, Init("b1", np.zeros(32, np.float32)), Init("w2", np.zeros((10, 32), np.float32)), Init("b2", np.zeros(10, np.float32))],
+    )
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 16))
+    outs = ONNXModel(ModelProto(g)).apply(ff, {"x": x})
+    assert len(outs) == 1 and outs[0].shape == (8, 10)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY, outputs=outs)
+    rs = np.random.RandomState(0)
+    preds = ff.predict(rs.randn(8, 16).astype(np.float32))
+    assert np.asarray(preds).shape == (8, 10)
+
+
+def test_onnx_cnn_graph():
+    g = GraphProto(
+        node=[
+            NodeProto("Conv", ["x", "cw", "cb"], ["c"], "conv", [ints("strides", (1, 1)), ints("pads", (1, 1, 1, 1))]),
+            NodeProto("Relu", ["c"], ["cr"], "relu"),
+            NodeProto("MaxPool", ["cr"], ["p"], "pool", [ints("kernel_shape", (2, 2)), ints("strides", (2, 2))]),
+            NodeProto("GlobalAveragePool", ["p"], ["gap"], "gap"),
+            NodeProto("Flatten", ["gap"], ["f"], "flat"),
+            NodeProto("Gemm", ["f", "fw", "fb"], ["y"], "fc", [Attr("transB", 2, i=1)]),
+        ],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[
+            Init("cw", np.zeros((8, 3, 3, 3), np.float32)),
+            Init("cb", np.zeros(8, np.float32)),
+            Init("fw", np.zeros((10, 8), np.float32)),
+            Init("fb", np.zeros(10, np.float32)),
+        ],
+    )
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 3, 16, 16))
+    outs = ONNXModel(ModelProto(g)).apply(ff, {"x": x})
+    assert outs[0].shape == (4, 10)
+
+
+def test_onnx_elementwise_and_shape_ops():
+    g = GraphProto(
+        node=[
+            NodeProto("Add", ["a", "b"], ["s"], "add"),
+            NodeProto("Mul", ["s", "b"], ["m"], "mul"),
+            NodeProto("Transpose", ["m"], ["t"], "tr", [ints("perm", (0, 2, 1))]),
+            NodeProto("Reshape", ["t", "shape"], ["r"], "rs"),
+            NodeProto("Concat", ["r", "r"], ["cat"], "cat", [Attr("axis", 2, i=1)]),
+        ],
+        input=[ValueInfo("a"), ValueInfo("b")],
+        output=[ValueInfo("cat")],
+        initializer=[Init("shape", np.array([4, -1], np.int64))],
+    )
+    ff = FFModel(FFConfig(batch_size=4))
+    a = ff.create_tensor((4, 6, 5))
+    b = ff.create_tensor((4, 6, 5))
+    outs = ONNXModel(ModelProto(g)).apply(ff, {"a": a, "b": b})
+    assert outs[0].shape == (4, 60)

@@ -1,0 +1,136 @@
+"""torch.fx importer alignment tests.
+
+Reference analog: tests/align/ — run the same network in the framework
+and in CPU PyTorch, assert outputs allclose (align_test.py), here with
+weights ported so forward passes must match numerically.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from flexflow_tpu import CompMode, FFConfig, FFModel, LossType, SGDOptimizer  # noqa: E402
+from flexflow_tpu.frontends.torch import PyTorchModel, copy_weights  # noqa: E402
+
+
+def import_and_compare(module, inputs_np, input_specs, atol=2e-5):
+    """Trace module -> FFModel, port weights, compare vs torch forward."""
+    cfg = FFConfig(batch_size=inputs_np[0].shape[0])
+    ff = FFModel(cfg)
+    ff_inputs = [ff.create_tensor(x.shape, dtype=dt) for x, dt in zip(inputs_np, input_specs)]
+    pt = PyTorchModel(module)
+    outs = pt.torch_to_ff(ff, ff_inputs)
+    ff.compile(optimizer=SGDOptimizer(lr=0.0), loss_type=LossType.MEAN_SQUARED_ERROR, outputs=outs)
+    copy_weights(module, ff, pt.name_map)
+    got = np.asarray(ff.predict(list(inputs_np)))
+    with torch.no_grad():
+        module.eval()
+        want = module(*[torch.from_numpy(x) for x in inputs_np]).numpy()
+    np.testing.assert_allclose(got, want, atol=atol, rtol=1e-4)
+    return ff
+
+
+def test_mlp_aligns_with_torch():
+    torch.manual_seed(0)
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8), nn.Tanh())
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    from flexflow_tpu import DataType
+
+    import_and_compare(m, [x], [DataType.FLOAT])
+
+
+def test_cnn_aligns_with_torch():
+    torch.manual_seed(1)
+
+    class CNN(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 8, 3, padding=1)
+            self.pool = nn.MaxPool2d(2)
+            self.conv2 = nn.Conv2d(8, 8, 3, padding=1)
+            self.fc = nn.Linear(8 * 8 * 8, 10)
+
+        def forward(self, x):
+            x = torch.relu(self.conv1(x))
+            x = self.pool(x)
+            x = torch.relu(self.conv2(x))
+            x = self.pool(x)
+            x = torch.flatten(x, 1)
+            return self.fc(x)
+
+    m = CNN()
+    x = np.random.RandomState(1).randn(4, 3, 32, 32).astype(np.float32)
+    from flexflow_tpu import DataType
+
+    import_and_compare(m, [x], [DataType.FLOAT], atol=1e-4)
+
+
+def test_residual_and_functional_ops():
+    torch.manual_seed(2)
+
+    class Block(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(16, 16)
+            self.fc2 = nn.Linear(16, 16)
+            self.ln = nn.LayerNorm(16)
+
+        def forward(self, x):
+            h = torch.relu(self.fc1(x))
+            h = self.fc2(h) + x  # residual add
+            h = self.ln(h)
+            return h * 2.0 - 1.0  # scalar ops
+
+    m = Block()
+    x = np.random.RandomState(2).randn(4, 16).astype(np.float32)
+    from flexflow_tpu import DataType
+
+    import_and_compare(m, [x], [DataType.FLOAT])
+
+
+def test_embedding_and_mean():
+    torch.manual_seed(3)
+
+    class Emb(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(50, 8)
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, ids):
+            h = self.emb(ids)
+            h = torch.mean(h, 1)
+            return self.fc(h)
+
+    m = Emb()
+    ids = np.random.RandomState(3).randint(0, 50, size=(4, 12)).astype(np.int32)
+    from flexflow_tpu import DataType
+
+    import_and_compare(m, [ids], [DataType.INT32])
+
+
+def test_trained_after_import():
+    """Imported model must also be trainable (reference: torch examples
+    train after torch_to_flexflow)."""
+    torch.manual_seed(4)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    cfg = FFConfig(batch_size=8)
+    ff = FFModel(cfg)
+    from flexflow_tpu import DataType, MetricsType
+
+    x_t = ff.create_tensor((8, 8), dtype=DataType.FLOAT)
+    pt = PyTorchModel(m)
+    outs = pt.torch_to_ff(ff, [x_t])
+    outs = [ff.softmax(outs[0])]
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.1),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        outputs=outs,
+    )
+    rs = np.random.RandomState(5)
+    x = rs.randn(64, 8).astype(np.float32)
+    y = np.argmax(x[:, :4], axis=1).astype(np.int32)
+    perf = ff.fit(x, y, epochs=5, verbose=False)
+    assert perf.accuracy > 0.4
