@@ -1,0 +1,325 @@
+"""ctypes binding to the native ffcore runtime library.
+
+The C API (native/include/ffcore.h) is the TPU-native analog of the
+reference's C API (python/flexflow_c.h): there, C wraps the C++ FFModel
+for Python cffi; here, C wraps the native search/runtime engine
+(taskgraph simulator, machine models, allreduce schedule optimizer,
+dataloader kernels) for the Python/JAX host.
+
+Importing this module loads ``libffcore.so`` if present, auto-building
+it from native/ with g++ when possible (disable with
+FF_NATIVE_DISABLE=1). All consumers treat ImportError / RuntimeError
+from here as "use the pure-Python fallback".
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+_HERE = pathlib.Path(__file__).resolve().parent
+_REPO = _HERE.parent.parent
+_NATIVE_DIR = _REPO / "native"
+_LIB_PATH = _HERE / "libffcore.so"
+_SOURCES = [
+    _NATIVE_DIR / "src" / "simulator.cc",
+    _NATIVE_DIR / "src" / "machine_model.cc",
+    _NATIVE_DIR / "src" / "allreduce.cc",
+    _NATIVE_DIR / "src" / "dataloader.cc",
+]
+_HEADERS = [
+    _NATIVE_DIR / "include" / "ffcore.h",
+    _NATIVE_DIR / "src" / "ffcore_internal.h",
+]
+
+_build_lock = threading.Lock()
+
+
+def _needs_build() -> bool:
+    if not all(s.exists() for s in _SOURCES):
+        return False  # installed without sources: use .so as-is
+    if not _LIB_PATH.exists():
+        return True
+    lib_mtime = _LIB_PATH.stat().st_mtime
+    return any(p.stat().st_mtime > lib_mtime for p in _SOURCES + _HEADERS)
+
+
+def _build() -> None:
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+        "-I", str(_NATIVE_DIR / "include"),
+        *[str(s) for s in _SOURCES],
+        "-o", str(_LIB_PATH),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("FF_NATIVE_DISABLE"):
+        return None
+    try:
+        with _build_lock:
+            if _needs_build():
+                _build()
+        if not _LIB_PATH.exists():
+            return None
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except Exception:
+        return None
+    # signatures
+    lib.ffc_version.restype = ctypes.c_char_p
+    lib.ffc_taskgraph_create.restype = ctypes.c_void_p
+    lib.ffc_taskgraph_destroy.argtypes = [ctypes.c_void_p]
+    lib.ffc_taskgraph_add_tasks.restype = ctypes.c_int64
+    lib.ffc_taskgraph_add_tasks.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.ffc_taskgraph_add_deps.restype = ctypes.c_int32
+    lib.ffc_taskgraph_add_deps.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.ffc_taskgraph_simulate.restype = ctypes.c_double
+    lib.ffc_taskgraph_simulate.argtypes = [ctypes.c_void_p]
+    lib.ffc_mm_create_simple.restype = ctypes.c_void_p
+    lib.ffc_mm_create_simple.argtypes = [
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+    ]
+    lib.ffc_mm_create_networked.restype = ctypes.c_void_p
+    lib.ffc_mm_create_networked.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.ffc_mm_destroy.argtypes = [ctypes.c_void_p]
+    lib.ffc_mm_num_devices.restype = ctypes.c_int32
+    lib.ffc_mm_num_devices.argtypes = [ctypes.c_void_p]
+    lib.ffc_mm_comm_time.restype = ctypes.c_double
+    lib.ffc_mm_comm_time.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_double,
+    ]
+    lib.ffc_mm_get_routes.restype = ctypes.c_int32
+    lib.ffc_mm_get_routes.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.ffc_allreduce_simulate.restype = ctypes.c_double
+    lib.ffc_allreduce_simulate.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ctypes.c_double, ctypes.c_int32,
+    ]
+    lib.ffc_allreduce_optimize.restype = ctypes.c_int32
+    lib.ffc_allreduce_optimize.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ctypes.c_double, ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.ffc_batch_gather.restype = ctypes.c_int32
+    lib.ffc_batch_gather.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+    ]
+    lib.ffc_shuffle_indices.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_uint64,
+    ]
+    return lib
+
+
+_lib = _load()
+
+if _lib is None:
+    raise ImportError("native ffcore library unavailable")
+
+
+def version() -> str:
+    return _lib.ffc_version().decode()
+
+
+# ------------------------------------------------------------ simulator
+
+
+def simulate_taskgraph(tasks) -> float:
+    """Native replay of a search/simulator.py TaskManager task list."""
+    n = len(tasks)
+    kinds = (ctypes.c_int32 * n)(*[t.kind for t in tasks])
+    devices = (ctypes.c_int64 * n)(*[t.device for t in tasks])
+    run_times = (ctypes.c_double * n)(*[t.run_time for t in tasks])
+    srcs: List[int] = []
+    dsts: List[int] = []
+    for i, t in enumerate(tasks):
+        srcs.extend([i] * len(t.next_tasks))
+        dsts.extend(t.next_tasks)
+    tg = _lib.ffc_taskgraph_create()
+    try:
+        _lib.ffc_taskgraph_add_tasks(tg, n, kinds, devices, run_times)
+        nd = len(srcs)
+        if nd:
+            csrc = (ctypes.c_int64 * nd)(*srcs)
+            cdst = (ctypes.c_int64 * nd)(*dsts)
+            if _lib.ffc_taskgraph_add_deps(tg, nd, csrc, cdst) != 0:
+                raise RuntimeError("bad dependency ids")
+        makespan = _lib.ffc_taskgraph_simulate(tg)
+    finally:
+        _lib.ffc_taskgraph_destroy(tg)
+    if makespan < 0:
+        raise ValueError("task graph deadlock")
+    return makespan
+
+
+# --------------------------------------------------------- machine model
+
+
+class NativeMachineModel:
+    """Owns an ffc_mm handle; constructed from the Python machine models."""
+
+    def __init__(self, handle):
+        self._h = handle
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and _lib is not None:
+            _lib.ffc_mm_destroy(h)
+
+    @classmethod
+    def simple(cls, num_nodes: int, devices_per_node: int,
+               ici_latency: float, ici_bandwidth: float,
+               dcn_latency: float, dcn_bandwidth: float) -> "NativeMachineModel":
+        h = _lib.ffc_mm_create_simple(
+            num_nodes, devices_per_node,
+            ici_latency, ici_bandwidth, dcn_latency, dcn_bandwidth)
+        if not h:
+            raise RuntimeError("ffc_mm_create_simple failed")
+        return cls(h)
+
+    @classmethod
+    def networked(cls, num_nodes: int, num_switches: int, devices_per_node: int,
+                  conn: Sequence[Sequence[int]], link_latency: float,
+                  link_bandwidth: float, ici_latency: float,
+                  ici_bandwidth: float, routing: str = "weighted_shortest",
+                  ecmp_max_paths: int = 4) -> "NativeMachineModel":
+        e = num_nodes + num_switches
+        flat = (ctypes.c_int32 * (e * e))(*[conn[i][j] for i in range(e) for j in range(e)])
+        rid = {"shortest": 0, "weighted_shortest": 1, "ecmp": 2}.get(routing, 1)
+        h = _lib.ffc_mm_create_networked(
+            num_nodes, num_switches, devices_per_node, flat,
+            link_latency, link_bandwidth, ici_latency, ici_bandwidth,
+            rid, ecmp_max_paths)
+        if not h:
+            raise RuntimeError("ffc_mm_create_networked failed")
+        return cls(h)
+
+    @classmethod
+    def from_python(cls, mm) -> "NativeMachineModel":
+        """Mirror a search/machine_model.py model into the native engine."""
+        from ..search.machine_model import NetworkedMachineModel, SimpleMachineModel
+
+        if isinstance(mm, SimpleMachineModel):
+            c = mm.machine.chip
+            return cls.simple(
+                mm.machine.num_nodes, mm.machine.devices_per_node,
+                c.ici_latency, c.ici_bandwidth, c.dcn_latency, c.dcn_bandwidth)
+        if isinstance(mm, NetworkedMachineModel):
+            from ..search.machine_model import (
+                ECMPRouting, ShortestPathRouting, WeightedShortestPathRouting)
+
+            topo = mm.topo
+            if isinstance(mm.routing, ECMPRouting):
+                routing, k = "ecmp", mm.routing.max_paths
+            elif isinstance(mm.routing, WeightedShortestPathRouting):
+                routing, k = "weighted_shortest", 4
+            elif isinstance(mm.routing, ShortestPathRouting):
+                routing, k = "shortest", 4
+            else:
+                raise TypeError(f"unsupported routing {type(mm.routing)}")
+            c = mm.machine.chip
+            return cls.networked(
+                topo.num_nodes, topo.num_switches, topo.devices_per_node,
+                topo.conn, topo.link_latency, topo.link_bandwidth,
+                c.ici_latency, c.ici_bandwidth, routing, k)
+        raise TypeError(f"no native mirror for {type(mm)}")
+
+    def num_devices(self) -> int:
+        return _lib.ffc_mm_num_devices(self._h)
+
+    def comm_time(self, src_dev: int, dst_dev: int, nbytes: float) -> float:
+        return _lib.ffc_mm_comm_time(self._h, src_dev, dst_dev, nbytes)
+
+    def get_routes(self, src_node: int, dst_node: int,
+                   max_paths: int = 8, max_len: int = 64) -> List[List[int]]:
+        out = (ctypes.c_int32 * (max_paths * max_len))()
+        lens = (ctypes.c_int32 * max_paths)()
+        np_ = _lib.ffc_mm_get_routes(self._h, src_node, dst_node, out, lens,
+                                     max_paths, max_len)
+        if np_ < 0:
+            raise RuntimeError("not a networked machine model")
+        return [[out[p * max_len + i] for i in range(lens[p])] for p in range(np_)]
+
+    # ------------------------------------------------------- allreduce
+    _PATTERN_IDS = {"ring": 0, "butterfly": 1, "double_binary_tree": 2}
+
+    def allreduce_time(self, participants: Sequence[int], nbytes: float,
+                       pattern: str) -> float:
+        n = len(participants)
+        parts = (ctypes.c_int32 * n)(*participants)
+        t = _lib.ffc_allreduce_simulate(
+            self._h, parts, n, nbytes, self._PATTERN_IDS[pattern])
+        if t < 0:
+            raise ValueError(f"bad pattern {pattern}")
+        return t
+
+    def allreduce_optimize(self, participants: Sequence[int],
+                           nbytes: float) -> Tuple[str, dict]:
+        n = len(participants)
+        parts = (ctypes.c_int32 * n)(*participants)
+        times = (ctypes.c_double * 3)()
+        best = _lib.ffc_allreduce_optimize(self._h, parts, n, nbytes, times)
+        names = ["ring", "butterfly", "double_binary_tree"]
+        return names[best], dict(zip(names, list(times)))
+
+
+# ------------------------------------------------------------ dataloader
+
+
+def batch_gather(src, dst, indices, num_threads: int = 0) -> None:
+    """dst[i] = src[indices[i]] row gather via the native threaded kernel.
+
+    src/dst are C-contiguous numpy arrays whose first axis is the row
+    axis; dst must have len(indices) rows.
+    """
+    import numpy as np
+
+    src = np.ascontiguousarray(src)
+    if not dst.flags["C_CONTIGUOUS"]:
+        raise ValueError("dst must be C-contiguous")
+    n = len(indices)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    if dst.shape[0] != n or dst.dtype != src.dtype or dst.shape[1:] != src.shape[1:]:
+        raise ValueError("dst shape/dtype mismatch")
+    idx = np.ascontiguousarray(np.asarray(indices, dtype=np.int64))
+    if n and (idx.min() < 0 or idx.max() >= src.shape[0]):
+        raise IndexError("gather index out of range")
+    rc = _lib.ffc_batch_gather(
+        src.ctypes.data_as(ctypes.c_void_p),
+        dst.ctypes.data_as(ctypes.c_void_p),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, row_bytes, num_threads)
+    if rc != 0:
+        raise RuntimeError("ffc_batch_gather failed")
+
+
+def shuffle_indices(n: int, seed: int):
+    """Deterministic permutation of range(n) from the native shuffler."""
+    import numpy as np
+
+    idx = np.arange(n, dtype=np.int64)
+    _lib.ffc_shuffle_indices(
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, seed)
+    return idx

@@ -81,17 +81,49 @@ class ONNXModel:
         return [env[o.name] for o in graph.output]
 
     # -- elementwise --------------------------------------------------
+    def _binary(self, ff, node, env, kind):
+        """Binary op where either side may be a graph initializer: scalar
+        constants lower to the scalar op family; non-scalar constants are
+        not importable (no constant-tensor op yet) and fail loudly."""
+        def resolve(name):
+            if name in env:
+                return env[name]
+            if name in self.initializers:
+                c = self.initializers[name]
+                if c.size == 1:
+                    return float(c.reshape(-1)[0])
+                raise NotImplementedError(
+                    f"{node.op_type} with non-scalar initializer {name!r} "
+                    f"(shape {tuple(c.shape)}) is not supported"
+                )
+            raise KeyError(f"{node.op_type} input {name!r} is neither a produced tensor nor an initializer")
+
+        a, b = resolve(node.input[0]), resolve(node.input[1])
+        bin_fn = {"add": ff.add, "sub": ff.subtract, "mul": ff.multiply, "div": ff.divide}[kind]
+        scalar_fn = {"add": ff.scalar_add, "sub": ff.scalar_sub, "mul": ff.scalar_multiply, "div": ff.scalar_true_divide}[kind]
+        if isinstance(b, float):
+            return scalar_fn(a, b, name=node.name)
+        if isinstance(a, float):
+            if kind in ("add", "mul"):
+                return scalar_fn(b, a, name=node.name)
+            if kind == "sub":  # c - x = -x + c
+                neg = ff.scalar_multiply(b, -1.0, inplace=False, name=f"{node.name}_neg")
+                return ff.scalar_add(neg, a, name=node.name)
+            inv = ff.pow(b, -1.0, name=f"{node.name}_inv")  # c / x = c * x^-1
+            return ff.scalar_multiply(inv, a, inplace=False, name=node.name)
+        return bin_fn(a, b, name=node.name)
+
     def handleAdd(self, ff, node, env):
-        return ff.add(env[node.input[0]], env[node.input[1]], name=node.name)
+        return self._binary(ff, node, env, "add")
 
     def handleSub(self, ff, node, env):
-        return ff.subtract(env[node.input[0]], env[node.input[1]], name=node.name)
+        return self._binary(ff, node, env, "sub")
 
     def handleMul(self, ff, node, env):
-        return ff.multiply(env[node.input[0]], env[node.input[1]], name=node.name)
+        return self._binary(ff, node, env, "mul")
 
     def handleDiv(self, ff, node, env):
-        return ff.divide(env[node.input[0]], env[node.input[1]], name=node.name)
+        return self._binary(ff, node, env, "div")
 
     def handleRelu(self, ff, node, env):
         return ff.relu(env[node.input[0]], name=node.name)
@@ -161,6 +193,11 @@ class ONNXModel:
         at = _attrs(node)
         w = self.initializers.get(node.input[1])
         assert w is not None, "Conv weight must be an initializer"
+        dil = at.get("dilations", [1, 1])
+        assert all(d == 1 for d in dil), f"dilated Conv (dilations={dil}) is not supported"
+        assert at.get("auto_pad", "NOTSET") in ("", "NOTSET"), (
+            f"auto_pad={at['auto_pad']} is not supported; export with explicit pads"
+        )
         out_c, _, kh, kw = w.shape
         strides = at.get("strides", [1, 1])
         pads = at.get("pads", [0, 0, 0, 0])  # [top, left, bottom, right]
@@ -175,6 +212,9 @@ class ONNXModel:
 
     def _pool(self, ff, node, env, pool_type):
         at = _attrs(node)
+        assert at.get("auto_pad", "NOTSET") in ("", "NOTSET"), (
+            f"auto_pad={at['auto_pad']} is not supported; export with explicit pads"
+        )
         k = at["kernel_shape"]
         strides = at.get("strides", k)
         pads = at.get("pads", [0, 0, 0, 0])

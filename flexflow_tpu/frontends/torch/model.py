@@ -38,8 +38,10 @@ class PyTorchModel:
         self.module = module
         self.seq_length = seq_length
         self.traced = torch.fx.symbolic_trace(module)
-        # fx node name -> ff node name used when porting weights
-        self.name_map: Dict[str, str] = {}
+        # fx submodule target -> ALL ff node names created from it (a
+        # module applied twice yields two FF nodes; weights are ported
+        # to every instance)
+        self.name_map: Dict[str, List[str]] = {}
 
     # -- the importer -------------------------------------------------
     def torch_to_ff(self, ffmodel, input_tensors: Sequence) -> List:
@@ -62,7 +64,7 @@ class PyTorchModel:
             elif node.op == "call_module":
                 mod = self.traced.get_submodule(node.target)
                 env[node.name] = self._module(ffmodel, node, mod, env)
-                self.name_map[node.target] = node.name
+                self.name_map.setdefault(node.target, []).append(node.name)
             elif node.op == "call_function":
                 env[node.name] = self._function(ffmodel, node, env)
             elif node.op == "call_method":
@@ -143,13 +145,13 @@ class PyTorchModel:
 
         args = [get(a) for a in node.args]
         if t in (operator.add, torch.add):
-            return self._bin_or_scalar(ff, ff.add, ff.scalar_add, args, name)
+            return self._bin_or_scalar(ff, "add", args, name)
         if t in (operator.sub, torch.sub):
-            return self._bin_or_scalar(ff, ff.subtract, ff.scalar_sub, args, name)
+            return self._bin_or_scalar(ff, "sub", args, name)
         if t in (operator.mul, torch.mul):
-            return self._bin_or_scalar(ff, ff.multiply, ff.scalar_multiply, args, name)
+            return self._bin_or_scalar(ff, "mul", args, name)
         if t in (operator.truediv, torch.div):
-            return self._bin_or_scalar(ff, ff.divide, ff.scalar_true_divide, args, name)
+            return self._bin_or_scalar(ff, "div", args, name)
         if t in (F.relu, torch.relu):
             return ff.relu(args[0], name=name)
         if t is F.gelu:
@@ -174,8 +176,13 @@ class PyTorchModel:
             return ff.concat(list(tensors), axis, name=name)
         if t is torch.split:
             axis = node.kwargs.get("dim", args[2] if len(args) > 2 else 0)
-            return ff.split(args[0], args[1], axis, name=name)
+            return ff.split(args[0], self._split_sizes(args[0], args[1], axis), axis, name=name)
         if t is torch.flatten:
+            start = node.kwargs.get("start_dim", args[1] if len(args) > 1 else 0)
+            end = node.kwargs.get("end_dim", args[2] if len(args) > 2 else -1)
+            assert start == 1 and end in (-1, args[0].ndim - 1), (
+                f"only flatten(start_dim=1, end_dim=-1) is supported, got ({start}, {end})"
+            )
             return ff.flat(args[0], name=name)
         if t in (torch.matmul, torch.bmm):
             return ff.batch_matmul(args[0], args[1], name=name)
@@ -217,6 +224,11 @@ class PyTorchModel:
                 shape[shape.index(-1)] = total // known
             return ff.reshape(args[0], tuple(shape), name=name)
         if m == "flatten":
+            start = node.kwargs.get("start_dim", args[1] if len(args) > 1 else 0)
+            end = node.kwargs.get("end_dim", args[2] if len(args) > 2 else -1)
+            assert start == 1 and end in (-1, args[0].ndim - 1), (
+                f"only flatten(start_dim=1, end_dim=-1) is supported, got ({start}, {end})"
+            )
             return ff.flat(args[0], name=name)
         if m == "transpose":
             return self._transpose(ff, args[0], args[1], args[2], name)
@@ -229,22 +241,43 @@ class PyTorchModel:
             return ff.relu(args[0], name=name)
         if m == "split":
             axis = node.kwargs.get("dim", args[2] if len(args) > 2 else 0)
-            return ff.split(args[0], args[1], axis, name=name)
+            return ff.split(args[0], self._split_sizes(args[0], args[1], axis), axis, name=name)
         if m == "mean":
             dims = [args[1]] if isinstance(args[1], int) else list(args[1])
             return ff.mean(args[0], dims, keepdims=node.kwargs.get("keepdim", False), name=name)
         if m in ("add", "sub", "mul", "div"):
-            fn = {"add": (ff.add, ff.scalar_add), "sub": (ff.subtract, ff.scalar_sub), "mul": (ff.multiply, ff.scalar_multiply), "div": (ff.divide, ff.scalar_true_divide)}[m]
-            return self._bin_or_scalar(ff, fn[0], fn[1], args, name)
+            return self._bin_or_scalar(ff, m, args, name)
         raise NotImplementedError(f"unsupported method {m}")
 
     @staticmethod
-    def _bin_or_scalar(ff, bin_fn, scalar_fn, args, name):
+    def _split_sizes(x, arg, axis):
+        """torch.split's int arg is the chunk SIZE; ff.split's int arg is
+        the number of chunks — convert to an explicit size list."""
+        if not isinstance(arg, int):
+            return list(arg)
+        n = x.shape[axis]
+        sizes = [arg] * (n // arg)
+        if n % arg:
+            sizes.append(n % arg)
+        return sizes
+
+    @staticmethod
+    def _bin_or_scalar(ff, kind, args, name):
+        bin_fn = {"add": ff.add, "sub": ff.subtract, "mul": ff.multiply, "div": ff.divide}[kind]
+        scalar_fn = {"add": ff.scalar_add, "sub": ff.scalar_sub, "mul": ff.scalar_multiply, "div": ff.scalar_true_divide}[kind]
         a, b = args[0], args[1]
         if isinstance(b, (int, float)):
             return scalar_fn(a, float(b), name=name)
         if isinstance(a, (int, float)):
-            return scalar_fn(b, float(a), name=name)
+            # scalar on the left: add/mul commute; sub/div need rewriting
+            if kind in ("add", "mul"):
+                return scalar_fn(b, float(a), name=name)
+            if kind == "sub":  # c - x = -x + c
+                neg = ff.scalar_multiply(b, -1.0, inplace=False, name=f"{name}_neg")
+                return ff.scalar_add(neg, float(a), name=name)
+            # c / x = c * x^-1
+            inv = ff.pow(b, -1.0, name=f"{name}_inv")
+            return ff.scalar_multiply(inv, float(a), inplace=False, name=name)
         return bin_fn(a, b, name=name)
 
     @staticmethod
@@ -268,10 +301,12 @@ def torch_to_flexflow(module, ffmodel, input_tensors, seq_length=None):
     return m.torch_to_ff(ffmodel, input_tensors), m
 
 
-def copy_weights(torch_module, ffmodel, name_map: Dict[str, str]) -> None:
+def copy_weights(torch_module, ffmodel, name_map: Dict[str, List[str]]) -> None:
     """Port torch parameters into the compiled executor.
 
-    name_map: fx submodule target -> ff node name (PyTorchModel.name_map).
+    name_map: fx submodule target -> ff node names (PyTorchModel.name_map;
+    one target maps to several nodes when the module is applied more than
+    once — each FF instance receives the shared torch weights).
     The reference's align tests do this via ParallelTensor::set_tensor
     (parallel_tensor.h:165); here we overwrite executor params.
     """
@@ -281,7 +316,12 @@ def copy_weights(torch_module, ffmodel, name_map: Dict[str, str]) -> None:
     ex = ffmodel.executor
     assert ex is not None, "compile() the ffmodel first"
     by_name = {n.name: n for n in ffmodel.graph.nodes.values() if n.name}
-    for target, ff_name in name_map.items():
+    pairs = [
+        (target, ff_name)
+        for target, ff_names in name_map.items()
+        for ff_name in (ff_names if isinstance(ff_names, list) else [ff_names])
+    ]
+    for target, ff_name in pairs:
         mod = torch_module.get_submodule(target)
         node = by_name.get(ff_name)
         if node is None:

@@ -140,6 +140,15 @@ class FFModel:
         return self._one(OpType.WEIGHT, params, [], name=name)
 
     # ------------------------------------------------------------- layers
+    @staticmethod
+    def _acti(activation) -> ActiMode:
+        """Accept ActiMode, its string value ("relu"), or None."""
+        if activation is None:
+            return ActiMode.NONE
+        if isinstance(activation, ActiMode):
+            return activation
+        return ActiMode(activation)
+
     def dense(
         self,
         input: Tensor,
@@ -151,7 +160,7 @@ class FFModel:
         bias_initializer: str = "zeros",
         name: str = "",
     ) -> Tensor:
-        p = linear_mod.LinearParams(out_dim, use_bias, activation, datatype, kernel_initializer, bias_initializer)
+        p = linear_mod.LinearParams(out_dim, use_bias, self._acti(activation), datatype, kernel_initializer, bias_initializer)
         return self._one(OpType.LINEAR, p, [input], name=name)
 
     def conv2d(
@@ -176,7 +185,7 @@ class FFModel:
             (padding_h, padding_w),
             groups,
             use_bias,
-            activation,
+            self._acti(activation),
             input.dtype,
         )
         return self._one(OpType.CONV2D, p, [input], name=name)
@@ -194,7 +203,7 @@ class FFModel:
         activation: ActiMode = ActiMode.NONE,
         name: str = "",
     ) -> Tensor:
-        p = conv_mod.Pool2DParams((kernel_h, kernel_w), (stride_h, stride_w), (padding_h, padding_w), pool_type, activation)
+        p = conv_mod.Pool2DParams((kernel_h, kernel_w), (stride_h, stride_w), (padding_h, padding_w), pool_type, self._acti(activation))
         return self._one(OpType.POOL2D, p, [input], name=name)
 
     def embedding(

@@ -45,11 +45,25 @@ class SingleDataLoader:
     def next_epoch(self):
         self._epoch += 1
 
+    def _gather(self, idx: np.ndarray) -> np.ndarray:
+        """Assemble one batch; native threaded row-gather when available
+        (the TPU-side analog of the reference's CUDA copy kernels in
+        flexflow_dataloader.cu — here the copy is host-side, the
+        host->HBM DMA happens in device_put)."""
+        try:
+            from .._native import batch_gather
+
+            out = np.empty((len(idx),) + self.data.shape[1:], self.data.dtype)
+            batch_gather(self.data, out, idx)
+            return out
+        except Exception:
+            return self.data[idx]
+
     def batches(self) -> Iterator[jax.Array]:
         order = self._order()
         for b in range(self.num_batches):
             idx = order[b * self.batch_size : (b + 1) * self.batch_size]
-            batch = self.data[idx]
+            batch = self._gather(idx)
             if self.sharding is not None:
                 yield jax.device_put(batch, self.sharding)
             else:
@@ -90,6 +104,18 @@ class DataLoader:
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
 
+        def put(item) -> bool:
+            """Bounded put that keeps checking stop so an abandoned epoch
+            (consumer broke out of the generator) can't wedge the thread
+            on a full queue."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def producer():
             iters = [ld.batches() for ld in self.loaders] + [self.label_loader.batches()]
             try:
@@ -97,10 +123,11 @@ class DataLoader:
                     if stop.is_set():
                         return
                     vals = [next(it) for it in iters]
-                    q.put((vals[:-1], vals[-1]))
-                q.put(None)
+                    if not put((vals[:-1], vals[-1])):
+                        return
+                put(None)
             except Exception as e:  # surface worker errors to the consumer
-                q.put(e)
+                put(e)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()

@@ -400,6 +400,17 @@ class LogicalTaskgraphSimulator:
     def __init__(self, machine_model: MachineModel, cost_model: Optional[CostModel] = None):
         self.machine_model = machine_model
         self.cost_model = cost_model or CostModel()
+        self._native_mm = None  # lazily-mirrored ffcore machine model
+
+    def _native(self):
+        if self._native_mm is None:
+            try:
+                from .._native import NativeMachineModel
+
+                self._native_mm = NativeMachineModel.from_python(self.machine_model)
+            except Exception:
+                self._native_mm = False
+        return self._native_mm or None
 
     def simulate_allreduce(
         self,
@@ -409,6 +420,11 @@ class LogicalTaskgraphSimulator:
     ) -> float:
         """Simulate one allreduce pattern as synchronized p2p rounds with
         congestion: transfers in a round sharing a physical link serialize."""
+        nmm = self._native()
+        if nmm is not None:
+            return nmm.allreduce_time(
+                list(participants), nbytes, AllreduceHelper.PATTERNS[option]
+            )
         rounds = AllreduceHelper.expand(option, participants, nbytes)
         total = 0.0
         record = isinstance(self.machine_model, NetworkedMachineModel)

@@ -1,0 +1,127 @@
+/* ffcore — native runtime core for the flexflow_tpu framework.
+ *
+ * C API consumed by flexflow_tpu/_native via ctypes (the TPU-native
+ * analog of the reference's C API python/flexflow_c.h: there C wraps the
+ * C++ FFModel for Python cffi; here C wraps the native search/runtime
+ * engine for the Python/JAX host).
+ *
+ * Subsystems (reference files they correspond to):
+ *   - taskgraph simulator  : src/runtime/simulator.cc simulate_runtime
+ *   - machine models       : src/runtime/machine_model.cc, network.cc
+ *   - allreduce schedules  : fork AllreduceHelper simulator.h:614-651,
+ *                            pattern generators simulator.cc:2870+
+ *   - batch gather/shuffle : python/flexflow_dataloader.cc SingleDataLoader
+ */
+#ifndef FFCORE_H
+#define FFCORE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+const char *ffc_version(void);
+
+/* ------------------------------------------------------------------ *
+ * Task-graph simulator (event-driven, per-device serialization).
+ * Task kinds mirror flexflow_tpu/search/simulator.py TASK_*.
+ * ------------------------------------------------------------------ */
+typedef struct ffc_taskgraph ffc_taskgraph_t;
+
+ffc_taskgraph_t *ffc_taskgraph_create(void);
+void ffc_taskgraph_destroy(ffc_taskgraph_t *tg);
+
+/* Returns the new task id (dense, starting at 0). device -1 = unbound
+ * (pure communication edge: no device serialization). */
+int64_t ffc_taskgraph_add_task(ffc_taskgraph_t *tg, int32_t kind,
+                               int64_t device, double run_time);
+/* Bulk add; returns id of the first task added. */
+int64_t ffc_taskgraph_add_tasks(ffc_taskgraph_t *tg, int64_t n,
+                                const int32_t *kinds, const int64_t *devices,
+                                const double *run_times);
+/* 0 on success, -1 on bad ids. */
+int32_t ffc_taskgraph_add_dep(ffc_taskgraph_t *tg, int64_t src, int64_t dst);
+int32_t ffc_taskgraph_add_deps(ffc_taskgraph_t *tg, int64_t n,
+                               const int64_t *srcs, const int64_t *dsts);
+
+int64_t ffc_taskgraph_num_tasks(const ffc_taskgraph_t *tg);
+
+/* Event-driven replay; returns makespan in seconds, or -1.0 if the
+ * graph deadlocks (a dependency cycle). Destroys scheduling state but
+ * the graph may be re-simulated (counters are rebuilt per call). */
+double ffc_taskgraph_simulate(ffc_taskgraph_t *tg);
+
+/* ------------------------------------------------------------------ *
+ * Machine models.
+ * ------------------------------------------------------------------ */
+typedef struct ffc_machine_model ffc_mm_t;
+
+/* Flat two-level model (reference: SimpleMachineModel
+ * machine_model.cc:58): intra-node = ICI hop, inter-node = DCN hop. */
+ffc_mm_t *ffc_mm_create_simple(int32_t num_nodes, int32_t devices_per_node,
+                               double ici_latency, double ici_bandwidth,
+                               double dcn_latency, double dcn_bandwidth);
+
+/* Topology-aware model (fork: NetworkedMachineModel simulator.h:668-758).
+ * conn: (num_nodes+num_switches)^2 row-major link-multiplicity matrix.
+ * routing: 0 = shortest path (hop count), 1 = weighted shortest
+ * (1/multiplicity edge weight), 2 = ECMP multi-path. */
+ffc_mm_t *ffc_mm_create_networked(int32_t num_nodes, int32_t num_switches,
+                                  int32_t devices_per_node,
+                                  const int32_t *conn, double link_latency,
+                                  double link_bandwidth, double ici_latency,
+                                  double ici_bandwidth, int32_t routing,
+                                  int32_t ecmp_max_paths);
+
+void ffc_mm_destroy(ffc_mm_t *mm);
+int32_t ffc_mm_num_devices(const ffc_mm_t *mm);
+
+/* Seconds to move nbytes from device src to device dst. */
+double ffc_mm_comm_time(ffc_mm_t *mm, int32_t src_dev, int32_t dst_dev,
+                        double nbytes);
+
+/* Routes between *nodes* (networked model only). Writes each path's
+ * endpoint ids into out (row-major, max_len per row) and its length
+ * into path_lens. Returns the number of paths (0 for same node or no
+ * route; -1 if mm is not networked). */
+int32_t ffc_mm_get_routes(ffc_mm_t *mm, int32_t src_node, int32_t dst_node,
+                          int32_t *out, int32_t *path_lens, int32_t max_paths,
+                          int32_t max_len);
+
+/* ------------------------------------------------------------------ *
+ * Allreduce schedule engine (fork parity).
+ * pattern: 0 = ring, 1 = butterfly, 2 = double binary tree.
+ * ------------------------------------------------------------------ */
+
+/* Simulate one allreduce over the machine model as synchronized p2p
+ * rounds; transfers sharing a physical link within a round congest
+ * (mirror of LogicalTaskgraphSimulator.simulate_allreduce). */
+double ffc_allreduce_simulate(ffc_mm_t *mm, const int32_t *participants,
+                              int32_t n, double nbytes, int32_t pattern);
+
+/* Evaluate all three patterns; writes times into out_times[3] (ring,
+ * butterfly, dbt) and returns the argmin pattern id. */
+int32_t ffc_allreduce_optimize(ffc_mm_t *mm, const int32_t *participants,
+                               int32_t n, double nbytes, double *out_times);
+
+/* ------------------------------------------------------------------ *
+ * Dataloader kernels (reference: SingleDataLoader's batched index
+ * loads, python/flexflow_dataloader.cc).
+ * ------------------------------------------------------------------ */
+
+/* dst[i] = src[idx[i]] row gather; rows are row_bytes wide. Spreads the
+ * copy across num_threads (<=0: hardware concurrency). 0 on success. */
+int32_t ffc_batch_gather(const void *src, void *dst, const int64_t *idx,
+                         int64_t n_rows, int64_t row_bytes,
+                         int32_t num_threads);
+
+/* Deterministic in-place Fisher-Yates shuffle (splitmix64 stream). */
+void ffc_shuffle_indices(int64_t *idx, int64_t n, uint64_t seed);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* FFCORE_H */

@@ -1,0 +1,169 @@
+// Allreduce schedule engine (fork parity): expand an allreduce over n
+// participants into synchronized p2p rounds for ring / butterfly /
+// double-binary-tree patterns, and simulate them over a machine model
+// with per-round link congestion.
+//
+// Reference: AllreduceHelper (simulator.h:614-651), pattern generators
+// (simulator.cc:2870+), simulation_with_allreduce_optimize
+// (simulator.cc:1721). Python mirror: search/simulator.py
+// AllreduceHelper / LogicalTaskgraphSimulator.simulate_allreduce —
+// generation order and congestion accounting match it transfer-for-
+// transfer so both backends agree.
+
+#include <algorithm>
+#include <cmath>
+#include <map>
+
+#include "ffcore.h"
+#include "ffcore_internal.h"
+
+namespace ffcore {
+
+namespace {
+
+struct Transfer {
+  int32_t src, dst;
+  double bytes;
+};
+using Rounds = std::vector<std::vector<Transfer>>;
+
+Rounds ring_rounds(const int32_t *p, int32_t n, double nbytes) {
+  Rounds rounds;
+  if (n <= 1) return rounds;
+  double chunk = nbytes / n;
+  for (int32_t r = 0; r < 2 * (n - 1); r++) {  // reduce-scatter + all-gather
+    std::vector<Transfer> round;
+    for (int32_t i = 0; i < n; i++) round.push_back({p[i], p[(i + 1) % n], chunk});
+    rounds.push_back(std::move(round));
+  }
+  return rounds;
+}
+
+Rounds butterfly_rounds(const int32_t *p, int32_t n, double nbytes) {
+  Rounds rounds;
+  if (n <= 1) return rounds;
+  int32_t steps = std::max(1, (int32_t)std::ceil(std::log2((double)n)));
+  double size = nbytes;
+  for (int32_t k = 0; k < steps; k++) {  // recursive halving
+    int32_t dist = 1 << k;
+    std::vector<Transfer> round;
+    for (int32_t i = 0; i < n; i++)
+      if ((i ^ dist) < n) round.push_back({p[i], p[i ^ dist], size / 2});
+    rounds.push_back(std::move(round));
+    size /= 2;
+  }
+  for (int32_t k = steps - 1; k >= 0; k--) {  // recursive doubling
+    int32_t dist = 1 << k;
+    size *= 2;
+    std::vector<Transfer> round;
+    for (int32_t i = 0; i < n; i++)
+      if ((i ^ dist) < n) round.push_back({p[i], p[i ^ dist], size / 2});
+    rounds.push_back(std::move(round));
+  }
+  return rounds;
+}
+
+Rounds dbt_rounds(const int32_t *p, int32_t n, double nbytes) {
+  Rounds rounds;
+  if (n <= 1) return rounds;
+  double half = nbytes / 2;  // each tree carries half the payload
+  auto tree_rounds = [&](const std::vector<int32_t> &order) {
+    int32_t depth = std::max(1, (int32_t)std::ceil(std::log2((double)n)));
+    Rounds up;
+    for (int32_t lvl = 0; lvl < depth; lvl++) {  // reduce toward the root
+      int32_t step = 1 << (lvl + 1);
+      std::vector<Transfer> r;
+      for (int32_t i = 0; i < n; i += step) {
+        int32_t j = i + (1 << lvl);
+        if (j < n) r.push_back({order[j], order[i], half});
+      }
+      if (!r.empty()) up.push_back(std::move(r));
+    }
+    Rounds down;  // broadcast back down: reversed rounds, flipped edges
+    for (auto it = up.rbegin(); it != up.rend(); ++it) {
+      std::vector<Transfer> r;
+      for (const auto &t : *it) r.push_back({t.dst, t.src, t.bytes});
+      down.push_back(std::move(r));
+    }
+    Rounds all = up;
+    all.insert(all.end(), down.begin(), down.end());
+    return all;
+  };
+  std::vector<int32_t> fwd(p, p + n), rev(fwd.rbegin(), fwd.rend());
+  Rounds t1 = tree_rounds(fwd), t2 = tree_rounds(rev);
+  size_t len = std::max(t1.size(), t2.size());
+  for (size_t i = 0; i < len; i++) {
+    std::vector<Transfer> r;
+    if (i < t1.size()) r.insert(r.end(), t1[i].begin(), t1[i].end());
+    if (i < t2.size()) r.insert(r.end(), t2[i].begin(), t2[i].end());
+    rounds.push_back(std::move(r));
+  }
+  return rounds;
+}
+
+}  // namespace
+
+double allreduce_simulate(MachineModel &mm, const int32_t *participants,
+                          int32_t n, double nbytes, int32_t pattern) {
+  Rounds rounds;
+  switch (pattern) {
+    case 0: rounds = ring_rounds(participants, n, nbytes); break;
+    case 1: rounds = butterfly_rounds(participants, n, nbytes); break;
+    case 2: rounds = dbt_rounds(participants, n, nbytes); break;
+    default: return -1.0;
+  }
+  bool networked = mm.kind == MachineModel::NETWORKED;
+  double total = 0.0;
+  for (const auto &round : rounds) {
+    std::map<std::pair<int32_t, int32_t>, double> link_load;
+    double round_t = 0.0;
+    for (const auto &tr : round) {
+      double t = mm.comm_time(tr.src, tr.dst, tr.bytes);
+      if (networked) {
+        int32_t sn = mm.node_of(tr.src), dn = mm.node_of(tr.dst);
+        double cong = 1.0;
+        if (sn != dn) {
+          const auto &rs = mm.routes(sn, dn);
+          if (!rs.empty()) {  // only the primary route congests (python parity)
+            const auto &path = rs[0];
+            for (size_t i = 0; i + 1 < path.size(); i++) {
+              auto key = std::make_pair(path[i], path[i + 1]);
+              link_load[key] += 1.0;
+              cong = std::max(cong, link_load[key]);
+            }
+          }
+        }
+        t *= cong;
+      }
+      round_t = std::max(round_t, t);
+    }
+    total += round_t;
+  }
+  return total;
+}
+
+}  // namespace ffcore
+
+extern "C" {
+
+double ffc_allreduce_simulate(ffc_mm_t *mm, const int32_t *participants,
+                              int32_t n, double nbytes, int32_t pattern) {
+  return ffcore::allreduce_simulate(*mm, participants, n, nbytes, pattern);
+}
+
+int32_t ffc_allreduce_optimize(ffc_mm_t *mm, const int32_t *participants,
+                               int32_t n, double nbytes, double *out_times) {
+  int32_t best = 0;
+  double best_t = std::numeric_limits<double>::infinity();
+  for (int32_t pat = 0; pat < 3; pat++) {
+    double t = ffcore::allreduce_simulate(*mm, participants, n, nbytes, pat);
+    if (out_times) out_times[pat] = t;
+    if (t < best_t) {
+      best_t = t;
+      best = pat;
+    }
+  }
+  return best;
+}
+
+}  // extern "C"

@@ -1,0 +1,67 @@
+// Dataloader kernels: multithreaded batch row-gather and deterministic
+// index shuffling.
+//
+// Reference: SingleDataLoader (python/flexflow_dataloader.cc:34+) keeps
+// the full dataset in host DRAM and issues per-batch index load tasks;
+// the CUDA copy kernels become plain parallel memcpy on the host here —
+// the host->TPU transfer itself is jax.device_put on the gathered batch.
+
+#include <algorithm>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "ffcore.h"
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t &state) {
+  uint64_t z = (state += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t ffc_batch_gather(const void *src, void *dst, const int64_t *idx,
+                         int64_t n_rows, int64_t row_bytes,
+                         int32_t num_threads) {
+  if (!src || !dst || !idx || n_rows < 0 || row_bytes <= 0) return -1;
+  const char *s = (const char *)src;
+  char *d = (char *)dst;
+  int32_t hw = (int32_t)std::thread::hardware_concurrency();
+  if (num_threads <= 0) num_threads = hw > 0 ? hw : 4;
+  // not worth spawning threads for small batches
+  if (n_rows * row_bytes < (1 << 20) || num_threads == 1) {
+    for (int64_t i = 0; i < n_rows; i++)
+      std::memcpy(d + i * row_bytes, s + idx[i] * row_bytes, (size_t)row_bytes);
+    return 0;
+  }
+  num_threads = (int32_t)std::min<int64_t>(num_threads, n_rows);
+  std::vector<std::thread> workers;
+  int64_t chunk = (n_rows + num_threads - 1) / num_threads;
+  for (int32_t t = 0; t < num_threads; t++) {
+    int64_t lo = t * chunk, hi = std::min(n_rows, lo + chunk);
+    if (lo >= hi) break;
+    workers.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; i++)
+        std::memcpy(d + i * row_bytes, s + idx[i] * row_bytes,
+                    (size_t)row_bytes);
+    });
+  }
+  for (auto &w : workers) w.join();
+  return 0;
+}
+
+void ffc_shuffle_indices(int64_t *idx, int64_t n, uint64_t seed) {
+  uint64_t state = seed;
+  for (int64_t i = n - 1; i > 0; i--) {
+    int64_t j = (int64_t)(splitmix64(state) % (uint64_t)(i + 1));
+    std::swap(idx[i], idx[j]);
+  }
+}
+
+}  // extern "C"

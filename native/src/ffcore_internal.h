@@ -1,0 +1,72 @@
+// Internal C++ structures behind the ffcore C API.
+#ifndef FFCORE_INTERNAL_H
+#define FFCORE_INTERNAL_H
+
+#include <cmath>
+#include <cstdint>
+#include <limits>
+#include <map>
+#include <utility>
+#include <vector>
+
+namespace ffcore {
+
+// ---------------------------------------------------------------- taskgraph
+struct Task {
+  int32_t kind;
+  int64_t device;  // -1: unbound (comm edge)
+  double run_time;
+  std::vector<int64_t> next;
+  int64_t n_deps = 0;  // static in-degree
+};
+
+struct TaskGraph {
+  std::vector<Task> tasks;
+};
+
+// ------------------------------------------------------------ machine model
+// Mirrors flexflow_tpu/search/machine_model.py semantics exactly so the
+// Python and native paths agree bit-for-bit on schedule decisions.
+struct MachineModel {
+  enum Kind { SIMPLE, NETWORKED } kind;
+
+  // shared
+  int32_t num_nodes = 1;
+  int32_t devices_per_node = 1;
+  double ici_latency = 1e-6, ici_bandwidth = 100e9;
+
+  // simple
+  double dcn_latency = 10e-6, dcn_bandwidth = 25e9;
+
+  // networked
+  int32_t num_switches = 0;
+  std::vector<int32_t> conn;  // (E x E) link multiplicity, E = nodes+switches
+  double link_latency = 10e-6, link_bandwidth = 25e9;
+  int32_t routing = 1;  // 0 shortest, 1 weighted shortest, 2 ecmp
+  int32_t ecmp_max_paths = 4;
+  std::map<std::pair<int32_t, int32_t>, std::vector<std::vector<int32_t>>>
+      route_cache;
+
+  int32_t num_endpoints() const { return num_nodes + num_switches; }
+  int32_t num_devices() const { return num_nodes * devices_per_node; }
+  int32_t node_of(int32_t dev) const { return dev / devices_per_node; }
+  int32_t links(int32_t u, int32_t v) const {
+    return conn[(size_t)u * num_endpoints() + v];
+  }
+
+  const std::vector<std::vector<int32_t>> &routes(int32_t src_node,
+                                                  int32_t dst_node);
+  double comm_time(int32_t src_dev, int32_t dst_dev, double nbytes);
+};
+
+double simulate_taskgraph(TaskGraph &tg);
+
+double allreduce_simulate(MachineModel &mm, const int32_t *participants,
+                          int32_t n, double nbytes, int32_t pattern);
+
+}  // namespace ffcore
+
+struct ffc_taskgraph : ffcore::TaskGraph {};
+struct ffc_machine_model : ffcore::MachineModel {};
+
+#endif  // FFCORE_INTERNAL_H

@@ -1,0 +1,203 @@
+// Machine models: flat two-level (SimpleMachineModel parity) and the
+// fork's topology-aware NetworkedMachineModel with routing strategies
+// (reference: src/runtime/machine_model.cc, network.cc:48-640;
+// python mirror: flexflow_tpu/search/machine_model.py).
+//
+// The Dijkstra here replicates the Python implementation's tie-breaking
+// ((dist, node) lexicographic pops, strict improvement, neighbors in
+// index order) so route choices — and therefore simulated times — are
+// identical across backends.
+
+#include <algorithm>
+#include <cmath>
+#include <queue>
+#include <set>
+
+#include "ffcore.h"
+#include "ffcore_internal.h"
+
+namespace ffcore {
+
+namespace {
+
+constexpr double kInf = std::numeric_limits<double>::infinity();
+
+// weight_fn(u, v, links) -> edge weight; removed edges get +inf.
+template <typename WeightFn>
+std::vector<int32_t> dijkstra(const MachineModel &mm, int32_t src, int32_t dst,
+                              WeightFn weight_fn) {
+  const int32_t n = mm.num_endpoints();
+  std::vector<double> dist(n, kInf);
+  std::vector<int32_t> prev(n, -1);
+  dist[src] = 0.0;
+  using Item = std::pair<double, int32_t>;
+  std::priority_queue<Item, std::vector<Item>, std::greater<Item>> pq;
+  pq.push({0.0, src});
+  while (!pq.empty()) {
+    auto [d, u] = pq.top();
+    pq.pop();
+    if (u == dst) break;
+    if (d > dist[u]) continue;
+    for (int32_t v = 0; v < n; v++) {
+      int32_t links = mm.links(u, v);
+      if (!links) continue;
+      double w = weight_fn(u, v, links);
+      double nd = d + w;
+      if (nd < dist[v]) {
+        dist[v] = nd;
+        prev[v] = u;
+        pq.push({nd, v});
+      }
+    }
+  }
+  if (dist[dst] == kInf) return {};
+  std::vector<int32_t> path = {dst};
+  while (path.back() != src) {
+    int32_t p = prev[path.back()];
+    if (p < 0) return {};
+    path.push_back(p);
+  }
+  std::reverse(path.begin(), path.end());
+  return path;
+}
+
+std::vector<std::vector<int32_t>> compute_routes(MachineModel &mm, int32_t src,
+                                                 int32_t dst) {
+  std::vector<std::vector<int32_t>> paths;
+  if (mm.routing == 0) {  // hop-count shortest
+    auto p = dijkstra(mm, src, dst, [](int32_t, int32_t, int32_t) { return 1.0; });
+    if (!p.empty()) paths.push_back(std::move(p));
+  } else if (mm.routing == 1) {  // weighted by inverse multiplicity
+    auto p = dijkstra(mm, src, dst,
+                      [](int32_t, int32_t, int32_t l) { return 1.0 / l; });
+    if (!p.empty()) paths.push_back(std::move(p));
+  } else {  // ECMP: k paths by removing the first hop of the last path
+    std::set<std::pair<int32_t, int32_t>> removed;
+    auto w = [&removed](int32_t u, int32_t v, int32_t) {
+      return removed.count({u, v}) ? kInf : 1.0;
+    };
+    auto base = dijkstra(mm, src, dst, w);
+    if (base.empty()) return paths;
+    size_t base_len = base.size();
+    paths.push_back(std::move(base));
+    while ((int32_t)paths.size() < mm.ecmp_max_paths) {
+      const auto &last = paths.back();
+      removed.insert({last[0], last[1]});
+      auto p = dijkstra(mm, src, dst, w);
+      if (p.empty() || p.size() > base_len) break;
+      if (std::find(paths.begin(), paths.end(), p) == paths.end())
+        paths.push_back(std::move(p));
+      else
+        break;  // same path re-found: no further diversity available
+    }
+  }
+  return paths;
+}
+
+}  // namespace
+
+const std::vector<std::vector<int32_t>> &MachineModel::routes(int32_t src_node,
+                                                              int32_t dst_node) {
+  auto key = std::make_pair(src_node, dst_node);
+  auto it = route_cache.find(key);
+  if (it == route_cache.end())
+    it = route_cache.emplace(key, compute_routes(*this, src_node, dst_node))
+             .first;
+  return it->second;
+}
+
+double MachineModel::comm_time(int32_t src_dev, int32_t dst_dev,
+                               double nbytes) {
+  if (kind == SIMPLE) {
+    if (src_dev == dst_dev) return 0.0;
+    bool same_node = src_dev / devices_per_node == dst_dev / devices_per_node;
+    if (same_node) return ici_latency + nbytes / ici_bandwidth;
+    return dcn_latency + nbytes / dcn_bandwidth;
+  }
+  // networked
+  int32_t sn = node_of(src_dev), dn = node_of(dst_dev);
+  if (sn == dn) {
+    if (src_dev == dst_dev) return 0.0;
+    return ici_latency + nbytes / ici_bandwidth;
+  }
+  const auto &rs = routes(sn, dn);
+  if (rs.empty()) return link_latency + nbytes / link_bandwidth;
+  double share = nbytes / (double)rs.size();
+  double t = 0.0;
+  for (const auto &path : rs) {
+    double bw = kInf;
+    for (size_t i = 0; i + 1 < path.size(); i++) {
+      int32_t l = links(path[i], path[i + 1]);
+      bw = std::min(bw, link_bandwidth * std::max(1, l));
+    }
+    double lat = link_latency * (double)(path.size() - 1);
+    t = std::max(t, lat + share / bw);
+  }
+  return t;
+}
+
+}  // namespace ffcore
+
+extern "C" {
+
+ffc_mm_t *ffc_mm_create_simple(int32_t num_nodes, int32_t devices_per_node,
+                               double ici_latency, double ici_bandwidth,
+                               double dcn_latency, double dcn_bandwidth) {
+  auto *mm = new ffc_machine_model();
+  mm->kind = ffcore::MachineModel::SIMPLE;
+  mm->num_nodes = num_nodes;
+  mm->devices_per_node = devices_per_node;
+  mm->ici_latency = ici_latency;
+  mm->ici_bandwidth = ici_bandwidth;
+  mm->dcn_latency = dcn_latency;
+  mm->dcn_bandwidth = dcn_bandwidth;
+  return mm;
+}
+
+ffc_mm_t *ffc_mm_create_networked(int32_t num_nodes, int32_t num_switches,
+                                  int32_t devices_per_node,
+                                  const int32_t *conn, double link_latency,
+                                  double link_bandwidth, double ici_latency,
+                                  double ici_bandwidth, int32_t routing,
+                                  int32_t ecmp_max_paths) {
+  auto *mm = new ffc_machine_model();
+  mm->kind = ffcore::MachineModel::NETWORKED;
+  mm->num_nodes = num_nodes;
+  mm->num_switches = num_switches;
+  mm->devices_per_node = devices_per_node;
+  int32_t e = num_nodes + num_switches;
+  mm->conn.assign(conn, conn + (size_t)e * e);
+  mm->link_latency = link_latency;
+  mm->link_bandwidth = link_bandwidth;
+  mm->ici_latency = ici_latency;
+  mm->ici_bandwidth = ici_bandwidth;
+  mm->routing = routing;
+  mm->ecmp_max_paths = ecmp_max_paths > 0 ? ecmp_max_paths : 4;
+  return mm;
+}
+
+void ffc_mm_destroy(ffc_mm_t *mm) { delete mm; }
+
+int32_t ffc_mm_num_devices(const ffc_mm_t *mm) { return mm->num_devices(); }
+
+double ffc_mm_comm_time(ffc_mm_t *mm, int32_t src_dev, int32_t dst_dev,
+                        double nbytes) {
+  return mm->comm_time(src_dev, dst_dev, nbytes);
+}
+
+int32_t ffc_mm_get_routes(ffc_mm_t *mm, int32_t src_node, int32_t dst_node,
+                          int32_t *out, int32_t *path_lens, int32_t max_paths,
+                          int32_t max_len) {
+  if (mm->kind != ffcore::MachineModel::NETWORKED) return -1;
+  if (src_node == dst_node) return 0;
+  const auto &rs = mm->routes(src_node, dst_node);
+  int32_t np = std::min((int32_t)rs.size(), max_paths);
+  for (int32_t p = 0; p < np; p++) {
+    int32_t len = std::min((int32_t)rs[p].size(), max_len);
+    path_lens[p] = len;
+    for (int32_t i = 0; i < len; i++) out[p * max_len + i] = rs[p][i];
+  }
+  return np;
+}
+
+}  // extern "C"

@@ -1,0 +1,104 @@
+// Event-driven task-graph simulator.
+//
+// Native mirror of flexflow_tpu/search/simulator.py::_simulate, itself
+// modeled on the reference's simulate_runtime (src/runtime/simulator.cc:856):
+// dependency-ordered replay with per-device serialization. Ties broken by
+// (ready_time, task id) exactly like the Python heap so both backends
+// produce identical makespans.
+
+#include <algorithm>
+#include <queue>
+#include <unordered_map>
+
+#include "ffcore.h"
+#include "ffcore_internal.h"
+
+namespace ffcore {
+
+double simulate_taskgraph(TaskGraph &tg) {
+  const int64_t n = (int64_t)tg.tasks.size();
+  std::vector<int64_t> counter(n);
+  std::vector<double> ready_time(n, 0.0);
+  using Item = std::pair<double, int64_t>;  // (ready_time, id)
+  std::priority_queue<Item, std::vector<Item>, std::greater<Item>> ready;
+  for (int64_t i = 0; i < n; i++) {
+    counter[i] = tg.tasks[i].n_deps;
+    if (counter[i] == 0) ready.push({0.0, i});
+  }
+  std::unordered_map<int64_t, double> device_free;
+  double finish_all = 0.0;
+  int64_t done = 0;
+  while (!ready.empty()) {
+    auto [rt, i] = ready.top();
+    ready.pop();
+    const Task &t = tg.tasks[i];
+    double start = rt;
+    if (t.device >= 0) {
+      auto it = device_free.find(t.device);
+      double free_at = it == device_free.end() ? 0.0 : it->second;
+      start = std::max(rt, free_at);
+    }
+    double end = start + t.run_time;
+    if (t.device >= 0) device_free[t.device] = end;
+    finish_all = std::max(finish_all, end);
+    done++;
+    for (int64_t j : t.next) {
+      counter[j]--;
+      ready_time[j] = std::max(ready_time[j], end);
+      if (counter[j] == 0) ready.push({ready_time[j], j});
+    }
+  }
+  if (done != n) return -1.0;  // deadlock (cycle)
+  return finish_all;
+}
+
+}  // namespace ffcore
+
+extern "C" {
+
+ffc_taskgraph_t *ffc_taskgraph_create(void) { return new ffc_taskgraph(); }
+
+void ffc_taskgraph_destroy(ffc_taskgraph_t *tg) { delete tg; }
+
+int64_t ffc_taskgraph_add_task(ffc_taskgraph_t *tg, int32_t kind,
+                               int64_t device, double run_time) {
+  tg->tasks.push_back({kind, device, run_time, {}, 0});
+  return (int64_t)tg->tasks.size() - 1;
+}
+
+int64_t ffc_taskgraph_add_tasks(ffc_taskgraph_t *tg, int64_t n,
+                                const int32_t *kinds, const int64_t *devices,
+                                const double *run_times) {
+  int64_t first = (int64_t)tg->tasks.size();
+  tg->tasks.reserve(tg->tasks.size() + (size_t)n);
+  for (int64_t i = 0; i < n; i++)
+    tg->tasks.push_back({kinds[i], devices[i], run_times[i], {}, 0});
+  return first;
+}
+
+int32_t ffc_taskgraph_add_dep(ffc_taskgraph_t *tg, int64_t src, int64_t dst) {
+  int64_t n = (int64_t)tg->tasks.size();
+  if (src < 0 || dst < 0 || src >= n || dst >= n) return -1;
+  tg->tasks[src].next.push_back(dst);
+  tg->tasks[dst].n_deps++;
+  return 0;
+}
+
+int32_t ffc_taskgraph_add_deps(ffc_taskgraph_t *tg, int64_t n,
+                               const int64_t *srcs, const int64_t *dsts) {
+  for (int64_t i = 0; i < n; i++)
+    if (ffc_taskgraph_add_dep(tg, srcs[i], dsts[i]) != 0) return -1;
+  return 0;
+}
+
+int64_t ffc_taskgraph_num_tasks(const ffc_taskgraph_t *tg) {
+  return (int64_t)tg->tasks.size();
+}
+
+double ffc_taskgraph_simulate(ffc_taskgraph_t *tg) {
+  return ffcore::simulate_taskgraph(*tg);
+}
+
+const char *ffc_version(void) { return "ffcore 0.1.0"; }
+
+}  // extern "C"

@@ -1,0 +1,211 @@
+"""Parity tests: native ffcore engine vs. the pure-Python implementations.
+
+The native library (native/, built to flexflow_tpu/_native/libffcore.so)
+mirrors search/simulator.py and search/machine_model.py semantics
+exactly — these tests pin that equivalence so either backend can serve
+the Unity search. Reference analog: tests/unit/ gtest coverage of
+machine-view/graph logic (SURVEY.md §4), plus the fact that the
+reference's simulator IS its C++ hot loop.
+"""
+import random
+
+import numpy as np
+import pytest
+
+try:
+    from flexflow_tpu import _native as N
+except ImportError:  # no compiler available
+    N = None
+
+from flexflow_tpu.core.types import ParameterSyncOption
+from flexflow_tpu.search.machine_model import (
+    NetworkedMachineModel,
+    NetworkTopology,
+    SimpleMachineModel,
+)
+from flexflow_tpu.search.simulator import (
+    LogicalTaskgraphSimulator,
+    TaskManager,
+)
+
+pytestmark = pytest.mark.skipif(N is None, reason="native ffcore unavailable")
+
+
+def _python_simulate(tm: TaskManager) -> float:
+    """The pure-Python replay, bypassing the native hook in _simulate."""
+    import heapq
+
+    device_free = {}
+    ready = []
+    counters = [t.counter for t in tm.tasks]
+    ready_time = [0.0] * len(tm.tasks)
+    for i, c in enumerate(counters):
+        if c == 0:
+            heapq.heappush(ready, (0.0, i))
+    finish_all = 0.0
+    done = 0
+    while ready:
+        rt, i = heapq.heappop(ready)
+        t = tm.tasks[i]
+        start = max(rt, device_free.get(t.device, 0.0)) if t.device >= 0 else rt
+        end = start + t.run_time
+        if t.device >= 0:
+            device_free[t.device] = end
+        finish_all = max(finish_all, end)
+        done += 1
+        for j in t.next_tasks:
+            counters[j] -= 1
+            ready_time[j] = max(ready_time[j], end)
+            if counters[j] == 0:
+                heapq.heappush(ready, (ready_time[j], j))
+    assert done == len(tm.tasks)
+    return finish_all
+
+
+def _random_dag(n_tasks: int, n_deps: int, n_devices: int, seed: int) -> TaskManager:
+    rng = random.Random(seed)
+    tm = TaskManager()
+    for _ in range(n_tasks):
+        dev = rng.randrange(n_devices) if rng.random() < 0.9 else -1
+        tm.new_task(rng.randrange(5), dev, rng.random() * 1e-3)
+    for _ in range(n_deps):
+        a, b = sorted(rng.sample(range(n_tasks), 2))
+        tm.add_dep(a, b)
+    return tm
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_taskgraph_simulate_parity(seed):
+    tm = _random_dag(300, 600, 8, seed)
+    expected = _python_simulate(tm)
+    got = N.simulate_taskgraph(tm.tasks)
+    assert got == pytest.approx(expected, rel=0, abs=1e-15)
+
+
+def test_taskgraph_deadlock_detected():
+    tm = TaskManager()
+    a = tm.new_task(0, 0, 1e-3)
+    b = tm.new_task(0, 0, 1e-3)
+    tm.add_dep(a, b)
+    tm.add_dep(b, a)
+    with pytest.raises(ValueError):
+        N.simulate_taskgraph(tm.tasks)
+
+
+def test_simple_machine_model_parity():
+    mm = SimpleMachineModel()
+    nm = N.NativeMachineModel.from_python(mm)
+    assert nm.num_devices() == mm.num_devices()
+    for s, d, b in [(0, 0, 1e6), (0, 1, 1e6), (0, 3, 1e9), (1, 5, 1e7), (4, 7, 128.0)]:
+        assert nm.comm_time(s, d, b) == pytest.approx(mm.comm_time(s, d, b), rel=0, abs=0)
+
+
+@pytest.mark.parametrize("routing", ["shortest", "weighted_shortest", "ecmp"])
+@pytest.mark.parametrize(
+    "topo_fn",
+    [
+        lambda: NetworkTopology.fat_tree(4, 2, devices_per_node=4),
+        lambda: NetworkTopology.big_switch(6, devices_per_node=2, uplinks=2),
+        lambda: NetworkTopology.torus((2, 3), devices_per_node=2),
+        lambda: NetworkTopology.flat_deg_constraint(8, 3, devices_per_node=2, seed=1),
+    ],
+)
+def test_networked_machine_model_parity(routing, topo_fn):
+    topo = topo_fn()
+    mm = NetworkedMachineModel(topo, routing=routing)
+    nm = N.NativeMachineModel.from_python(mm)
+    nd = mm.num_devices()
+    for s in range(0, nd, 3):
+        for d in range(0, nd, 5):
+            a, b = mm.comm_time(s, d, 1e6), nm.comm_time(s, d, 1e6)
+            assert b == pytest.approx(a, rel=1e-12), (routing, s, d)
+
+
+def test_routes_parity():
+    topo = NetworkTopology.fat_tree(4, 2, devices_per_node=1)
+    mm = NetworkedMachineModel(topo, routing="ecmp")
+    nm = N.NativeMachineModel.from_python(mm)
+    for s in range(topo.num_nodes):
+        for d in range(topo.num_nodes):
+            if s == d:
+                continue
+            assert nm.get_routes(s, d) == mm.get_routes(s, d), (s, d)
+
+
+@pytest.mark.parametrize(
+    "option,name",
+    [
+        (ParameterSyncOption.RING, "ring"),
+        (ParameterSyncOption.BUTTERFLY, "butterfly"),
+        (ParameterSyncOption.DOUBLE_BINARY_TREE, "double_binary_tree"),
+    ],
+)
+def test_allreduce_parity(option, name):
+    topo = NetworkTopology.fat_tree(4, 2, devices_per_node=2)
+    mm = NetworkedMachineModel(topo, routing="weighted_shortest")
+    nm = N.NativeMachineModel.from_python(mm)
+    lsim = LogicalTaskgraphSimulator(mm)
+    lsim._native_mm = False  # force the pure-Python expansion
+    for parts in [list(range(4)), list(range(16)), [0, 3, 5, 9, 12]]:
+        expected = lsim.simulate_allreduce(option, parts, 1e8)
+        got = nm.allreduce_time(parts, 1e8, name)
+        assert got == pytest.approx(expected, rel=1e-12), parts
+
+
+def test_allreduce_optimize_picks_argmin():
+    topo = NetworkTopology.big_switch(8, devices_per_node=2)
+    mm = NetworkedMachineModel(topo)
+    nm = N.NativeMachineModel.from_python(mm)
+    best, times = nm.allreduce_optimize(list(range(16)), 1e8)
+    assert best in times
+    assert times[best] == min(times.values())
+
+
+def test_simulate_allreduce_uses_native_and_agrees():
+    """The wired-in fast path must agree with the Python expansion."""
+    topo = NetworkTopology.torus((2, 2), devices_per_node=2)
+    mm = NetworkedMachineModel(topo)
+    fast = LogicalTaskgraphSimulator(mm)
+    slow = LogicalTaskgraphSimulator(mm)
+    slow._native_mm = False
+    parts = list(range(8))
+    for opt in ParameterSyncOption:
+        if opt == ParameterSyncOption.DEFAULT:
+            continue
+        assert fast.simulate_allreduce(opt, parts, 5e7) == pytest.approx(
+            slow.simulate_allreduce(opt, parts, 5e7), rel=1e-12
+        )
+
+
+def test_batch_gather_matches_numpy():
+    rs = np.random.RandomState(0)
+    src = rs.randn(500, 8, 3).astype(np.float32)
+    idx = rs.randint(0, 500, size=64)
+    dst = np.empty((64, 8, 3), np.float32)
+    N.batch_gather(src, dst, idx)
+    assert np.array_equal(dst, src[idx])
+
+
+def test_batch_gather_large_multithreaded():
+    rs = np.random.RandomState(1)
+    src = rs.randn(4096, 512).astype(np.float32)  # >1MB: threaded path
+    idx = rs.randint(0, 4096, size=2048)
+    dst = np.empty((2048, 512), np.float32)
+    N.batch_gather(src, dst, idx, num_threads=4)
+    assert np.array_equal(dst, src[idx])
+
+
+def test_batch_gather_rejects_bad_index():
+    src = np.zeros((10, 4), np.float32)
+    dst = np.empty((2, 4), np.float32)
+    with pytest.raises(IndexError):
+        N.batch_gather(src, dst, [0, 10])
+
+
+def test_shuffle_deterministic_permutation():
+    a = N.shuffle_indices(1000, seed=7)
+    b = N.shuffle_indices(1000, seed=7)
+    c = N.shuffle_indices(1000, seed=8)
+    assert np.array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert np.array_equal(np.sort(a), np.arange(1000))

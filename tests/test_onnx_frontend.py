@@ -125,3 +125,70 @@ def test_onnx_elementwise_and_shape_ops():
     b = ff.create_tensor((4, 6, 5))
     outs = ONNXModel(ModelProto(g)).apply(ff, {"a": a, "b": b})
     assert outs[0].shape == (4, 60)
+
+
+def test_onnx_scalar_initializer_binary_ops():
+    """Add/Mul/Sub/Div with a scalar initializer operand (very common in
+    exported graphs) must lower to the scalar op family — including the
+    scalar-on-the-left non-commutative cases."""
+    g = GraphProto(
+        node=[
+            NodeProto("Mul", ["x", "scale"], ["xs"], "mul1"),
+            NodeProto("Sub", ["one", "xs"], ["inv"], "sub1"),   # c - x
+            NodeProto("Div", ["two", "shifted"], ["out"], "div1"),  # c / x
+            NodeProto("Add", ["inv", "three"], ["shifted"], "add1"),
+        ],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("out")],
+        initializer=[
+            Init("scale", np.array([2.0], np.float32)),
+            Init("one", np.array([1.0], np.float32)),
+            Init("two", np.array([2.0], np.float32)),
+            Init("three", np.array([3.0], np.float32)),
+        ],
+    )
+    # reorder nodes topologically (add1 before div1)
+    g.node = [g.node[0], g.node[1], g.node[3], g.node[2]]
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 8))
+    outs = ONNXModel(ModelProto(g)).apply(ff, {"x": x})
+    ff.compile(optimizer=SGDOptimizer(lr=0.0), loss_type=LossType.MEAN_SQUARED_ERROR, outputs=outs)
+    xv = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+    got = np.asarray(ff.predict([xv]))
+    want = 2.0 / ((1.0 - xv * 2.0) + 3.0)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_onnx_nonscalar_initializer_binary_fails_loudly():
+    import pytest
+
+    g = GraphProto(
+        node=[NodeProto("Add", ["x", "bias"], ["y"], "add1")],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[Init("bias", np.zeros(8, np.float32))],
+    )
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 8))
+    with pytest.raises(NotImplementedError, match="bias"):
+        ONNXModel(ModelProto(g)).apply(ff, {"x": x})
+
+
+def test_onnx_dilated_conv_rejected():
+    import pytest
+
+    g = GraphProto(
+        node=[
+            NodeProto(
+                "Conv", ["x", "w"], ["y"], "conv1",
+                [ints("strides", [1, 1]), ints("pads", [1, 1, 1, 1]), ints("dilations", [2, 2])],
+            )
+        ],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[Init("w", np.zeros((8, 3, 3, 3), np.float32))],
+    )
+    ff = FFModel(FFConfig(batch_size=2))
+    x = ff.create_tensor((2, 3, 16, 16))
+    with pytest.raises(AssertionError, match="dilat"):
+        ONNXModel(ModelProto(g)).apply(ff, {"x": x})

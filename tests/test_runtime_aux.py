@@ -114,3 +114,24 @@ def test_recompile_on_condition():
             w_after = np.asarray(model.executor.params[_node_key(n)]["kernel"])
     np.testing.assert_allclose(w_before, w_after)
     model.fit(x, y, epochs=1, verbose=False)  # still trainable
+
+
+def test_dataloader_abandoned_epoch_does_not_wedge_producer():
+    """Breaking out of epoch() early must let the producer thread exit
+    (regression: bounded q.put blocked forever after the consumer left)."""
+    import threading
+    import time
+
+    from flexflow_tpu.runtime.dataloader import DataLoader
+
+    x = np.arange(64 * 4, dtype=np.float32).reshape(64, 4)
+    y = np.arange(64, dtype=np.int32)
+    dl = DataLoader([x], y, batch_size=4, shuffle=False, prefetch=1)
+    before = threading.active_count()
+    for _ in range(5):
+        for batch in dl.epoch():
+            break  # abandon immediately with the queue full
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "producer threads leaked"

@@ -134,3 +134,64 @@ def test_trained_after_import():
     y = np.argmax(x[:, :4], axis=1).astype(np.int32)
     perf = ff.fit(x, y, epochs=5, verbose=False)
     assert perf.accuracy > 0.4
+
+
+def test_scalar_first_sub_div_align():
+    """c - x and c / x must not import as x - c / x / c."""
+    torch.manual_seed(2)
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            y = self.fc(x)
+            return 1.0 - torch.sigmoid(y) + 2.0 / (torch.exp(y) + 3.0)
+
+    x = np.random.RandomState(3).randn(4, 8).astype(np.float32)
+    from flexflow_tpu import DataType
+
+    import_and_compare(M(), [x], [DataType.FLOAT])
+
+
+def test_split_int_is_chunk_size():
+    """torch.split(x, 2, dim=1) yields chunks of SIZE 2, not 2 chunks."""
+    class M(nn.Module):
+        def forward(self, x):
+            a, b, c = torch.split(x, 2, dim=1)
+            return a + b + c
+
+    x = np.random.RandomState(4).randn(4, 6).astype(np.float32)
+    from flexflow_tpu import DataType
+
+    import_and_compare(M(), [x], [DataType.FLOAT])
+
+
+def test_module_called_twice_gets_weights_on_both_instances():
+    torch.manual_seed(5)
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            return self.fc(torch.relu(self.fc(x)))
+
+    x = np.random.RandomState(6).randn(4, 8).astype(np.float32)
+    from flexflow_tpu import DataType
+
+    import_and_compare(M(), [x], [DataType.FLOAT])
+
+
+def test_flatten_with_nonunit_start_dim_rejected():
+    class M(nn.Module):
+        def forward(self, x):
+            return torch.flatten(x)  # start_dim=0: flattens the batch dim
+
+    cfg = FFConfig(batch_size=4)
+    ff = FFModel(cfg)
+    t = ff.create_tensor([4, 2, 3])
+    with pytest.raises(AssertionError):
+        PyTorchModel(M()).torch_to_ff(ff, [t])
