@@ -48,14 +48,22 @@ def _needs_build() -> bool:
 
 
 def _build() -> None:
+    # compile to a per-process temp path, then rename atomically so a
+    # concurrent process never dlopens a half-written library
+    tmp = _LIB_PATH.with_suffix(f".so.tmp{os.getpid()}")
     cmd = [
         os.environ.get("CXX", "g++"),
         "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
         "-I", str(_NATIVE_DIR / "include"),
         *[str(s) for s in _SOURCES],
-        "-o", str(_LIB_PATH),
+        "-o", str(tmp),
     ]
-    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB_PATH)
+    finally:
+        if tmp.exists():
+            tmp.unlink()
 
 
 def _load() -> Optional[ctypes.CDLL]:

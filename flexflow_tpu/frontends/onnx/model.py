@@ -59,6 +59,10 @@ class ONNXModel:
         self.model = model
         self.inputs: Dict[str, object] = {}
         self.initializers: Dict[str, np.ndarray] = {}
+        # ff node name -> {weight name: value} for load_weights (the
+        # serving path needs the graph's trained weights, not random
+        # init; reference: triton/src/onnx_parser.cc parses weights too)
+        self.weight_map: Dict[str, Dict[str, np.ndarray]] = {}
 
     def apply(self, ffmodel, input_tensors: Dict[str, object]) -> List:
         """Replay the graph; input_tensors maps graph input name -> ff
@@ -67,6 +71,11 @@ class ONNXModel:
         env: Dict[str, object] = dict(input_tensors)
         for init in graph.initializer:
             self.initializers[init.name] = _to_numpy(init)
+        for i, node in enumerate(graph.node):
+            if not node.name:
+                # node names are optional in ONNX; weight_map and FF node
+                # lookup need them unique and non-empty
+                node.name = f"{node.op_type.lower()}_{i}"
         for node in graph.node:
             handler = getattr(self, f"handle{node.op_type}", None)
             if handler is None:
@@ -99,6 +108,8 @@ class ONNXModel:
             raise KeyError(f"{node.op_type} input {name!r} is neither a produced tensor nor an initializer")
 
         a, b = resolve(node.input[0]), resolve(node.input[1])
+        if isinstance(a, float) and isinstance(b, float):  # constant fold
+            return {"add": a + b, "sub": a - b, "mul": a * b, "div": a / b}[kind]
         bin_fn = {"add": ff.add, "sub": ff.subtract, "mul": ff.multiply, "div": ff.divide}[kind]
         scalar_fn = {"add": ff.scalar_add, "sub": ff.scalar_sub, "mul": ff.scalar_multiply, "div": ff.scalar_true_divide}[kind]
         if isinstance(b, float):
@@ -205,6 +216,12 @@ class ONNXModel:
         pw = (pads[1], pads[3]) if pads[1] != pads[3] else pads[1]
         groups = at.get("group", 1)
         use_bias = len(node.input) > 2
+        ws = {"kernel": w}
+        if use_bias:
+            b = self.initializers.get(node.input[2])
+            if b is not None:
+                ws["bias"] = b
+        self.weight_map[node.name] = ws
         return ff.conv2d(
             env[node.input[0]], out_c, kh, kw, strides[0], strides[1], ph, pw,
             groups=groups, use_bias=use_bias, name=node.name,
@@ -244,7 +261,18 @@ class ONNXModel:
         assert w is not None
         out_dim = w.shape[0] if at.get("transB", 0) else w.shape[1]
         use_bias = len(node.input) > 2
+        ws = {"kernel": np.ascontiguousarray(w.T) if at.get("transB", 0) else w}
+        if use_bias:
+            b = self.initializers.get(node.input[2])
+            if b is not None:
+                ws["bias"] = b
+        self.weight_map[node.name] = ws
         return ff.dense(env[node.input[0]], out_dim, use_bias=use_bias, name=node.name)
+
+    def load_weights(self, ffmodel) -> int:
+        """After compile(): overwrite executor params with the graph's
+        initializer weights. Returns the number of nodes updated."""
+        return _load_weights_impl(self, ffmodel)
 
     def handleMatMul(self, ff, node, env):
         """MatMul with constant rhs = dense; tensor×tensor = batch_matmul
@@ -252,8 +280,35 @@ class ONNXModel:
         rhs = node.input[1]
         if rhs in self.initializers:
             w = self.initializers[rhs]
+            self.weight_map[node.name] = {"kernel": w}
             return ff.dense(env[node.input[0]], w.shape[-1], use_bias=False, name=node.name)
         return ff.batch_matmul(env[node.input[0]], env[rhs], name=node.name)
+
+
+def _load_weights_impl(onnx_model: "ONNXModel", ffmodel) -> int:
+    """Port the graph's initializer weights into the compiled executor
+    (serving parity with triton/src/onnx_parser.cc, which parses weight
+    tensors out of the ModelProto). Returns the number of nodes updated."""
+    from ...runtime.executor import _node_key
+
+    ex = ffmodel.executor
+    assert ex is not None, "compile() the ffmodel before load_weights()"
+    by_name = {n.name: n for n in ffmodel.graph.nodes.values() if n.name}
+    updated = 0
+    for ff_name, ws in onnx_model.weight_map.items():
+        node = by_name.get(ff_name)
+        if node is None:
+            continue
+        key = _node_key(node)
+        if key not in ex.params:
+            continue
+        cur = dict(ex.params[key])
+        for wname, value in ws.items():
+            if wname in cur:
+                cur[wname] = ex._place_weight(node.guid, wname, np.asarray(value))
+        ex.params[key] = cur
+        updated += 1
+    return updated
 
 
 def _to_numpy(init) -> np.ndarray:

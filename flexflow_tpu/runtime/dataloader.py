@@ -19,6 +19,20 @@ from typing import Iterator, List, Optional, Sequence
 import jax
 import numpy as np
 
+_native_gather = None  # cached: function, or False after a failed import
+
+
+def _get_native_gather():
+    global _native_gather
+    if _native_gather is None:
+        try:
+            from .._native import batch_gather as f
+
+            _native_gather = f
+        except Exception:
+            _native_gather = False
+    return _native_gather or None
+
 
 class SingleDataLoader:
     """Batches one array; reference: SingleDataLoader (flexflow_cffi.py:2433)."""
@@ -50,14 +64,15 @@ class SingleDataLoader:
         (the TPU-side analog of the reference's CUDA copy kernels in
         flexflow_dataloader.cu — here the copy is host-side, the
         host->HBM DMA happens in device_put)."""
-        try:
-            from .._native import batch_gather
-
-            out = np.empty((len(idx),) + self.data.shape[1:], self.data.dtype)
-            batch_gather(self.data, out, idx)
-            return out
-        except Exception:
-            return self.data[idx]
+        native = _get_native_gather()
+        if native is not None:
+            try:
+                out = np.empty((len(idx),) + self.data.shape[1:], self.data.dtype)
+                native(self.data, out, idx)
+                return out
+            except Exception:
+                pass
+        return self.data[idx]
 
     def batches(self) -> Iterator[jax.Array]:
         order = self._order()

@@ -64,15 +64,34 @@ class TaskManager:
         self.tasks[dst].counter += 1
 
 
+_native_sim = None  # cached: function, or False after a failed import —
+# a failed package import is NOT cached by Python, so retrying it every
+# call would re-run the module (and its auto-build) in the search loop
+
+
+def _get_native_sim():
+    global _native_sim
+    if _native_sim is None:
+        try:
+            from .._native import simulate_taskgraph as f
+
+            _native_sim = f
+        except Exception:
+            _native_sim = False
+    return _native_sim or None
+
+
 def _simulate(tm: TaskManager) -> float:
     """Event-driven replay (reference: simulate_runtime simulator.cc:856):
     per-device serialization, dependency-ordered, returns makespan."""
-    try:
-        from .._native import simulate_taskgraph  # C++ fast path
-
-        return simulate_taskgraph(tm.tasks)
-    except Exception:
-        pass
+    native = _get_native_sim()
+    if native is not None:
+        try:
+            return native(tm.tasks)
+        except ValueError:
+            raise  # deadlock: same error contract as the Python path
+        except Exception:
+            pass
     device_free: Dict[int, float] = {}
     ready: List[Tuple[float, int]] = []
     for i, t in enumerate(tm.tasks):

@@ -1,0 +1,10 @@
+"""Inference serving: compiled inference graphs + dynamic batching + an
+HTTP server speaking the Triton/KServe v2 protocol subset.
+
+Reference: triton/ (16k LoC Legion-based Triton backend, SURVEY §2.9).
+"""
+from .batcher import DynamicBatcher
+from .model import InferenceModel, TensorMeta
+from .server import InferenceServer
+
+__all__ = ["DynamicBatcher", "InferenceModel", "InferenceServer", "TensorMeta"]

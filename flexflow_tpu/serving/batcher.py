@@ -1,0 +1,123 @@
+"""Dynamic batcher: coalesce concurrent requests into one device batch.
+
+Reference: the Triton backend executes per-request Legion task launches
+(triton/src/instance.cc); Triton itself provides dynamic batching above
+the backend. Here batching lives in-framework: requests queue up, a
+collector thread drains up to ``max_batch`` samples (waiting at most
+``max_delay_s`` after the first), runs ONE padded jitted call, and
+scatters results back to per-request futures — on TPU a single large
+batch is vastly cheaper than many small dispatches.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .model import InferenceModel
+
+
+class _Request:
+    __slots__ = ("inputs", "future", "n")
+
+    def __init__(self, inputs: Sequence[np.ndarray]):
+        self.inputs = inputs
+        self.future: Future = Future()
+        self.n = inputs[0].shape[0]
+
+
+class DynamicBatcher:
+    """Queue + collector thread around one InferenceModel."""
+
+    def __init__(self, model: InferenceModel, max_delay_s: float = 0.005):
+        self.model = model
+        self.max_delay_s = max_delay_s
+        self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    # ------------------------------------------------------------ control
+    def start(self):
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if not self._running:
+            return
+        self._running = False
+        self._q.put(None)
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ------------------------------------------------------------- submit
+    def submit(self, inputs: Sequence[np.ndarray]) -> Future:
+        """Enqueue one request (batch <= max_batch); returns a Future of
+        the output list."""
+        if not self._running:
+            raise RuntimeError("batcher not started")
+        n = inputs[0].shape[0]
+        if n > self.model.max_batch:
+            raise ValueError(f"request batch {n} exceeds max_batch {self.model.max_batch}")
+        req = _Request([np.asarray(x) for x in inputs])
+        self._q.put(req)
+        return req.future
+
+    def infer(self, inputs: Sequence[np.ndarray], timeout: Optional[float] = None) -> List[np.ndarray]:
+        return self.submit(inputs).result(timeout=timeout)
+
+    # ------------------------------------------------------------ internals
+    def _collect(self) -> List[_Request]:
+        """Block for the first request, then drain until the batch is full
+        or max_delay_s has passed."""
+        first = self._q.get()
+        if first is None:
+            return []
+        batch = [first]
+        total = first.n
+        deadline = threading.Event()
+        timer = threading.Timer(self.max_delay_s, deadline.set)
+        timer.start()
+        try:
+            while total < self.model.max_batch and not deadline.is_set():
+                try:
+                    nxt = self._q.get(timeout=self.max_delay_s / 10)
+                except queue.Empty:
+                    continue
+                if nxt is None:
+                    self._q.put(None)  # keep the shutdown signal
+                    break
+                if total + nxt.n > self.model.max_batch:
+                    self._q.put(nxt)  # doesn't fit: next round
+                    break
+                batch.append(nxt)
+                total += nxt.n
+        finally:
+            timer.cancel()
+        return batch
+
+    def _loop(self):
+        while self._running:
+            batch = self._collect()
+            if not batch:
+                break
+            try:
+                stacked = [
+                    np.concatenate([r.inputs[i] for r in batch], axis=0)
+                    for i in range(len(batch[0].inputs))
+                ]
+                outs = self.model.infer(stacked)
+                off = 0
+                for r in batch:
+                    r.future.set_result([o[off : off + r.n] for o in outs])
+                    off += r.n
+            except Exception as e:
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)

@@ -1,0 +1,136 @@
+"""Servable model: a compiled inference graph plus tensor metadata.
+
+Reference: the Triton backend prototype (SURVEY §2.9) — triton/src/
+model.cc loads an ONNX model (onnx_parser.cc) and a partition strategy
+(strategy.cc), builds its op graph, and instance.cc executes requests.
+TPU-native: an FFModel compiled with CompMode.INFERENCE (ffconst.h:41-44
+COMP_MODE_INFERENCE) is the "model instance"; XLA replaces the
+per-operator Legion task launches; the partition strategy file is the
+same ParallelStrategy JSON the trainer exports (--export-strategy).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.types import CompMode, DataType
+from ..model import FFModel, Tensor
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorMeta:
+    """Wire metadata for one input/output (Triton model-metadata analog)."""
+
+    name: str
+    shape: tuple  # per-sample shape (no batch dim)
+    dtype: str
+
+
+class InferenceModel:
+    """One servable model: compiled graph + fixed max batch size.
+
+    Requests are padded to ``max_batch`` so the jitted computation has a
+    single static shape (XLA: no dynamic shapes; the reference gets the
+    same effect from fixed Legion index spaces).
+    """
+
+    def __init__(
+        self,
+        model: FFModel,
+        name: str = "model",
+        max_batch: Optional[int] = None,
+        input_names: Optional[Sequence[str]] = None,
+    ):
+        if model.executor is None:
+            raise ValueError("compile() the FFModel before serving it")
+        self.model = model
+        self.name = name
+        self.max_batch = max_batch or model.config.batch_size
+        from ..core.types import OpType
+
+        ins = sorted(
+            (n for n in model.graph.nodes.values() if n.op_type == OpType.INPUT),
+            key=lambda n: n.params.input_index,
+        )
+        from ..parallel.propagation import infer_all_specs
+
+        specs = infer_all_specs(model.graph)
+        names = list(input_names) if input_names else [n.name or f"input_{i}" for i, n in enumerate(ins)]
+        self.inputs: List[TensorMeta] = [
+            TensorMeta(nm, tuple(specs[n.guid][0].shape[1:]), specs[n.guid][0].dtype.value)
+            for nm, n in zip(names, ins)
+        ]
+        self.outputs: List[TensorMeta] = [
+            TensorMeta(f"output_{i}", tuple(t.shape[1:]), t.dtype.value)
+            for i, t in enumerate(model._outputs)
+        ]
+
+    # ------------------------------------------------------------- build
+    @classmethod
+    def from_onnx(
+        cls,
+        onnx_model,
+        input_shapes: Dict[str, Sequence[int]],
+        name: str = "model",
+        max_batch: int = 8,
+        strategy_file: str = "",
+        input_dtypes: Optional[Dict[str, DataType]] = None,
+        config=None,
+    ) -> "InferenceModel":
+        """Load an ONNX graph and compile it for inference (reference:
+        triton/src/onnx_parser.cc + strategy.cc + model.cc)."""
+        from ..config import FFConfig
+        from ..frontends.onnx import ONNXModel
+
+        config = config or FFConfig(batch_size=max_batch)
+        ff = FFModel(config)
+        tensors: Dict[str, Tensor] = {}
+        dtypes = input_dtypes or {}
+        in_names = list(input_shapes)
+        for nm in in_names:
+            shape = [max_batch] + list(input_shapes[nm])
+            tensors[nm] = ff.create_tensor(shape, dtype=dtypes.get(nm, DataType.FLOAT), name=nm)
+        om = ONNXModel(onnx_model)
+        outs = om.apply(ff, tensors)
+        strategy = None
+        if strategy_file:
+            from ..parallel.strategy import ParallelStrategy
+
+            with open(strategy_file) as f:
+                strategy = ParallelStrategy.from_json(f.read())
+        ff.compile(comp_mode=CompMode.INFERENCE, outputs=outs, strategy=strategy)
+        om.load_weights(ff)  # serve the graph's weights, not random init
+        return cls(ff, name=name, max_batch=max_batch, input_names=in_names)
+
+    # --------------------------------------------------------------- run
+    def infer(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """Run a batch (any size <= max_batch); pads to the compiled batch
+        and slices the padding back off."""
+        if len(inputs) != len(self.inputs):
+            raise ValueError(f"model takes {len(self.inputs)} inputs, got {len(inputs)}")
+        n = inputs[0].shape[0]
+        if n > self.max_batch:
+            raise ValueError(f"batch {n} exceeds max_batch {self.max_batch}")
+        padded = []
+        for x, meta in zip(inputs, self.inputs):
+            if tuple(x.shape[1:]) != meta.shape:
+                raise ValueError(f"input {meta.name}: expected {meta.shape}, got {tuple(x.shape[1:])}")
+            if n < self.max_batch:
+                pad = np.zeros((self.max_batch - n,) + tuple(x.shape[1:]), x.dtype)
+                x = np.concatenate([x, pad], axis=0)
+            padded.append(x)
+        outs = self.model.executor.predict([jax.numpy.asarray(x) for x in padded])
+        return [np.asarray(o)[:n] for o in outs]
+
+    def metadata(self) -> Dict:
+        """Triton-style model metadata."""
+        return {
+            "name": self.name,
+            "platform": "flexflow_tpu",
+            "max_batch_size": self.max_batch,
+            "inputs": [dataclasses.asdict(m) for m in self.inputs],
+            "outputs": [dataclasses.asdict(m) for m in self.outputs],
+        }

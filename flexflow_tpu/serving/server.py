@@ -1,0 +1,145 @@
+"""HTTP inference server speaking the KServe/Triton v2 protocol subset.
+
+Reference: triton/ (SURVEY §2.9) — the reference serves its Legion op
+graph as a Triton backend; its wire protocol is Triton's v2 inference
+API. This server implements the same surface directly (stdlib only):
+
+  GET  /v2/health/ready                    -> 200 when serving
+  GET  /v2/models/{name}                   -> model metadata
+  POST /v2/models/{name}/infer             -> run inference
+
+Infer request JSON: {"inputs": [{"name", "shape", "datatype", "data"}]},
+response mirrors it — the v2 tensor format with row-major flat data.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from .batcher import DynamicBatcher
+from .model import InferenceModel
+
+_V2_DTYPES = {
+    "FP32": np.float32, "FP64": np.float64, "FP16": np.float16,
+    "BF16": np.dtype("bfloat16") if hasattr(np, "bfloat16") else np.float32,
+    "INT32": np.int32, "INT64": np.int64, "BOOL": np.bool_,
+}
+_NP_TO_V2 = {
+    "float32": "FP32", "float64": "FP64", "float16": "FP16",
+    "bfloat16": "BF16", "int32": "INT32", "int64": "INT64", "bool": "BOOL",
+}
+
+
+class InferenceServer:
+    """Serves one or more InferenceModels over HTTP with dynamic batching."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000, max_delay_s: float = 0.005):
+        self.host = host
+        self.port = port
+        self.models: Dict[str, InferenceModel] = {}
+        self.batchers: Dict[str, DynamicBatcher] = {}
+        self.max_delay_s = max_delay_s
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, model: InferenceModel):
+        self.models[model.name] = model
+        b = DynamicBatcher(model, max_delay_s=self.max_delay_s)
+        self.batchers[model.name] = b
+        if self._httpd is not None:
+            b.start()
+
+    # ------------------------------------------------------------ control
+    def start(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/v2/health/ready":
+                    return self._json(200, {"ready": True})
+                if self.path == "/v2/models":
+                    return self._json(200, {"models": sorted(server.models)})
+                if self.path.startswith("/v2/models/"):
+                    name = self.path.split("/")[3]
+                    m = server.models.get(name)
+                    if m is None:
+                        return self._json(404, {"error": f"unknown model {name}"})
+                    return self._json(200, m.metadata())
+                return self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                parts = self.path.split("/")
+                if len(parts) < 5 or parts[1] != "v2" or parts[2] != "models" or parts[4] != "infer":
+                    return self._json(404, {"error": "not found"})
+                name = parts[3]
+                batcher = server.batchers.get(name)
+                model = server.models.get(name)
+                if batcher is None or model is None:
+                    return self._json(404, {"error": f"unknown model {name}"})
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length))
+                    by_name = {t["name"]: t for t in req["inputs"]}
+                    arrays = []
+                    for meta in model.inputs:
+                        t = by_name.get(meta.name)
+                        if t is None:
+                            raise ValueError(f"missing input {meta.name}")
+                        dt = _V2_DTYPES.get(t.get("datatype", "FP32"), np.float32)
+                        arrays.append(np.asarray(t["data"], dtype=dt).reshape(t["shape"]))
+                    outs = batcher.infer(arrays, timeout=60.0)
+                except Exception as e:
+                    return self._json(400, {"error": str(e)})
+                resp = {
+                    "model_name": name,
+                    "outputs": [
+                        {
+                            "name": meta.name,
+                            "shape": list(o.shape),
+                            "datatype": _NP_TO_V2.get(str(o.dtype), "FP32"),
+                            "data": np.asarray(o, dtype=np.float64 if o.dtype.kind == "f" else o.dtype).reshape(-1).tolist(),
+                        }
+                        for meta, o in zip(model.outputs, outs)
+                    ],
+                }
+                return self._json(200, resp)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        for b in self.batchers.values():
+            b.start()
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        for b in self.batchers.values():
+            b.stop()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

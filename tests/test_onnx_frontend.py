@@ -192,3 +192,50 @@ def test_onnx_dilated_conv_rejected():
     x = ff.create_tensor((2, 3, 16, 16))
     with pytest.raises(AssertionError, match="dilat"):
         ONNXModel(ModelProto(g)).apply(ff, {"x": x})
+
+
+def test_onnx_unnamed_nodes_get_unique_names_and_weights():
+    """node.name is optional in ONNX; unnamed nodes must still serve the
+    graph's weights (regression: they collided on the '' key)."""
+    rs = np.random.RandomState(9)
+    w1 = rs.randn(8, 8).astype(np.float32)
+    w2 = rs.randn(8, 2).astype(np.float32)
+    g = GraphProto(
+        node=[
+            NodeProto("MatMul", ["x", "w1"], ["h"]),  # unnamed
+            NodeProto("Relu", ["h"], ["hr"]),
+            NodeProto("MatMul", ["hr", "w2"], ["y"]),
+        ],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[Init("w1", w1), Init("w2", w2)],
+    )
+    from flexflow_tpu import CompMode
+
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 8))
+    om = ONNXModel(ModelProto(g))
+    outs = om.apply(ff, {"x": x})
+    ff.compile(comp_mode=CompMode.INFERENCE, outputs=outs)
+    assert om.load_weights(ff) == 2
+    xv = rs.randn(4, 8).astype(np.float32)
+    got = np.asarray(ff.predict([xv]))
+    np.testing.assert_allclose(got, np.maximum(xv @ w1, 0) @ w2, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_both_scalar_initializers_fold():
+    g = GraphProto(
+        node=[
+            NodeProto("Div", ["one", "two"], ["half"], "d"),  # 1/2 -> const
+            NodeProto("Mul", ["x", "half"], ["y"], "m"),
+        ],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[Init("one", np.array([1.0], np.float32)), Init("two", np.array([2.0], np.float32))],
+    )
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 8))
+    outs = ONNXModel(ModelProto(g)).apply(ff, {"x": x})
+    ff.compile(optimizer=SGDOptimizer(lr=0.0), loss_type=LossType.MEAN_SQUARED_ERROR, outputs=outs)
+    xv = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(ff.predict([xv])), xv * 0.5, rtol=1e-6)
