@@ -1,0 +1,30 @@
+"""AlexNet on CIFAR-10-shaped data (reference: examples/cpp/AlexNet,
+bootcamp_demo/ff_alexnet_cifar10.py).
+
+  python examples/alexnet_cifar10.py -b 64 -e 1 [--budget 10]
+"""
+import sys
+
+sys.path.insert(0, ".")
+from examples.common import Timer, synthetic_classification
+
+from flexflow_tpu import FFConfig, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_alexnet
+
+
+def main():
+    config = FFConfig.from_args()
+    model = build_alexnet(config, num_classes=10, image_hw=32)
+    model.compile(
+        optimizer=SGDOptimizer(lr=config.learning_rate, momentum=0.9),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    x, y = synthetic_classification(4 * config.batch_size, (3, 32, 32), 10)
+    with Timer() as t:
+        model.fit([x], y, epochs=config.epochs)
+    print(f"done in {t.seconds:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Shared example scaffolding: synthetic data + timing.
+
+Reference analog: each examples/cpp app's top_level_task parses FFConfig
+flags (use ``FFConfig.from_args()``, same CLI surface) and loads data.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def synthetic_classification(n, input_shape, num_classes, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, *input_shape).astype(np.float32)
+    y = rs.randint(0, num_classes, n).astype(np.int32)
+    return x, y
+
+
+class Timer:
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *a):
+        self.seconds = time.time() - self.t0

@@ -1,0 +1,38 @@
+"""DLRM recommender (reference: examples/cpp/DLRM/dlrm.cc with
+attribute-parallel embedding tables, scripts/osdi22ae/dlrm.sh).
+
+  python examples/dlrm.py -b 256 [--budget 20]
+"""
+import sys
+
+sys.path.insert(0, ".")
+import numpy as np
+
+from examples.common import Timer
+
+from flexflow_tpu import FFConfig, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_dlrm
+
+
+def main():
+    config = FFConfig.from_args()
+    n_sparse, vocab = 8, 1000
+    model = build_dlrm(config, embedding_sizes=(vocab,) * n_sparse)
+    model.compile(
+        optimizer=SGDOptimizer(lr=config.learning_rate),
+        loss_type=LossType.MEAN_SQUARED_ERROR,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    rs = np.random.RandomState(0)
+    n = 4 * config.batch_size
+    dense = rs.randn(n, 64).astype(np.float32)
+    sparse = [rs.randint(0, vocab, (n, 1)).astype(np.int32) for _ in range(n_sparse)]
+    y = rs.rand(n, 1).astype(np.float32)
+    with Timer() as t:
+        # input order matches creation order: sparse tables, then dense
+        model.fit(sparse + [dense], y, epochs=config.epochs)
+    print(f"done in {t.seconds:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Keras frontend (reference: python/flexflow/keras — Sequential API).
+
+  python examples/keras_mnist.py -e 1
+"""
+import sys
+
+sys.path.insert(0, ".")
+import numpy as np
+
+from flexflow_tpu.frontends.keras import layers, models, optimizers
+
+
+def main():
+    model = models.Sequential([
+        layers.Dense(128, activation="relu", input_shape=(784,)),
+        layers.Dropout(0.2),
+        layers.Dense(10, activation="softmax"),
+    ])
+    model.compile(
+        optimizer=optimizers.SGD(learning_rate=0.05),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    rs = np.random.RandomState(0)
+    x = rs.rand(512, 784).astype(np.float32)
+    y = rs.randint(0, 10, 512).astype(np.int32)
+    model.fit(x, y, batch_size=64, epochs=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""MLP with Unity search (reference: examples/cpp/MLP_Unify/mlp.cc,
+scripts/osdi22ae/mlp.sh: --budget 20 vs --only-data-parallel).
+
+  python examples/mlp_unify.py --budget 20 -b 512 -e 2
+"""
+import sys
+
+sys.path.insert(0, ".")
+from examples.common import Timer, synthetic_classification
+
+from flexflow_tpu import FFConfig, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_mlp_unify
+
+
+def main():
+    config = FFConfig.from_args()
+    model = build_mlp_unify(config, in_dim=1024, hidden=(2048, 2048, 512))
+    model.compile(
+        optimizer=SGDOptimizer(lr=config.learning_rate),
+        loss_type=LossType.MEAN_SQUARED_ERROR,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    if model._search_result is not None:
+        r = model._search_result
+        print(f"search: cost {r.best_cost*1e3:.3f} ms/iter, {r.candidates_explored} candidates, mesh {model.strategy.axis_sizes}")
+    import numpy as np
+
+    rs = np.random.RandomState(0)
+    n = 4 * config.batch_size
+    x = rs.randn(n, 1024).astype(np.float32)
+    y = rs.randn(n, 512).astype(np.float32)
+    with Timer() as t:
+        model.fit([x], y, epochs=config.epochs)
+    print(f"done in {t.seconds:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

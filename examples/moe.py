@@ -1,0 +1,30 @@
+"""Mixture-of-Experts MLP (reference: examples/cpp/mixture_of_experts/
+moe.cc with Cache + recompile hooks for adaptive expert placement).
+
+  python examples/moe.py -b 64 -e 1
+"""
+import sys
+
+sys.path.insert(0, ".")
+from examples.common import Timer, synthetic_classification
+
+from flexflow_tpu import FFConfig, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_moe_mlp
+
+
+def main():
+    config = FFConfig.from_args()
+    model = build_moe_mlp(config, in_dim=784, num_classes=10, num_experts=8, num_select=2)
+    model.compile(
+        optimizer=SGDOptimizer(lr=config.learning_rate),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    x, y = synthetic_classification(4 * config.batch_size, (784,), 10)
+    with Timer() as t:
+        model.fit([x], y, epochs=config.epochs)
+    print(f"done in {t.seconds:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

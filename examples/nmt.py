@@ -1,0 +1,39 @@
+"""LSTM NMT with attention (reference: nmt/ legacy seq2seq app).
+
+  python examples/nmt.py -b 32 -e 1
+"""
+import sys
+
+sys.path.insert(0, ".")
+import numpy as np
+
+from examples.common import Timer
+
+from flexflow_tpu import FFConfig, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_nmt
+
+
+def main():
+    config = FFConfig.from_args()
+    src_vocab = tgt_vocab = 4000
+    model = build_nmt(
+        config, src_vocab=src_vocab, tgt_vocab=tgt_vocab,
+        embed_dim=128, hidden_size=128, num_layers=2, src_len=24, tgt_len=24,
+    )
+    model.compile(
+        optimizer=SGDOptimizer(lr=config.learning_rate),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    rs = np.random.RandomState(0)
+    n = 4 * config.batch_size
+    src = rs.randint(0, src_vocab, (n, 24)).astype(np.int32)
+    tgt_in = rs.randint(0, tgt_vocab, (n, 24)).astype(np.int32)
+    tgt_out = np.roll(tgt_in, -1, axis=1)
+    with Timer() as t:
+        model.fit([src, tgt_in], tgt_out, epochs=config.epochs)
+    print(f"done in {t.seconds:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Import an ONNX model and serve it (reference: python/flexflow/onnx/
+model.py + triton/src/onnx_parser.cc).
+
+Builds a ModelProto-shaped graph in-process (the onnx package isn't
+required); pass a path to a real .onnx file instead when available:
+
+  python examples/onnx_import.py [model.onnx]
+"""
+import sys
+
+sys.path.insert(0, ".")
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from flexflow_tpu.serving import InferenceModel
+
+
+@dataclasses.dataclass
+class _Node:
+    op_type: str
+    input: List[str]
+    output: List[str]
+    name: str = ""
+    attribute: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class _VI:
+    name: str
+
+
+@dataclasses.dataclass
+class _Init:
+    name: str
+    numpy: np.ndarray
+
+
+@dataclasses.dataclass
+class _Graph:
+    node: list
+    input: list
+    output: list
+    initializer: list
+
+
+@dataclasses.dataclass
+class _Model:
+    graph: _Graph
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1].endswith(".onnx"):
+        model_in = sys.argv[1]
+        shapes = {"input": [16]}  # adjust for your model
+    else:
+        rs = np.random.RandomState(0)
+        w1, w2 = rs.randn(16, 64).astype(np.float32), rs.randn(64, 4).astype(np.float32)
+        g = _Graph(
+            node=[
+                _Node("MatMul", ["input", "w1"], ["h"]),
+                _Node("Relu", ["h"], ["hr"]),
+                _Node("MatMul", ["hr", "w2"], ["out"]),
+            ],
+            input=[_VI("input")], output=[_VI("out")],
+            initializer=[_Init("w1", w1), _Init("w2", w2)],
+        )
+        model_in = _Model(g)
+        shapes = {"input": [16]}
+
+    m = InferenceModel.from_onnx(model_in, shapes, name="onnx_demo", max_batch=8)
+    x = np.random.RandomState(1).randn(3, 16).astype(np.float32)
+    (out,) = m.infer([x])
+    print("output:", out.shape, out.dtype)
+    print(m.metadata())
+
+
+if __name__ == "__main__":
+    main()

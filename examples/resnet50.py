@@ -1,0 +1,32 @@
+"""ResNet-50 / ResNeXt-50 (reference: examples/cpp/ResNet, resnext50,
+scripts/osdi22ae/resnext-50.sh).
+
+  python examples/resnet50.py -b 16 [--resnext]
+"""
+import sys
+
+sys.path.insert(0, ".")
+from examples.common import Timer, synthetic_classification
+
+from flexflow_tpu import FFConfig, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_resnet50, build_resnext50
+
+
+def main():
+    use_resnext = "--resnext" in sys.argv
+    config = FFConfig.from_args()
+    build = build_resnext50 if use_resnext else build_resnet50
+    model = build(config, num_classes=100, image_hw=64)
+    model.compile(
+        optimizer=SGDOptimizer(lr=config.learning_rate, momentum=0.9),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    x, y = synthetic_classification(2 * config.batch_size, (3, 64, 64), 100)
+    with Timer() as t:
+        model.fit([x], y, epochs=config.epochs)
+    print(f"done in {t.seconds:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

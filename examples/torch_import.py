@@ -1,0 +1,46 @@
+"""Import a PyTorch module via torch.fx and train/predict on TPU
+(reference: python/flexflow/torch/model.py, flexflow.torch.fx).
+
+  python examples/torch_import.py
+"""
+import sys
+
+sys.path.insert(0, ".")
+import numpy as np
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import DataType, FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.frontends.torch import PyTorchModel, copy_weights
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(32, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        return self.fc2(torch.relu(self.fc1(x)))
+
+
+def main():
+    torch.manual_seed(0)
+    module = Net()
+    config = FFConfig.from_args()
+    ff = FFModel(config)
+    x = ff.create_tensor([config.batch_size, 32])
+    pt = PyTorchModel(module)
+    outs = pt.torch_to_ff(ff, [x])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=LossType.MEAN_SQUARED_ERROR, outputs=outs)
+    copy_weights(module, ff, pt.name_map)
+
+    xv = np.random.RandomState(0).randn(config.batch_size, 32).astype(np.float32)
+    got = np.asarray(ff.predict([xv]))
+    with torch.no_grad():
+        want = module(torch.from_numpy(xv)).numpy()
+    print("max |ff - torch| =", np.abs(got - want).max())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Unity search walkthrough: substitutions + DP placement + strategy
+export + task-graph DOT (reference: --budget/--export/--taskgraph/
+--compgraph flags, graph_optimize_task graph.cc:2047).
+
+  python examples/unity_search_demo.py --budget 20 --export strategy.json \
+      --taskgraph taskgraph.dot --compgraph pcg.dot
+"""
+import sys
+
+sys.path.insert(0, ".")
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.search.unity import unity_optimize
+
+
+def main():
+    config = FFConfig.from_args()
+    if config.search_budget <= 0:
+        config.search_budget = 20
+    config.workers_per_node = max(config.workers_per_node, 8)
+    model = FFModel(config)
+    x = model.create_tensor([config.batch_size, 4096])
+    t = model.dense(x, 8192, activation="relu")
+    t = model.dense(t, 8192, activation="relu")
+    t = model.dense(t, 1024)
+    model.softmax(t)
+
+    strategy, result = unity_optimize(model.graph, config)
+    print(f"explored {result.candidates_explored} candidates")
+    print(f"best simulated cost: {result.best_cost*1e3:.3f} ms/iter")
+    print(f"memory/device: {result.memory_per_device/1e6:.1f} MB")
+    print(f"mesh axes: {strategy.axis_sizes}")
+    for guid, view in sorted(result.views.items()):
+        node = result.graph.nodes[guid]
+        print(f"  {node.op_type.value:12s} guid={guid} parts={view.num_parts}")
+
+    if config.export_strategy_file:
+        with open(config.export_strategy_file, "w") as f:
+            f.write(strategy.to_json())
+        print(f"strategy -> {config.export_strategy_file}")
+    if config.export_strategy_task_graph_file:
+        from flexflow_tpu.search.simulator import Simulator
+
+        sim = Simulator()
+        tm = sim.build_taskgraph(result.graph, result.views)
+        with open(config.export_strategy_task_graph_file, "w") as f:
+            f.write(sim.export_taskgraph_dot(tm))
+        print(f"taskgraph -> {config.export_strategy_task_graph_file}")
+    if config.export_strategy_computation_graph_file:
+        with open(config.export_strategy_computation_graph_file, "w") as f:
+            f.write(result.graph.to_dot())
+        print(f"pcg -> {config.export_strategy_computation_graph_file}")
+
+
+if __name__ == "__main__":
+    main()

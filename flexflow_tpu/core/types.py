@@ -171,6 +171,9 @@ class OpType(enum.Enum):
     GATHER = "gather"
     REDUCE_SUM = "reduce_sum"
     MEAN = "mean"
+    # recurrent (reference: nmt/ LSTM/RNN via cudnnRNN)
+    RNN = "rnn"
+    LSTM = "lstm"
     # MoE family
     TOPK = "topk"
     GROUP_BY = "group_by"

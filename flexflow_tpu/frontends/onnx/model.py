@@ -109,7 +109,9 @@ class ONNXModel:
 
         a, b = resolve(node.input[0]), resolve(node.input[1])
         if isinstance(a, float) and isinstance(b, float):  # constant fold
-            return {"add": a + b, "sub": a - b, "mul": a * b, "div": a / b}[kind]
+            import operator as _op
+
+            return {"add": _op.add, "sub": _op.sub, "mul": _op.mul, "div": _op.truediv}[kind](a, b)
         bin_fn = {"add": ff.add, "sub": ff.subtract, "mul": ff.multiply, "div": ff.divide}[kind]
         scalar_fn = {"add": ff.scalar_add, "sub": ff.scalar_sub, "mul": ff.scalar_multiply, "div": ff.scalar_true_divide}[kind]
         if isinstance(b, float):

@@ -240,6 +240,46 @@ class FFModel:
         p = MultiHeadAttentionParams(embed_dim, num_heads, kdim, vdim, dropout, bias, causal, query.dtype)
         return self._one(OpType.MULTIHEAD_ATTENTION, p, [query, key, value], name=name)
 
+    def rnn(
+        self,
+        input: Tensor,
+        hidden_size: int,
+        initial_state: Optional[Tensor] = None,
+        activation: ActiMode = ActiMode.TANH,
+        name: str = "",
+    ) -> Tuple[Tensor, Tensor]:
+        """Elman RNN over [B, T, D] -> (sequence [B, T, H], final_h [B, H]).
+        Reference: nmt/ RNN mode."""
+        from .ops.recurrent import RecurrentParams
+
+        p = RecurrentParams(hidden_size, input.dtype, self._acti(activation))
+        ins = [input] + ([initial_state] if initial_state is not None else [])
+        outs = self._add(OpType.RNN, p, ins, name=name)
+        return outs[0], outs[1]
+
+    def lstm(
+        self,
+        input: Tensor,
+        hidden_size: int,
+        initial_h: Optional[Tensor] = None,
+        initial_c: Optional[Tensor] = None,
+        name: str = "",
+    ) -> Tuple[Tensor, Tensor, Tensor]:
+        """LSTM over [B, T, D] -> (sequence, final_h, final_c).
+        Reference: nmt/lstm.cc (cudnnRNN LSTM mode)."""
+        from .ops.recurrent import RecurrentParams
+
+        p = RecurrentParams(hidden_size, input.dtype)
+        if initial_c is not None and initial_h is None:
+            raise ValueError("lstm: initial_c requires initial_h (pass zeros for h explicitly)")
+        ins = [input]
+        if initial_h is not None:
+            ins.append(initial_h)
+            if initial_c is not None:
+                ins.append(initial_c)
+        outs = self._add(OpType.LSTM, p, ins, name=name)
+        return outs[0], outs[1], outs[2]
+
     def layer_norm(
         self,
         input: Tensor,

@@ -1,5 +1,6 @@
 """Model zoo matching the reference's examples/cpp applications."""
 from .moe import build_moe_mlp
+from .nmt import build_nmt
 from .recommender import build_candle_uno, build_dlrm, build_mlp_unify, build_xdl
 from .transformer import (
     BERT_BASE,
@@ -23,4 +24,5 @@ __all__ = [
     "build_candle_uno",
     "build_mlp_unify",
     "build_moe_mlp",
+    "build_nmt",
 ]

@@ -27,3 +27,4 @@ from . import shape_ops  # noqa: F401
 from . import reduction_ops  # noqa: F401
 from . import moe_ops  # noqa: F401
 from . import parallel_ops  # noqa: F401
+from . import recurrent  # noqa: F401

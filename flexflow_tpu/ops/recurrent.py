@@ -1,0 +1,163 @@
+"""Recurrent operators: vanilla RNN and LSTM.
+
+Reference: nmt/ (3980 LoC) — the legacy standalone LSTM/RNN NMT app
+predating FFModel (nmt/rnn.h, nmt/lstm.cc CUDA kernels via cudnnRNN).
+TPU-native: lax.scan over time — XLA unrolls the recurrence into a
+single compiled loop; the input projection for ALL timesteps is one
+large matmul (good MXU utilization), only the hidden recurrence scans.
+
+Layout: sequences are batch-first [B, T, D]; hidden states [B, H].
+Both ops emit (sequence, final_h[, final_c]) so encoder final states can
+initialize a decoder (optional inputs h0[, c0]).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+from ..core.types import ActiMode, DataType, OpType
+from .base import LowerCtx, OpCost, OpDef, WeightSpec, io_cost, register_op
+from .elementwise import apply_activation
+
+
+@dataclasses.dataclass(frozen=True)
+class RecurrentParams:
+    hidden_size: int
+    dtype: DataType = DataType.FLOAT
+    activation: ActiMode = ActiMode.TANH  # RNN cell nonlinearity
+    kernel_initializer: str = "glorot_uniform"
+
+
+def _scan_time_major(step, init_carry, x_proj):
+    """Scan over [T, B, G] input projections."""
+    (carry, ys) = jax.lax.scan(step, init_carry, x_proj)
+    return carry, ys
+
+
+@register_op
+class RNNOp(OpDef):
+    """Elman RNN: h_t = act(x_t @ Wx + h_{t-1} @ Wh + b)."""
+
+    op_type = OpType.RNN
+    params_cls = RecurrentParams
+
+    @staticmethod
+    def infer_output_specs(params: RecurrentParams, input_specs: List[TensorSpec]):
+        x = input_specs[0]
+        b, t = x.shape[0], x.shape[1]
+        h = params.hidden_size
+        return [
+            TensorSpec((b, t, h), params.dtype),  # sequence
+            TensorSpec((b, h), params.dtype),  # final hidden
+        ]
+
+    @staticmethod
+    def weight_specs(params: RecurrentParams, input_specs: List[TensorSpec]):
+        x = input_specs[0]
+        d, h = x.shape[-1], params.hidden_size
+        init = params.kernel_initializer
+        return [
+            WeightSpec("wx", TensorSpec((d, h), params.dtype), init),
+            WeightSpec("wh", TensorSpec((h, h), params.dtype), "orthogonal"),
+            WeightSpec("bias", TensorSpec((h,), params.dtype), "zeros"),
+        ]
+
+    @staticmethod
+    def lower(params: RecurrentParams, inputs, weights, ctx: LowerCtx):
+        x = inputs[0]
+        b = x.shape[0]
+        h = params.hidden_size
+        h0 = inputs[1] if len(inputs) > 1 else jnp.zeros((b, h), x.dtype)
+        # one big [B*T, D] @ [D, H] matmul for every step's input part
+        xp = jnp.einsum("btd,dh->tbh", x, weights["wx"]) + weights["bias"]
+
+        def step(carry, xt):
+            nxt = apply_activation(
+                params.activation,
+                xt + jnp.dot(carry, weights["wh"], preferred_element_type=jnp.float32).astype(xt.dtype),
+            )
+            return nxt, nxt
+
+        hT, ys = _scan_time_major(step, h0, xp)
+        return [jnp.swapaxes(ys, 0, 1), hT]
+
+    @staticmethod
+    def cost(params: RecurrentParams, input_specs, output_specs) -> OpCost:
+        x = input_specs[0]
+        b, t, d = x.shape[0], x.shape[1], x.shape[-1]
+        h = params.hidden_size
+        flops = 2.0 * b * t * (d * h + h * h)
+        w_bytes = (d * h + h * h + h) * params.dtype.size_bytes
+        c = io_cost(input_specs, output_specs, flops=flops, extra_mem=w_bytes)
+        c.bytes_accessed += w_bytes + t * b * h * params.dtype.size_bytes
+        return c
+
+
+@register_op
+class LSTMOp(OpDef):
+    """LSTM with fused gates (i, f, g, o), forget bias 1.0
+    (reference: nmt/lstm.cc's cudnnRNN LSTM mode)."""
+
+    op_type = OpType.LSTM
+    params_cls = RecurrentParams
+
+    @staticmethod
+    def infer_output_specs(params: RecurrentParams, input_specs: List[TensorSpec]):
+        x = input_specs[0]
+        b, t = x.shape[0], x.shape[1]
+        h = params.hidden_size
+        return [
+            TensorSpec((b, t, h), params.dtype),  # sequence
+            TensorSpec((b, h), params.dtype),  # final hidden
+            TensorSpec((b, h), params.dtype),  # final cell
+        ]
+
+    @staticmethod
+    def weight_specs(params: RecurrentParams, input_specs: List[TensorSpec]):
+        x = input_specs[0]
+        d, h = x.shape[-1], params.hidden_size
+        init = params.kernel_initializer
+        return [
+            WeightSpec("wx", TensorSpec((d, 4 * h), params.dtype), init),
+            WeightSpec("wh", TensorSpec((h, 4 * h), params.dtype), "orthogonal"),
+            WeightSpec("bias", TensorSpec((4 * h,), params.dtype), "zeros"),
+        ]
+
+    @staticmethod
+    def lower(params: RecurrentParams, inputs, weights, ctx: LowerCtx):
+        x = inputs[0]
+        b = x.shape[0]
+        h = params.hidden_size
+        h0 = inputs[1] if len(inputs) > 1 else jnp.zeros((b, h), x.dtype)
+        c0 = inputs[2] if len(inputs) > 2 else jnp.zeros((b, h), x.dtype)
+        xp = jnp.einsum("btd,dg->tbg", x, weights["wx"]) + weights["bias"]
+
+        def step(carry, xt):
+            hp, cp = carry
+            gates = xt + jnp.dot(hp, weights["wh"], preferred_element_type=jnp.float32).astype(xt.dtype)
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f + 1.0)  # forget-gate bias 1.0
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c = f * cp + i * g
+            hn = o * jnp.tanh(c)
+            return (hn, c), hn
+
+        (hT, cT), ys = _scan_time_major(step, (h0, c0), xp)
+        return [jnp.swapaxes(ys, 0, 1), hT, cT]
+
+    @staticmethod
+    def cost(params: RecurrentParams, input_specs, output_specs) -> OpCost:
+        x = input_specs[0]
+        b, t, d = x.shape[0], x.shape[1], x.shape[-1]
+        h = params.hidden_size
+        flops = 2.0 * b * t * 4 * (d * h + h * h)
+        w_bytes = (d * 4 * h + h * 4 * h + 4 * h) * params.dtype.size_bytes
+        c = io_cost(input_specs, output_specs, flops=flops, extra_mem=w_bytes)
+        c.bytes_accessed += w_bytes + t * b * h * params.dtype.size_bytes
+        return c

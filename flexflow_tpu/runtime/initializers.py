@@ -50,6 +50,20 @@ def make_normal(mean: float = 0.0, stddev: float = 1.0):
     return init
 
 
+def orthogonal(key: jax.Array, spec: TensorSpec) -> jax.Array:
+    """Orthogonal init (recurrent kernels; gain 1.0). For [H, G*H] LSTM
+    weights each square block column is orthogonalized independently."""
+    shape = spec.shape
+    if len(shape) != 2:
+        return glorot_uniform(key, spec)
+    rows, cols = shape
+    n = max(rows, cols)
+    a = jax.random.normal(key, (n, n), jnp.float32)
+    q, r = jnp.linalg.qr(a)
+    q = q * jnp.sign(jnp.diagonal(r))
+    return q[:rows, :cols].astype(spec.dtype.jnp)
+
+
 def make_constant(value: float):
     def init(key, spec: TensorSpec):
         return jnp.full(spec.shape, value, spec.dtype.jnp)
@@ -63,6 +77,7 @@ _REGISTRY: Dict[str, Callable] = {
     "ones": ones,
     "normal": make_normal(),
     "uniform": make_uniform(-0.05, 0.05),
+    "orthogonal": orthogonal,
 }
 
 

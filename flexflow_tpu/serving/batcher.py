@@ -55,6 +55,15 @@ class DynamicBatcher:
         if self._thread:
             self._thread.join(timeout=5)
             self._thread = None
+        # drain stale sentinels/requests so a later start() gets a clean
+        # queue (a re-queued None would kill the new collector instantly)
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(item, _Request) and not item.future.done():
+                item.future.set_exception(RuntimeError("batcher stopped"))
 
     # ------------------------------------------------------------- submit
     def submit(self, inputs: Sequence[np.ndarray]) -> Future:
@@ -62,10 +71,20 @@ class DynamicBatcher:
         the output list."""
         if not self._running:
             raise RuntimeError("batcher not started")
+        if len(inputs) != len(self.model.inputs):
+            raise ValueError(f"model takes {len(self.model.inputs)} inputs, got {len(inputs)}")
         n = inputs[0].shape[0]
         if n > self.model.max_batch:
             raise ValueError(f"request batch {n} exceeds max_batch {self.model.max_batch}")
-        req = _Request([np.asarray(x) for x in inputs])
+        arrays = [np.asarray(x) for x in inputs]
+        # validate per-request so one malformed request can't poison the
+        # co-batched requests at np.concatenate time
+        for x, meta in zip(arrays, self.model.inputs):
+            if tuple(x.shape[1:]) != meta.shape:
+                raise ValueError(f"input {meta.name}: expected {meta.shape}, got {tuple(x.shape[1:])}")
+            if x.shape[0] != n:
+                raise ValueError("all inputs in a request must share the batch dim")
+        req = _Request(arrays)
         self._q.put(req)
         return req.future
 
@@ -76,30 +95,30 @@ class DynamicBatcher:
     def _collect(self) -> List[_Request]:
         """Block for the first request, then drain until the batch is full
         or max_delay_s has passed."""
+        import time
+
         first = self._q.get()
         if first is None:
             return []
         batch = [first]
         total = first.n
-        deadline = threading.Event()
-        timer = threading.Timer(self.max_delay_s, deadline.set)
-        timer.start()
-        try:
-            while total < self.model.max_batch and not deadline.is_set():
-                try:
-                    nxt = self._q.get(timeout=self.max_delay_s / 10)
-                except queue.Empty:
-                    continue
-                if nxt is None:
-                    self._q.put(None)  # keep the shutdown signal
-                    break
-                if total + nxt.n > self.model.max_batch:
-                    self._q.put(nxt)  # doesn't fit: next round
-                    break
-                batch.append(nxt)
-                total += nxt.n
-        finally:
-            timer.cancel()
+        deadline = time.monotonic() + self.max_delay_s
+        while total < self.model.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if nxt is None:
+                self._q.put(None)  # keep the shutdown signal
+                break
+            if total + nxt.n > self.model.max_batch:
+                self._q.put(nxt)  # doesn't fit: next round
+                break
+            batch.append(nxt)
+            total += nxt.n
         return batch
 
     def _loop(self):

@@ -239,3 +239,22 @@ def test_onnx_both_scalar_initializers_fold():
     ff.compile(optimizer=SGDOptimizer(lr=0.0), loss_type=LossType.MEAN_SQUARED_ERROR, outputs=outs)
     xv = np.random.RandomState(2).randn(4, 8).astype(np.float32)
     np.testing.assert_allclose(np.asarray(ff.predict([xv])), xv * 0.5, rtol=1e-6)
+
+
+def test_onnx_add_with_zero_scalar_initializer():
+    """Regression: the constant fold must not evaluate div when folding add."""
+    g = GraphProto(
+        node=[
+            NodeProto("Add", ["one", "zero"], ["c"], "a"),
+            NodeProto("Mul", ["x", "c"], ["y"], "m"),
+        ],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[Init("one", np.array([1.0], np.float32)), Init("zero", np.array([0.0], np.float32))],
+    )
+    ff = FFModel(FFConfig(batch_size=2))
+    x = ff.create_tensor((2, 4))
+    outs = ONNXModel(ModelProto(g)).apply(ff, {"x": x})
+    ff.compile(optimizer=SGDOptimizer(lr=0.0), loss_type=LossType.MEAN_SQUARED_ERROR, outputs=outs)
+    xv = np.random.RandomState(3).randn(2, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(ff.predict([xv])), xv, rtol=1e-6)

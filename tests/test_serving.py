@@ -182,3 +182,27 @@ def test_from_onnx_serves_graph_weights():
     x = rs.randn(3, 16).astype(np.float32)
     (got,) = m.infer([x])
     np.testing.assert_allclose(got, np.maximum(x @ w, 0.0), rtol=1e-5, atol=1e-6)
+
+
+def test_batcher_rejects_bad_shape_without_poisoning_batch(served_model):
+    b = DynamicBatcher(served_model, max_delay_s=0.02)
+    b.start()
+    try:
+        good = b.submit([np.zeros((1, 16), np.float32)])
+        with pytest.raises(ValueError):
+            b.submit([np.zeros((1, 5), np.float32)])  # rejected at submit
+        (out,) = good.result(timeout=30)
+        assert out.shape == (1, 4)
+    finally:
+        b.stop()
+
+
+def test_batcher_restart_after_stop(served_model):
+    b = DynamicBatcher(served_model, max_delay_s=0.01)
+    b.start()
+    b.infer([np.zeros((1, 16), np.float32)], timeout=30)
+    b.stop()
+    b.start()  # regression: stale None sentinel used to kill the collector
+    (out,) = b.infer([np.zeros((1, 16), np.float32)], timeout=30)
+    assert out.shape == (1, 4)
+    b.stop()
