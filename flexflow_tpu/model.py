@@ -563,6 +563,8 @@ class FFModel:
         steps = n // bs
         rng = jax.random.key(self._seed + 1)
         perf = PerfMetrics()
+        if self.config.profiling:  # reference: --profiling per-op timings
+            self.profile(x=[xx[:bs] for xx in xs])
         t0 = time.time()
         for epoch in range(epochs):
             for step in range(steps):
@@ -632,6 +634,31 @@ class FFModel:
             shardings=shardings,
             label_sharding=label_sharding,
         )
+
+    def profile(self, x=None, verbose: bool = True):
+        """Per-op forward timing table (reference: --profiling cudaEvent
+        brackets in every kernel, e.g. linear_kernels.cu:95-118)."""
+        from .runtime.profiling import format_profiles, profile_step
+
+        assert self.executor is not None, "call compile() first"
+        if x is None:
+            specs = infer_all_specs(self.graph)
+            ins = sorted(
+                (n for n in self.graph.nodes.values() if n.op_type == OpType.INPUT),
+                key=lambda n: n.params.input_index,
+            )
+            rs = np.random.RandomState(0)
+            x = []
+            for n in ins:
+                s = specs[n.guid][0]
+                if s.dtype.jnp in (jnp.int32, jnp.int64):
+                    x.append(rs.randint(0, 2, s.shape).astype(np.int32))
+                else:
+                    x.append(rs.randn(*s.shape).astype(np.float32))
+        profiles = profile_step(self.executor, x)
+        if verbose:
+            print(format_profiles(profiles))
+        return profiles
 
     def recompile_on_condition(self, trigger, alter):
         """Reference: FFModel::recompile_on_condition (model.cc:2430)."""

@@ -1,0 +1,114 @@
+"""Pipeline-parallel transformer: the block stack runs under the GPipe
+schedule (parallel/pipeline.py) with stage weights sharded over "pipe".
+
+Reference: no real pipeline exists there (SURVEY §2.2 — OP_PIPELINE is a
+placeholder); this composes the new capability with the transformer
+flagship. Embedding-free (projection in/out like examples/cpp/
+Transformer's encoder) so the pipelined region is homogeneous; each
+stage holds layers_per_stage consecutive encoder blocks.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import TransformerConfig
+from ..ops.attention import attention_core
+from ..parallel.mesh import PIPE_AXIS
+from ..parallel.pipeline import gpipe, shard_stage_params
+
+
+def _block_apply(p: Dict[str, jax.Array], x: jax.Array, num_heads: int) -> jax.Array:
+    """One pre-LN encoder block on [mb, S, D]."""
+    d = x.shape[-1]
+    hd = d // num_heads
+
+    def ln(x, scale, bias):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+
+    h = ln(x, p["ln1_s"], p["ln1_b"])
+    q = jnp.einsum("bsd,dhk->bshk", h, p["wq"].reshape(d, num_heads, hd))
+    k = jnp.einsum("bsd,dhk->bshk", h, p["wk"].reshape(d, num_heads, hd))
+    v = jnp.einsum("bsd,dhk->bshk", h, p["wv"].reshape(d, num_heads, hd))
+    a = attention_core(q, k, v, backend="cpu")  # XLA path; fusible under pipeline
+    h = jnp.einsum("bshk,hkd->bsd", a, p["wo"].reshape(num_heads, hd, d))
+    x = x + h
+    h = ln(x, p["ln2_s"], p["ln2_b"])
+    h = jax.nn.gelu(h @ p["fc1"] + p["b1"])
+    h = h @ p["fc2"] + p["b2"]
+    return x + h
+
+
+def init_pipelined_transformer(
+    cfg: TransformerConfig, n_stages: int, key: jax.Array
+) -> Dict[str, jax.Array]:
+    """Stacked stage params: every leaf is [S, layers_per_stage, ...]."""
+    assert cfg.num_layers % n_stages == 0, (cfg.num_layers, n_stages)
+    lps = cfg.num_layers // n_stages
+    d, f = cfg.hidden_size, cfg.ff_size
+    dt = cfg.dtype.jnp
+
+    def w(key, *shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] ** -0.5 if len(shape) > 1 else 0.02)
+        return (jax.random.normal(key, (n_stages, lps) + shape, jnp.float32) * scale).astype(dt)
+
+    ks = iter(jax.random.split(key, 16))
+    return {
+        "ln1_s": jnp.ones((n_stages, lps, d), dt),
+        "ln1_b": jnp.zeros((n_stages, lps, d), dt),
+        "wq": w(next(ks), d, d),
+        "wk": w(next(ks), d, d),
+        "wv": w(next(ks), d, d),
+        "wo": w(next(ks), d, d),
+        "ln2_s": jnp.ones((n_stages, lps, d), dt),
+        "ln2_b": jnp.zeros((n_stages, lps, d), dt),
+        "fc1": w(next(ks), d, f),
+        "b1": jnp.zeros((n_stages, lps, f), dt),
+        "fc2": w(next(ks), f, d),
+        "b2": jnp.zeros((n_stages, lps, d), dt),
+    }
+
+
+def build_pipelined_transformer(
+    cfg: TransformerConfig,
+    mesh,
+    n_microbatches: int,
+) -> Tuple[Callable, Callable]:
+    """Returns (init_fn, train_step).
+
+    init_fn(key) -> params sharded over the mesh ("pipe" on stage axis).
+    train_step(params, x, y, lr) -> (params, loss): pipelined forward,
+    backward through the reverse pipeline, SGD update.
+    """
+    n_stages = mesh.shape[PIPE_AXIS]
+
+    def stage_fn(stage_params, act):
+        # stage_params leaves: [layers_per_stage, ...]; loop the blocks
+        lps = next(iter(stage_params.values())).shape[0]
+
+        def body(act, layer_params):
+            return _block_apply(layer_params, act, cfg.num_heads), None
+
+        act, _ = jax.lax.scan(body, act, stage_params)
+        return act
+
+    pipelined = gpipe(stage_fn, n_microbatches=n_microbatches, mesh=mesh)
+
+    def init_fn(key):
+        return shard_stage_params(mesh, init_pipelined_transformer(cfg, n_stages, key))
+
+    def train_step(params, x, y, lr=0.01):
+        def loss_fn(p):
+            out = pipelined(p, x)
+            return jnp.mean((out.astype(jnp.float32) - y.astype(jnp.float32)) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params = jax.tree.map(lambda p, g: (p - lr * g.astype(p.dtype)).astype(p.dtype), params, grads)
+        return params, loss
+
+    return init_fn, train_step

@@ -1,0 +1,182 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a
+``pipe`` mesh axis.
+
+Reference status (SURVEY §2.2): OP_PIPELINE is an enum placeholder with
+NO implementation (ffconst.h:160; only stray references in
+ffconst_utils.cc:171 and substitution.cc:1448) — the reference's
+"pipeline" is just inter-op device placement from the DP search's graph
+splits. This module is the real thing, TPU-native:
+
+  * stage parameters carry a leading [S] stage axis sharded over "pipe";
+  * inside shard_map every device applies its own stage to its current
+    microbatch each tick, then the activations rotate one hop along the
+    pipe axis with lax.ppermute (a neighbor transfer on the ICI torus);
+  * a lax.scan over M + S - 1 ticks runs the classic GPipe schedule
+    (fill, steady state, drain; bubble fraction (S-1)/(M+S-1));
+  * reverse-mode AD through scan + ppermute yields the backward
+    pipeline automatically (ppermute's transpose is the reverse hop).
+
+Works for homogeneous stage stacks (each stage runs the same program
+with its own weights) — the transformer-block case; heterogeneous
+prologue/epilogue (embeddings, heads) run outside the pipelined region
+under the usual dp/tp shardings.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .mesh import PIPE_AXIS
+
+
+def shard_stage_params(mesh: Mesh, stacked_params):
+    """Place stacked stage params [S, ...] with the stage axis on "pipe"
+    (per-leaf rank-aware; biases and matrices differ in rank)."""
+    return jax.tree.map(
+        lambda p: jax.device_put(
+            p, NamedSharding(mesh, PartitionSpec(PIPE_AXIS, *([None] * (p.ndim - 1))))
+        ),
+        stacked_params,
+    )
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    n_microbatches: int,
+    mesh: Mesh,
+    axis: str = PIPE_AXIS,
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """Build a pipelined apply: (stacked_params, x) -> y.
+
+    stage_fn(params_for_one_stage, activation) -> activation, with the
+    same activation shape in and out (a residual-block stack).
+    stacked_params: pytree whose leaves have a leading stage axis [S, ...]
+    sharded over ``axis``. x: [B, ...] with B divisible by n_microbatches.
+
+    The returned function must be called under jit with ``mesh`` active
+    (shard_map handles the collectives).
+    """
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    n_stages = mesh.shape[axis]
+
+    def pipelined(stacked_params, x):
+        b = x.shape[0]
+        assert b % n_microbatches == 0, (b, n_microbatches)
+        mb = b // n_microbatches
+        # [M, mb, ...] microbatch schedule
+        xs = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+        def per_device(params, xs_local):
+            # params: this stage's slice, leading axis of size 1
+            params = jax.tree.map(lambda p: p[0], params)
+            stage = jax.lax.axis_index(axis)
+            ticks = n_microbatches + n_stages - 1
+            # local microbatch shape (the batch dim may be data-sharded)
+            act0 = jnp.zeros(xs_local.shape[1:], x.dtype)
+            outs0 = jnp.zeros_like(xs_local)
+            if hasattr(jax.lax, "pcast"):
+                # newer shard_map tracks varying manual axes: the carries
+                # must enter the scan with the variance they will have
+                # after a tick — {pipe} ∪ {data if batch-sharded}.
+                # outs0 = zeros_like(xs_local) already varies like the
+                # input (data); act0 is fresh zeros (invarying).
+                from .mesh import DATA_AXIS as _DA
+
+                data_v = (_DA,) if (_DA in mesh.axis_names and mesh.shape[_DA] > 1) else ()
+                act0 = jax.lax.pcast(act0, (axis,) + data_v, to="varying")
+                outs0 = jax.lax.pcast(outs0, (axis,), to="varying")
+
+            def tick(carry, t):
+                act, outs = carry
+                # stage 0 injects microbatch t; others use the arriving act
+                inject = jnp.where(t < n_microbatches, t, 0)
+                fresh = jax.lax.dynamic_index_in_dim(xs_local, inject, keepdims=False)
+                inp = jnp.where(stage == 0, fresh, act)
+                out = stage_fn(params, inp)
+                # last stage banks microbatch t - (S-1)
+                done_idx = t - (n_stages - 1)
+                is_last = stage == n_stages - 1
+                valid = jnp.logical_and(is_last, done_idx >= 0)
+                updated = jax.lax.dynamic_update_index_in_dim(
+                    outs, out.astype(outs.dtype), jnp.maximum(done_idx, 0), 0
+                )
+                outs = jnp.where(valid, updated, outs)
+                # rotate activations one hop down the pipe
+                perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+                act = jax.lax.ppermute(out, axis, perm)
+                return (act, outs), None
+
+            (act, outs), _ = jax.lax.scan(tick, (act0, outs0), jnp.arange(ticks))
+            # outs is populated only on the last stage; psum broadcasts it
+            # (every other stage holds zeros)
+            mask = (stage == n_stages - 1).astype(outs.dtype)
+            return jax.lax.psum(outs * mask, axis)
+
+        specs_params = jax.tree.map(lambda _: PartitionSpec(axis), stacked_params)
+        # combine with data parallelism when the mesh has a "data" axis:
+        # the microbatch dim rides it (dp x pp, reference-style hybrid)
+        from .mesh import DATA_AXIS
+
+        data = DATA_AXIS if DATA_AXIS in mesh.axis_names and mesh.shape[DATA_AXIS] > 1 else None
+        xs_spec = PartitionSpec(None, data)
+        y = shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(specs_params, xs_spec),
+            out_specs=xs_spec,
+        )(stacked_params, xs)
+        return y.reshape((b,) + y.shape[2:])
+
+    return pipelined
+
+
+def balanced_stages(costs, n_stages: int):
+    """Split op costs into contiguous stages minimizing the max stage cost
+    (the placement half of pipeline parallelism; reference analog: the DP
+    search's sequential graph splits, graph.cc:206-231). Returns stage
+    boundary indices: ops [b[i], b[i+1]) form stage i."""
+    n = len(costs)
+    if n_stages <= 1 or n <= n_stages:
+        bounds = list(range(n + 1))
+        while len(bounds) < n_stages + 1:
+            bounds.append(n)
+        return bounds[: n_stages + 1]
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+
+    def stage_cost(i, j):
+        return prefix[j] - prefix[i]
+
+    # binary search the max stage cost, greedy feasibility
+    lo, hi = max(costs), prefix[-1]
+    for _ in range(40):
+        mid = (lo + hi) / 2
+        stages, start = 1, 0
+        for i in range(1, n + 1):
+            if stage_cost(start, i) > mid:
+                stages += 1
+                start = i - 1
+        if stages <= n_stages:
+            hi = mid
+        else:
+            lo = mid
+    # materialize bounds at threshold hi
+    bounds = [0]
+    start = 0
+    for i in range(1, n + 1):
+        if stage_cost(start, i) > hi and len(bounds) < n_stages:
+            bounds.append(i - 1)
+            start = i - 1
+    bounds.append(n)
+    while len(bounds) < n_stages + 1:
+        bounds.insert(-1, bounds[-2])
+    return bounds

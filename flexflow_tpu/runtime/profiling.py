@@ -1,0 +1,137 @@
+"""Profiling and tracing.
+
+Reference (SURVEY §5): (a) Legion tracing for iteration replay — on TPU
+the jit compile cache plays that role; (b) the ``--profiling`` flag makes
+every kernel bracket itself with cudaEvents and print elapsed ms
+(linear_kernels.cu:95-118) — here ``profile_step`` times each op's
+lowering with a device flush; (c) DOT exports (--taskgraph/--compgraph/
+--include-costs-dot-graph); (d) Legion's -lg:prof — here
+``trace()`` wraps jax.profiler for an xplane/TensorBoard trace.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.types import OpType
+from ..ops.base import LowerCtx, get_op_def
+
+
+@dataclasses.dataclass
+class OpProfile:
+    guid: int
+    op_type: str
+    name: str
+    ms: float
+    flops: float
+    bytes_accessed: float
+
+    @property
+    def tflops(self) -> float:
+        return self.flops / max(1e-9, self.ms / 1e3) / 1e12
+
+
+def profile_step(executor, inputs: Sequence, rng=None) -> List[OpProfile]:
+    """Run the forward graph op-by-op, timing each lowering with a device
+    flush (reference: per-op cudaEvent brackets under --profiling).
+
+    Eager per-op execution loses XLA fusion, so these are upper bounds on
+    each op's standalone cost — the jitted step is faster than the sum.
+    """
+    from ..parallel.propagation import infer_all_specs
+
+    from .executor import _node_key
+
+    graph = executor.graph
+    specs = infer_all_specs(graph)
+    if rng is None:
+        rng = jax.random.key(0)
+    ctx = LowerCtx(training=False, rng=rng, backend=executor.backend, mesh=executor.mesh)
+    values = {}
+    profiles: List[OpProfile] = []
+    inputs = [jax.numpy.asarray(x) for x in inputs]
+    for node in graph.topo_order():
+        op_def = get_op_def(node.op_type)
+        nkey = _node_key(node)
+        if node.op_type == OpType.INPUT:
+            values[(node.guid, 0)] = inputs[node.params.input_index]
+            continue
+        node_inputs = [values[(e.src, e.src_idx)] for e in graph.in_edges(node)]
+        weights = {}
+        weights.update(executor.params.get(nkey, {}))
+        weights.update(executor.state.get(nkey, {}))
+        ctx.node_guid = node.guid
+        fn = jax.jit(lambda ni, w: op_def.lower(node.params, ni, w, ctx))
+        outs = fn(node_inputs, weights)  # compile + first run
+        jax.block_until_ready(outs)
+        t0 = time.perf_counter()
+        outs = fn(node_inputs, weights)
+        jax.block_until_ready(outs)
+        ms = (time.perf_counter() - t0) * 1e3
+        for i, o in enumerate(outs):
+            values[(node.guid, i)] = o
+        in_specs = [specs[e.src][e.src_idx] for e in graph.in_edges(node)]
+        try:
+            cost = op_def.cost(node.params, in_specs, specs[node.guid])
+            flops, nbytes = cost.flops, cost.bytes_accessed
+        except Exception:
+            flops = nbytes = 0.0
+        profiles.append(
+            OpProfile(node.guid, node.op_type.value, node.name or "", ms, flops, nbytes)
+        )
+    return profiles
+
+
+def format_profiles(profiles: List[OpProfile]) -> str:
+    total = sum(p.ms for p in profiles)
+    lines = [f"{'op':16s} {'name':20s} {'ms':>9s} {'%':>6s} {'TFLOP/s':>8s}"]
+    for p in sorted(profiles, key=lambda p: -p.ms):
+        lines.append(
+            f"{p.op_type:16s} {p.name[:20]:20s} {p.ms:9.3f} {100*p.ms/max(1e-9,total):6.1f} {p.tflops:8.2f}"
+        )
+    lines.append(f"{'TOTAL':16s} {'':20s} {total:9.3f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """jax.profiler trace (xplane; view in TensorBoard) — the TPU analog
+    of Legion's -lg:prof profile logs."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def export_cost_dot(graph, machine=None) -> str:
+    """PCG DOT annotated with analytic per-op costs (reference:
+    --include-costs-dot-graph, config.h:145)."""
+    from ..parallel.propagation import infer_all_specs
+    from ..search.cost_model import CostModel
+
+    cm = CostModel(machine) if machine else CostModel()
+    specs = infer_all_specs(graph)
+
+    def label(node):
+        base = f"{node.op_type.value}\\n{node.name or node.guid}"
+        if node.op_type in (OpType.INPUT, OpType.WEIGHT):
+            return base
+        in_specs = [specs[e.src][e.src_idx] for e in graph.in_edges(node)]
+        try:
+            op_def = get_op_def(node.op_type)
+            c = op_def.cost(node.params, in_specs, specs[node.guid])
+            m = cm.op_cost_metrics(node.op_type, node.params, in_specs, specs[node.guid])
+            return (
+                f"{base}\\n{c.flops/1e9:.2f} GFLOP, {c.bytes_accessed/1e6:.1f} MB"
+                f"\\n~{m.forward_time*1e6:.1f} us fwd"
+            )
+        except Exception:
+            return base
+
+    return graph.to_dot(label_fn=label)

@@ -1,0 +1,61 @@
+"""Profiling/tracing subsystem tests (reference: SURVEY §5 — the
+--profiling per-kernel timings, --include-costs-dot-graph export,
+Legion -lg:prof ~ jax.profiler)."""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.runtime.profiling import export_cost_dot, format_profiles, profile_step
+
+
+def _small_model():
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor([4, 16])
+    t = ff.dense(x, 32, activation="relu", name="fc1")
+    t = ff.dense(t, 8, name="fc2")
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1), loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def test_profile_step_covers_all_compute_ops():
+    ff = _small_model()
+    profiles = ff.profile(verbose=False)
+    kinds = {p.op_type for p in profiles}
+    assert {"linear", "softmax"} <= kinds
+    assert all(p.ms >= 0 for p in profiles)
+    linear = next(p for p in profiles if p.name == "fc1")
+    assert linear.flops > 0
+    table = format_profiles(profiles)
+    assert "TOTAL" in table and "fc1" in table
+
+
+def test_profiling_flag_prints_table(capsys):
+    ff = FFModel(FFConfig(batch_size=4, profiling=True))
+    x = ff.create_tensor([4, 16])
+    ff.dense(x, 8)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1), loss_type=LossType.MEAN_SQUARED_ERROR)
+    X = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    Y = np.random.RandomState(1).randn(8, 8).astype(np.float32)
+    ff.fit([X], Y, epochs=1, verbose=False)
+    out = capsys.readouterr().out
+    assert "TOTAL" in out
+
+
+def test_export_cost_dot_annotates_costs():
+    ff = _small_model()
+    dot = export_cost_dot(ff.graph)
+    assert "digraph" in dot
+    assert "GFLOP" in dot
+    assert "us fwd" in dot
+
+
+def test_trace_context_writes_profile(tmp_path):
+    import jax
+
+    from flexflow_tpu.runtime.profiling import trace
+
+    with trace(str(tmp_path)):
+        jax.block_until_ready(jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8)))
+    # xplane artifacts land under plugins/profile/<run>/
+    found = list(tmp_path.rglob("*.xplane.pb"))
+    assert found, f"no xplane trace written under {tmp_path}"
