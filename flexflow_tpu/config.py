@@ -44,6 +44,14 @@ class FFConfig:
     machine_model_file: str = ""
     simulator_segment_size: int = 16777216
     simulator_max_num_segments: int = 1
+    # None = auto (class-level calibration only); True = measure every
+    # uncached candidate op live on the device (reference behavior,
+    # operator.h:127); False = purely analytic
+    measure_op_costs: Optional[bool] = None
+    # pipeline parallelism (new capability; reference's OP_PIPELINE is an
+    # unimplemented placeholder, ffconst.h:160)
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 0  # 0 -> auto (parallel/strategy.py)
     # execution flags
     perform_fusion: bool = False  # XLA fuses regardless; kept for CLI parity
     profiling: bool = False
@@ -100,6 +108,8 @@ class FFConfig:
         p.add_argument("--taskgraph", type=str, default="")
         p.add_argument("--compgraph", type=str, default="")
         p.add_argument("--include-costs-dot-graph", action="store_true")
+        p.add_argument("--pipeline-stages", type=int, default=1)
+        p.add_argument("--pipeline-microbatches", type=int, default=0)
         p.add_argument("--topo-file", type=str, default="")
         p.add_argument("--iteration", type=int, default=1)
         p.add_argument("--nodes", type=int, default=1)
@@ -137,6 +147,8 @@ class FFConfig:
             export_strategy_task_graph_file=ns.taskgraph,
             export_strategy_computation_graph_file=ns.compgraph,
             include_costs_dot_graph=ns.include_costs_dot_graph,
+            pipeline_stages=ns.pipeline_stages,
+            pipeline_microbatches=ns.pipeline_microbatches,
             topo_file=ns.topo_file,
             iteration=ns.iteration,
         )

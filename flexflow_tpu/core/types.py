@@ -180,6 +180,9 @@ class OpType(enum.Enum):
     AGGREGATE = "aggregate"
     AGGREGATE_SPEC = "aggregate_spec"
     CACHE = "cache"
+    # batched expert FFN over [n_experts, capacity, d] (TPU-native: one
+    # MXU-friendly einsum replaces the reference's n per-expert Dense ops)
+    EXPERTS = "experts"
     # fused
     FUSED = "fused"
     # parallel ops (sharding transitions; reference: src/parallel_ops/)

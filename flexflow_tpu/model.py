@@ -428,8 +428,27 @@ class FFModel:
         outs = self._add(OpType.TOPK, TopKParams(k, sorted), [input], name=name)
         return outs[0], outs[1]
 
-    def group_by(self, input: Tensor, assign: Tensor, n: int, alpha: float, name: str = "") -> List[Tensor]:
-        return self._add(OpType.GROUP_BY, GroupByParams(n, alpha), [input, assign], name=name)
+    def group_by(
+        self, input: Tensor, assign: Tensor, n: int, alpha: float, stacked: bool = False, name: str = ""
+    ) -> Union[List[Tensor], Tensor]:
+        outs = self._add(OpType.GROUP_BY, GroupByParams(n, alpha, stacked), [input, assign], name=name)
+        return outs[0] if stacked else outs
+
+    def experts(
+        self,
+        grouped: Tensor,
+        num_exp: int,
+        hidden_size: int,
+        out_dim: int,
+        activation: ActiMode = ActiMode.RELU,
+        name: str = "",
+    ) -> Tensor:
+        """Batched expert FFN over stacked [n, cap, D] (TPU-native: the
+        expert dim shards over the mesh for real expert parallelism)."""
+        from .ops.moe_ops import ExpertsParams
+
+        p = ExpertsParams(num_exp, hidden_size, out_dim, activation, grouped.dtype)
+        return self._one(OpType.EXPERTS, p, [grouped], name=name)
 
     def aggregate(
         self, gate_preds: Tensor, gate_assign: Tensor, exp_preds: Sequence[Tensor], n: int, lambda_bal: float, name: str = ""
@@ -454,13 +473,26 @@ class FFModel:
         expert_hidden_size: int,
         alpha: float = 2.0,
         lambda_bal: float = 0.04,
+        batched: bool = True,
         name: str = "",
     ) -> Tensor:
         """Composite MoE layer (reference: FFModel::moe, src/ops/moe.cc:20):
-        dense gate -> topk -> group_by -> per-expert dense -> aggregate."""
+        dense gate -> topk -> group_by -> experts -> aggregate.
+
+        batched=True (default, TPU-native): ONE stacked dispatch + ONE
+        batched Experts op — constant HLO size at any expert count, and
+        the expert dim shards over the mesh (real expert parallelism).
+        batched=False reproduces the reference's n separate per-expert
+        Dense ops."""
         gate = self.dense(input, num_exp, ActiMode.NONE, name=f"{name}_gate")
         gate = self.softmax(gate, name=f"{name}_gate_sm")
         topk_vals, topk_idx = self.top_k(gate, num_select, name=f"{name}_topk")
+        if batched:
+            grouped = self.group_by(input, topk_idx, num_exp, alpha, stacked=True, name=f"{name}_groupby")
+            expert_out = self.experts(
+                grouped, num_exp, expert_hidden_size, input.shape[-1], name=f"{name}_experts"
+            )
+            return self.aggregate(topk_vals, topk_idx, [expert_out], num_exp, lambda_bal, name=f"{name}_agg")
         grouped = self.group_by(input, topk_idx, num_exp, alpha, name=f"{name}_groupby")
         expert_outs = []
         for e, g in enumerate(grouped):
@@ -504,6 +536,18 @@ class FFModel:
 
             with open(self.config.import_strategy_file) as f:
                 self.strategy = ParallelStrategy.from_json(f.read())
+        elif self.config.pipeline_stages > 1:
+            from .parallel.strategy import pipeline_strategy
+
+            pp = self.config.pipeline_stages
+            if num_devices % pp != 0:
+                raise ValueError(f"{num_devices} devices not divisible by pipeline_stages={pp}")
+            self.strategy = pipeline_strategy(
+                self.graph,
+                pp=pp,
+                dp=num_devices // pp,
+                n_microbatches=self.config.pipeline_microbatches,
+            )
         elif self.config.only_data_parallel or self.config.search_budget <= 0:
             self.strategy = data_parallel_strategy(self.graph, num_devices)
         else:

@@ -138,6 +138,110 @@ def gpipe(
     return pipelined
 
 
+# ---------------------------------------------------------------------------
+# stage discovery: find the repeated block structure of a PCG
+# ---------------------------------------------------------------------------
+
+
+def _node_signatures(graph, order):
+    """Structural signature per topo position: (op_type, params, in-edge
+    shape) where each in-edge is (dst_idx, relative offset to the
+    producer's topo position, src_idx). Offsets make the signature
+    position-independent, so a repeated block stack yields a literal
+    periodic sequence."""
+    pos = {n.guid: i for i, n in enumerate(order)}
+    sigs = []
+    for i, n in enumerate(order):
+        edges = tuple(
+            sorted((e.dst_idx, i - pos[e.src], e.src_idx) for e in graph.in_edges(n))
+        )
+        sigs.append((n.op_type, n.params, edges))
+    return sigs
+
+
+def detect_repeats(graph):
+    """Split the PCG into (pre, repeats, post) where ``repeats`` is the
+    maximal run of structurally-isomorphic contiguous blocks (a
+    transformer's encoder stack). Block isomorphism is what lets the
+    executor stack per-block params [S, r, ...] and run them as ONE SPMD
+    stage program under the GPipe schedule.
+
+    Returns (pre: List[Node], repeats: List[List[Node]], post: List[Node]);
+    repeats == [] when no periodic region of >= 2 blocks exists.
+    """
+    order = list(graph.topo_order())
+    sigs = _node_signatures(graph, order)
+    n = len(order)
+    # maximize covered nodes; tie-break earliest start, then SMALLEST
+    # period (k repeats of one block beat k/2 repeats of a double block:
+    # more repeats = more stage-count flexibility)
+    best = None  # (coverage, -a, -p, a, p, k)
+    for a in range(n - 1):
+        if best is not None and best[0] >= n - a:
+            break
+        for p in range(1, (n - a) // 2 + 1):
+            if sigs[a : a + p] != sigs[a + p : a + 2 * p]:
+                continue
+            k = 2
+            while a + (k + 1) * p <= n and sigs[a + k * p : a + (k + 1) * p] == sigs[a : a + p]:
+                k += 1
+            cand = (k * p, -a, -p, a, p, k)
+            if best is None or cand > best:
+                best = cand
+    if best is None:
+        return order, [], []
+    _, _, _, a, p, k = best
+    repeats = [order[a + j * p : a + (j + 1) * p] for j in range(k)]
+    return order[:a], repeats, order[a + k * p :]
+
+
+def boundary_values(graph, repeats):
+    """((in_src_guid, in_src_idx), (out_src_guid, out_src_idx)) for the
+    pipelined region: the single value entering repeat 0 and the single
+    value leaving the last repeat. Raises if any repeat boundary carries
+    more than one tensor (GPipe rotates exactly one activation)."""
+    for j, rep in enumerate(repeats):
+        guids = {n.guid for n in rep}
+        ext_in = {
+            (e.src, e.src_idx)
+            for node in rep
+            for e in graph.in_edges(node)
+            if e.src not in guids
+        }
+        if len(ext_in) != 1:
+            raise ValueError(
+                f"pipeline stage boundary at repeat {j} carries {len(ext_in)} values "
+                f"(need exactly 1): {sorted(ext_in)}"
+            )
+        if j == 0:
+            boundary_in = next(iter(ext_in))
+    last = repeats[-1]
+    last_guids = {n.guid for n in last}
+    ext_out = {
+        (e.src, e.src_idx)
+        for node in last
+        for e in graph.out_edges(node)
+        if e.dst not in last_guids
+    }
+    if len(ext_out) > 1:
+        raise ValueError(f"pipelined region exposes {len(ext_out)} outputs (need 1)")
+    if not ext_out:
+        # the last repeat is the graph sink: its final value is the output
+        sink_edges = {
+            (e.src, e.src_idx)
+            for node in repeats[-2]
+            for e in graph.out_edges(node)
+            if e.dst in last_guids
+        }
+        # structurally the same position one block later
+        src_guid, src_idx = next(iter(sink_edges))
+        pos = {n.guid: i for i, n in enumerate(repeats[-2])}
+        out = (last[pos[src_guid]].guid, src_idx)
+    else:
+        out = next(iter(ext_out))
+    return boundary_in, out
+
+
 def balanced_stages(costs, n_stages: int):
     """Split op costs into contiguous stages minimizing the max stage cost
     (the placement half of pipeline parallelism; reference analog: the DP

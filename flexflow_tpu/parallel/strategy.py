@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core.graph import PCGraph
 from ..core.types import OpType
-from .mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS
+from .mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
 
 # A partition spec as pure data: one entry per tensor dim; each entry is a
 # tuple of mesh axis names (usually 0- or 1-long).
@@ -72,6 +72,18 @@ class OpSharding:
 
 
 @dataclasses.dataclass
+class PipelineAssignment:
+    """Stage partition of the PCG for GPipe execution (NEW capability —
+    the reference's OP_PIPELINE is an unimplemented placeholder,
+    ffconst.h:160; its closest analog is inter-op device placement from
+    the DP search's graph splits, graph.cc:206-231)."""
+
+    n_stages: int
+    n_microbatches: int
+    stage_of: Dict[int, int] = dataclasses.field(default_factory=dict)  # guid -> stage
+
+
+@dataclasses.dataclass
 class ParallelStrategy:
     """Full strategy: mesh shape + per-node shardings.
 
@@ -81,6 +93,7 @@ class ParallelStrategy:
 
     axis_sizes: Dict[str, int] = dataclasses.field(default_factory=dict)
     node_shardings: Dict[int, OpSharding] = dataclasses.field(default_factory=dict)
+    pipeline: Optional[PipelineAssignment] = None
 
     def output_spec(self, guid: int, idx: int = 0) -> Optional[SpecTuple]:
         s = self.node_shardings.get(guid)
@@ -99,6 +112,15 @@ class ParallelStrategy:
         return json.dumps(
             {
                 "axis_sizes": self.axis_sizes,
+                "pipeline": (
+                    {
+                        "n_stages": self.pipeline.n_stages,
+                        "n_microbatches": self.pipeline.n_microbatches,
+                        "stage_of": {str(g): s for g, s in self.pipeline.stage_of.items()},
+                    }
+                    if self.pipeline
+                    else None
+                ),
                 "nodes": {
                     str(g): {
                         "outputs": [list(map(list, o)) if o is not None else None for o in s.outputs],
@@ -115,6 +137,13 @@ class ParallelStrategy:
     def from_json(cls, text: str) -> "ParallelStrategy":
         d = json.loads(text)
         st = cls(axis_sizes=dict(d["axis_sizes"]))
+        if d.get("pipeline"):
+            p = d["pipeline"]
+            st.pipeline = PipelineAssignment(
+                n_stages=p["n_stages"],
+                n_microbatches=p["n_microbatches"],
+                stage_of={int(g): s for g, s in p["stage_of"].items()},
+            )
         for g, s in d["nodes"].items():
             st.node_shardings[int(g)] = OpSharding(
                 outputs=[tuple(tuple(e) for e in o) if o is not None else None for o in s["outputs"]],
@@ -239,6 +268,152 @@ def context_parallel_strategy(
             outputs=shardings, weights={w.name: None for w in wspecs}
         )
     return st
+
+
+def expert_parallel_strategy(
+    graph: PCGraph,
+    dp: int,
+    ep: int,
+    batch_dim: int = 0,
+) -> ParallelStrategy:
+    """dp x ep hybrid for MoE graphs (reference: per-op machine views
+    placing experts on distinct devices, examples/cpp/mixture_of_experts/
+    moe.cc:180-204 + aggregate.cc): the stacked GROUP_BY output and the
+    ExpertsOp weights shard their leading expert dim over the "expert"
+    axis — each device holds n/ep experts and GSPMD materializes the
+    token all_to_all at the dispatch/combine boundaries; token tensors
+    ride the "data" axis."""
+    from ..ops.base import get_op_def
+    from .propagation import infer_all_specs
+
+    st = ParallelStrategy(axis_sizes={DATA_AXIS: dp, EXPERT_AXIS: ep})
+    specs = infer_all_specs(graph)
+    for node in graph.topo_order():
+        out_specs = specs[node.guid]
+        in_specs = [specs[e.src][e.src_idx] for e in graph.in_edges(node)]
+        op_def = get_op_def(node.op_type)
+        try:
+            wspecs = op_def.weight_specs(node.params, in_specs)
+        except Exception:
+            wspecs = []
+        by_name = {w.name: w for w in wspecs}
+        weights: Dict[str, Optional[SpecTuple]] = {w.name: None for w in wspecs}
+        expert_sharded = False
+        if node.op_type == OpType.EXPERTS and ep > 1 and node.params.n_experts % ep == 0:
+            for wn in ("w1", "b1", "w2", "b2"):
+                shard_weight_entry(weights, by_name, wn, 0, EXPERT_AXIS, ep)
+            expert_sharded = True
+        if node.op_type == OpType.GROUP_BY and getattr(node.params, "stacked", False):
+            expert_sharded = ep > 1 and node.params.n_experts % ep == 0
+        outputs: List[Optional[SpecTuple]] = []
+        for os in out_specs:
+            if expert_sharded and os.ndim == 3 and os.shape[0] % ep == 0:
+                outputs.append(pspec(EXPERT_AXIS, None, None))
+            elif (
+                dp > 1
+                and node.op_type != OpType.WEIGHT
+                and os.ndim > batch_dim
+                and os.shape[batch_dim] % dp == 0
+            ):
+                outputs.append(pspec(*([DATA_AXIS] + [None] * (os.ndim - 1))))
+            else:
+                outputs.append(None)
+        st.node_shardings[node.guid] = OpSharding(outputs=outputs, weights=weights)
+    return st
+
+
+def pipeline_strategy(
+    graph: PCGraph,
+    pp: int,
+    dp: int = 1,
+    n_microbatches: int = 0,
+    batch_dim: int = 0,
+) -> ParallelStrategy:
+    """dp x pp hybrid: the graph's repeated block stack is split into
+    ``pp`` GPipe stages (stage costs balanced via balanced_stages over
+    the analytic cost model — the search half the reference's graph
+    splits performed, graph.cc:206-231), activations ride the "data"
+    axis, stage params ride "pipe".
+
+    Requires the number of repeated blocks to be divisible by pp (stages
+    must be isomorphic so the executor can stack their params [S, r, ...]
+    and run one SPMD stage program).
+    """
+    from .pipeline import balanced_stages, detect_repeats
+
+    pre, repeats, post = detect_repeats(graph)
+    if pp > 1:
+        if len(repeats) < pp:
+            raise ValueError(
+                f"pipeline_stages={pp} but only {len(repeats)} repeated blocks detected"
+            )
+        if len(repeats) % pp != 0:
+            raise ValueError(
+                f"{len(repeats)} repeated blocks not divisible into {pp} isomorphic stages"
+            )
+        # repeats are verified isomorphic (equal cost), so the balanced
+        # contiguous split is the uniform one; balanced_stages is the
+        # general tool for heterogeneous-cost splits (search integration)
+        r = len(repeats) // pp
+        bounds = balanced_stages([1.0] * len(repeats), pp)
+        if bounds != [i * r for i in range(pp + 1)]:
+            bounds = [i * r for i in range(pp + 1)]  # stages must stay stackable
+        stage_of = {}
+        for s in range(pp):
+            for rep in repeats[bounds[s] : bounds[s + 1]]:
+                for node in rep:
+                    stage_of[node.guid] = s
+        if n_microbatches <= 0:
+            n_microbatches = default_microbatches(_graph_batch(graph, batch_dim), pp, dp)
+        pipeline = PipelineAssignment(pp, n_microbatches, stage_of)
+    else:
+        pipeline = None
+
+    st = data_parallel_strategy(graph, dp, batch_dim=batch_dim)
+    st.axis_sizes = {DATA_AXIS: dp, PIPE_AXIS: pp}
+    st.pipeline = pipeline
+    if dp <= 1:
+        # build_mesh drops size-1 axes: no "data" axis exists, so no
+        # sharding constraint may reference it
+        for g, s in st.node_shardings.items():
+            st.node_shardings[g] = OpSharding(
+                outputs=[None] * len(s.outputs), weights=s.weights
+            )
+    if pipeline is not None:
+        # activations inside the pipelined region live under shard_map;
+        # sharding constraints there are the schedule's business, not GSPMD's
+        for guid in pipeline.stage_of:
+            if guid in st.node_shardings:
+                st.node_shardings[guid] = OpSharding(
+                    outputs=[None] * len(st.node_shardings[guid].outputs),
+                    weights=st.node_shardings[guid].weights,
+                )
+    return st
+
+
+def default_microbatches(batch: int, pp: int, dp: int = 1) -> int:
+    """Pick the GPipe microbatch count: prefer 4*pp (bubble ~ (S-1)/(M+S-1)
+    ~= 20%), fall back to smaller multiples, requiring batch % (M*dp) == 0
+    so every microbatch keeps an even data-parallel split."""
+    for m in (4 * pp, 2 * pp, pp):
+        if m <= batch and batch % (m * dp) == 0:
+            return m
+    for m in range(min(batch // max(1, dp), 4 * pp), 0, -1):
+        if batch % (m * dp) == 0:
+            return m
+    return 1
+
+
+def _graph_batch(graph: PCGraph, batch_dim: int) -> int:
+    from .propagation import infer_all_specs
+
+    specs = infer_all_specs(graph)
+    for node in graph.topo_order():
+        if node.op_type == OpType.INPUT:
+            s = specs[node.guid][0]
+            if s.ndim > batch_dim:
+                return s.shape[batch_dim]
+    return 1
 
 
 def data_parallel_strategy(graph: PCGraph, num_devices: int, batch_dim: int = 0) -> ParallelStrategy:

@@ -35,6 +35,66 @@ def _node_key(node: Node) -> str:
     return f"{node.op_type.value}_{node.guid}"
 
 
+_PIPE_KEY = "__pipe_stages__"
+
+
+@dataclasses.dataclass
+class _PipelinePlan:
+    """Executable stage partition derived from strategy.pipeline."""
+
+    pre: List[Node]
+    repeats: List[List[Node]]  # stage-major contiguous blocks
+    post: List[Node]
+    n_stages: int
+    n_microbatches: int
+    boundary_in: Tuple[int, int]  # (guid, idx) of the value entering repeat 0
+    out_src: Tuple[int, int]  # (guid, idx) of the last repeat's exit value
+    out_pos: Tuple[int, int]  # same value template-locally: (position, idx)
+
+
+def _build_pipeline_plan(graph: PCGraph, strategy) -> Optional[_PipelinePlan]:
+    if strategy is None or strategy.pipeline is None or strategy.pipeline.n_stages <= 1:
+        return None
+    from ..parallel.pipeline import boundary_values, detect_repeats
+
+    pa = strategy.pipeline
+    pre, repeats, post = detect_repeats(graph)
+    staged = {g for g in pa.stage_of}
+    rep_guids = {n.guid for rep in repeats for n in rep}
+    if staged != rep_guids:
+        raise ValueError(
+            "strategy.pipeline.stage_of does not match the graph's detected "
+            f"repeated blocks ({len(staged)} staged vs {len(rep_guids)} detected)"
+        )
+    if len(repeats) % pa.n_stages != 0:
+        raise ValueError(
+            f"{len(repeats)} blocks not divisible into {pa.n_stages} stages"
+        )
+    # verify the assignment is contiguous stage-major (stackable [S, r, ...])
+    r = len(repeats) // pa.n_stages
+    for j, rep in enumerate(repeats):
+        want = j // r
+        for node in rep:
+            if pa.stage_of.get(node.guid) != want:
+                raise ValueError(
+                    f"block {j} node {node} assigned stage "
+                    f"{pa.stage_of.get(node.guid)}, need contiguous stage {want}"
+                )
+    boundary_in, out_src = boundary_values(graph, repeats)
+    last = repeats[-1]
+    pos = next(i for i, n in enumerate(last) if n.guid == out_src[0])
+    return _PipelinePlan(
+        pre=pre,
+        repeats=repeats,
+        post=post,
+        n_stages=pa.n_stages,
+        n_microbatches=pa.n_microbatches,
+        boundary_in=boundary_in,
+        out_src=out_src,
+        out_pos=(pos, out_src[1]),
+    )
+
+
 @dataclasses.dataclass
 class CompiledExecutor:
     """A compiled training/inference program for one PCG + strategy."""
@@ -59,6 +119,7 @@ class CompiledExecutor:
     _train_step: Optional[Callable] = None
     _eval_step: Optional[Callable] = None
     _forward: Optional[Callable] = None
+    _pipeline_plan: Any = None  # _PipelinePlan when the strategy pipelines
 
     # ----------------------------------------------------------- building
     def initialize(self, rng: jax.Array):
@@ -66,6 +127,7 @@ class CompiledExecutor:
         initializer tasks) and build the jitted step functions."""
         import zlib
 
+        self._pipeline_plan = _build_pipeline_plan(self.graph, self.strategy)
         specs = infer_all_specs(self.graph)
         params: Dict[str, Dict[str, jax.Array]] = {}
         state: Dict[str, Dict[str, jax.Array]] = {}
@@ -88,12 +150,72 @@ class CompiledExecutor:
                     params.setdefault(nkey, {})[w.name] = arr
                 else:
                     state.setdefault(nkey, {})[w.name] = arr
+        if self._pipeline_plan is not None:
+            params = self._stack_pipeline_params(params, state)
         self.params = params
         self.state = state
         if self.optimizer is not None:
             self.opt_state = self.optimizer.init_state(params)
         self._build_steps()
         return self
+
+    def _stack_pipeline_params(self, params, state):
+        """Restructure repeat-node params into stacked leaves [S, r, ...]
+        with the stage axis sharded over "pipe" (the executor-side half of
+        parallel/pipeline.py shard_stage_params)."""
+        import numpy as np
+
+        plan = self._pipeline_plan
+        for rep in plan.repeats:
+            for node in rep:
+                if _node_key(node) in state and state[_node_key(node)]:
+                    raise NotImplementedError(
+                        f"pipelined op {node} has non-trainable state; "
+                        "keep stateful ops (batchnorm) outside the block stack"
+                    )
+                # aux losses raised inside the stage scan would be silently
+                # dropped (only pre/post LowerCtx aux is collected)
+                if node.op_type in (OpType.AGGREGATE, OpType.AGGREGATE_SPEC) and getattr(
+                    node.params, "lambda_bal", 0.0
+                ) > 0.0:
+                    raise NotImplementedError(
+                        f"pipelined op {node} emits an aux load-balance loss "
+                        "(lambda_bal > 0), which the GPipe schedule cannot "
+                        "collect; set lambda_bal=0 or keep the MoE layer "
+                        "outside the pipelined block stack"
+                    )
+        S, r = plan.n_stages, len(plan.repeats) // plan.n_stages
+        stacked: Dict[str, Dict[str, jax.Array]] = {}
+        for t, tnode in enumerate(plan.repeats[0]):
+            tkey = _node_key(tnode)
+            names = params.get(tkey, {})
+            if not names:
+                continue
+            stacked[tkey] = {}
+            for wname in names:
+                rows = [
+                    np.asarray(params[_node_key(rep[t])][wname])
+                    for rep in plan.repeats
+                ]
+                arr = jnp.asarray(np.stack(rows).reshape((S, r) + rows[0].shape))
+                if self.mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    from ..parallel.mesh import PIPE_AXIS
+
+                    arr = jax.device_put(
+                        arr,
+                        NamedSharding(
+                            self.mesh,
+                            PartitionSpec(PIPE_AXIS, *([None] * (arr.ndim - 1))),
+                        ),
+                    )
+                stacked[tkey][wname] = arr
+        for rep in plan.repeats:
+            for node in rep:
+                params.pop(_node_key(node), None)
+        params[_PIPE_KEY] = stacked
+        return params
 
     def _place_weight(self, guid: int, name: str, arr: jax.Array) -> jax.Array:
         if self.mesh is None:
@@ -108,6 +230,8 @@ class CompiledExecutor:
         """Interpret the PCG in topological order (the reference's
         FFModel::forward op loop, model.cc:2423 — but traced, not
         dispatched per iteration)."""
+        if self._pipeline_plan is not None:
+            return self._forward_pipelined(params, state, inputs, rng, training)
         values: Dict[Tuple[int, int], jax.Array] = {}
         ctx = LowerCtx(
             training=training,
@@ -134,6 +258,108 @@ class CompiledExecutor:
         new_state = _apply_state_updates(state, ctx.state_updates, self.graph)
         outputs = [values[(g, i)] for g, i in self.outputs]
         return outputs, new_state, ctx.aux_losses
+
+    def _interpret_nodes(self, nodes, values, params, state, rng, training, constrain=True):
+        """Interpret a node subset given pre-seeded boundary values."""
+        ctx = LowerCtx(
+            training=training,
+            rng=rng,
+            backend=self.backend,
+            mesh=self.mesh if constrain else None,
+            seq_length=self.seq_length,
+        )
+        for node in nodes:
+            op_def = get_op_def(node.op_type)
+            nkey = _node_key(node)
+            node_inputs = [values[(e.src, e.src_idx)] for e in self.graph.in_edges(node)]
+            weights = {}
+            weights.update(params.get(nkey, {}))
+            weights.update(state.get(nkey, {}))
+            ctx.node_guid = node.guid
+            outs = op_def.lower(node.params, node_inputs, weights, ctx)
+            for i, o in enumerate(outs):
+                values[(node.guid, i)] = (
+                    self._constrain_output(node.guid, i, o) if constrain else o
+                )
+        return ctx
+
+    def _forward_pipelined(self, params, state, inputs, rng, training):
+        """GPipe execution of the repeated block stack (reference has no
+        pipeline implementation — OP_PIPELINE is a placeholder,
+        ffconst.h:160; this is the TPU-native schedule from
+        parallel/pipeline.py): pre-nodes run under plain GSPMD shardings,
+        the stacked stage params [S, r, ...] rotate activations along the
+        "pipe" mesh axis, post-nodes consume the pipeline output."""
+        from ..parallel.pipeline import gpipe
+
+        plan = self._pipeline_plan
+        values: Dict[Tuple[int, int], jax.Array] = {}
+        for node in plan.pre:
+            if node.op_type == OpType.INPUT:
+                v = inputs[node.params.input_index]
+                values[(node.guid, 0)] = self._constrain_output(node.guid, 0, v)
+        pre_ctx = self._interpret_nodes(
+            [n for n in plan.pre if n.op_type != OpType.INPUT],
+            values, params, state, rng, training,
+        )
+        x = values[plan.boundary_in]
+
+        template = plan.repeats[0]
+        tpl_guids = {n.guid for n in template}
+        tpl_in = {
+            (e.src, e.src_idx)
+            for node in template
+            for e in self.graph.in_edges(node)
+            if e.src not in tpl_guids
+        }
+        (in_src,) = tpl_in
+        # the template's outgoing value, expressed template-locally
+        out_pos = plan.out_pos
+
+        r = len(plan.repeats) // plan.n_stages
+
+        def stage_fn(stage_params, act):
+            # stage_params leaves [r, ...]: scan the stage's blocks.
+            # RNG folds the GLOBAL block index (stage*r + ridx): folding
+            # only ridx would give corresponding blocks of every stage
+            # identical dropout masks
+            from ..parallel.mesh import PIPE_AXIS
+
+            stage_idx = jax.lax.axis_index(PIPE_AXIS)
+
+            def body(carry, rep):
+                rep_params, ridx = rep
+                local = {in_src: carry}
+                ctx = LowerCtx(
+                    training=training,
+                    rng=jax.random.fold_in(rng, stage_idx * r + ridx),
+                    backend=self.backend,
+                    mesh=None,  # inside shard_map: manual, no GSPMD constraints
+                    seq_length=self.seq_length,
+                )
+                for node in template:
+                    op_def = get_op_def(node.op_type)
+                    ins = [local[(e.src, e.src_idx)] for e in self.graph.in_edges(node)]
+                    ctx.node_guid = node.guid
+                    outs = op_def.lower(node.params, ins, rep_params.get(_node_key(node), {}), ctx)
+                    for i, o in enumerate(outs):
+                        local[(node.guid, i)] = o
+                return local[(template[out_pos[0]].guid, out_pos[1])], None
+
+            act, _ = jax.lax.scan(body, act, (stage_params, jnp.arange(r)))
+            return act
+
+        y = gpipe(stage_fn, n_microbatches=plan.n_microbatches, mesh=self.mesh)(
+            params[_PIPE_KEY], x
+        )
+        values[plan.out_src] = y
+        post_ctx = self._interpret_nodes(plan.post, values, params, state, rng, training)
+        aux = pre_ctx.aux_losses + post_ctx.aux_losses
+        updates = dict(pre_ctx.state_updates)
+        updates.update(post_ctx.state_updates)
+        new_state = _apply_state_updates(state, updates, self.graph)
+        outputs = [values[(g, i)] for g, i in self.outputs]
+        return outputs, new_state, aux
 
     def _constrain_output(self, guid: int, idx: int, x: jax.Array) -> jax.Array:
         if self.mesh is None or self.strategy is None:

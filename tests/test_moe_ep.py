@@ -1,0 +1,173 @@
+"""Expert parallelism + batched MoE ops (round-2: VERDICT item 5).
+
+Reference: examples/cpp/mixture_of_experts/moe.cc:180-204 places experts
+on distinct devices via per-op machine views; group_by.cc scatters with
+CUDA kernels. Here: ONE dense-capacity scatter dispatches tokens to a
+stacked [n, cap, D] buffer, the batched ExpertsOp computes all experts
+in one einsum (shard_map-local per device when the mesh has an expert
+axis), and the expert dim shards over the mesh — GSPMD materializes the
+token all_to_all.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+from flexflow_tpu.core.types import OpType
+from flexflow_tpu.models.moe import build_moe_mlp
+from flexflow_tpu.ops.moe_ops import (
+    AggregateOp,
+    AggregateParams,
+    ExpertsOp,
+    ExpertsParams,
+    GroupByOp,
+    GroupByParams,
+    expert_capacity,
+)
+from flexflow_tpu.ops.base import LowerCtx
+from flexflow_tpu.parallel.strategy import expert_parallel_strategy
+
+
+def _ctx():
+    return LowerCtx(training=False, rng=jax.random.key(0), backend="cpu")
+
+
+def test_group_by_stacked_matches_per_expert():
+    rs = np.random.RandomState(0)
+    data = jnp.asarray(rs.randn(16, 8), jnp.float32)
+    assign = jnp.asarray(rs.randint(0, 4, (16, 2)), jnp.int32)
+    per = GroupByOp.lower(GroupByParams(4, 1.5), [data, assign], {}, _ctx())
+    (stacked,) = GroupByOp.lower(GroupByParams(4, 1.5, stacked=True), [data, assign], {}, _ctx())
+    assert stacked.shape[0] == 4
+    for e in range(4):
+        np.testing.assert_array_equal(np.asarray(per[e]), np.asarray(stacked[e]))
+
+
+def test_aggregate_accepts_stacked_input():
+    rs = np.random.RandomState(1)
+    n, cap, d, b, k = 4, 8, 6, 8, 2
+    gate = jnp.asarray(rs.rand(b, k), jnp.float32)
+    assign = jnp.asarray(rs.randint(0, n, (b, k)), jnp.int32)
+    experts = [jnp.asarray(rs.randn(cap, d), jnp.float32) for _ in range(n)]
+    stacked = jnp.stack(experts)
+    p = AggregateParams(n)
+    (out_list,) = AggregateOp.lower(p, [gate, assign] + experts, {}, _ctx())
+    (out_stacked,) = AggregateOp.lower(p, [gate, assign, stacked], {}, _ctx())
+    np.testing.assert_allclose(np.asarray(out_list), np.asarray(out_stacked), rtol=1e-6)
+
+
+def test_batched_moe_matches_per_expert_moe():
+    """Batched ExpertsOp == n separate Dense pairs with identical weights."""
+    config = FFConfig(batch_size=16)
+    kw = dict(in_dim=24, num_classes=4, num_experts=4, num_select=2, expert_hidden=16, lambda_bal=0.0)
+    m_b = build_moe_mlp(config, **kw)
+    m_b.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    # build the per-expert variant manually (models/moe.py default is batched)
+    from flexflow_tpu.model import FFModel
+
+    m2 = FFModel(config)
+    x2 = m2.create_tensor((16, 24), name="input")
+    t2 = m2.moe(x2, 4, 2, 16, alpha=2.0, lambda_bal=0.0, batched=False, name="moe")
+    t2 = m2.dense(t2, 4, name="head")
+    m2.softmax(t2, name="softmax")
+    m2.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    # copy batched weights into the per-expert layout
+    pb, pp = m_b.executor.params, m2.executor.params
+    exp_key = next(k for k in pb if k.startswith("experts"))
+    w1, b1, w2, b2 = (np.asarray(pb[exp_key][n]) for n in ("w1", "b1", "w2", "b2"))
+    # align every shared weight (gate, head) by node name
+    name_of = {}
+    for g, node in m_b.graph.nodes.items():
+        name_of[f"{node.op_type.value}_{g}"] = node.name
+    name_of2 = {}
+    for g, node in m2.graph.nodes.items():
+        name_of2[node.name] = f"{node.op_type.value}_{g}"
+    for key, ws in pb.items():
+        nm = name_of.get(key, "")
+        if nm and name_of2.get(nm) in pp:
+            for wn, arr in ws.items():
+                if pp[name_of2[nm]][wn].shape == arr.shape:
+                    pp[name_of2[nm]][wn] = arr
+    for e in range(4):
+        pp_key = name_of2[f"moe_exp{e}"]
+        pp[pp_key]["kernel"] = jnp.asarray(w1[e])
+        pp[pp_key]["bias"] = jnp.asarray(b1[e])
+        pp_key2 = name_of2[f"moe_exp{e}_out"]
+        pp[pp_key2]["kernel"] = jnp.asarray(w2[e])
+        pp[pp_key2]["bias"] = jnp.asarray(b2[e])
+
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(16, 24), jnp.float32)
+    out_b = np.asarray(m_b.executor.predict([x])[0])
+    out_p = np.asarray(m2.executor.predict([x])[0])
+    np.testing.assert_allclose(out_b, out_p, rtol=1e-5, atol=1e-6)
+
+
+def test_expert_parallel_training_with_sharded_weights():
+    """VERDICT item 5 'done' criterion: MoE trains on the 8-CPU mesh with
+    experts placed; per-device expert weight shards asserted."""
+    config = FFConfig(batch_size=32, workers_per_node=8)
+    m = build_moe_mlp(config, in_dim=32, num_classes=8, num_experts=8, num_select=2, expert_hidden=16)
+    strategy = expert_parallel_strategy(m.graph, dp=2, ep=4)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=strategy,
+    )
+    assert dict(zip(m.mesh.axis_names, m.mesh.devices.shape)) == {"data": 2, "expert": 4}
+    ex = m.executor
+    exp_key = next(k for k in ex.params if k.startswith("experts"))
+    w1 = ex.params[exp_key]["w1"]
+    assert w1.shape == (8, 32, 16)
+    assert w1.sharding.spec[0] == "expert"
+    assert w1.addressable_shards[0].data.shape == (2, 32, 16)  # 8 experts / 4 = 2 per device
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(32, 32), jnp.float32)
+    y = jnp.asarray(rs.randint(0, 8, (32,)), jnp.int32)
+    losses = [float(ex.train_batch([x], y, jax.random.key(0))["loss"]) for _ in range(5)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_unity_strategy_from_pcg_emits_expert_axis():
+    from flexflow_tpu.search.unity import strategy_from_pcg
+
+    config = FFConfig(batch_size=32, workers_per_node=8)
+    m = build_moe_mlp(config, in_dim=32, num_classes=8, num_experts=8, num_select=2, expert_hidden=16)
+    strategy = strategy_from_pcg(m.graph, {}, num_devices=8)
+    exp_node = next(n for n in m.graph.topo_order() if n.op_type == OpType.EXPERTS)
+    ws = strategy.node_shardings[exp_node.guid].weights
+    assert ws["w1"] is not None and ws["w1"][0] == ("model",), ws
+    outs = strategy.node_shardings[exp_node.guid].outputs
+    assert outs[0] is not None and outs[0][0] == ("model",)
+
+
+def test_aggregate_spec_semantics():
+    """AggregateSpec outputs per-(token, k) expert rows [B*K, D] and its
+    gate gradient follows the reference's hand-crafted rule
+    (aggregate_spec.cu:64-127), not the forward transpose."""
+    from flexflow_tpu.ops.moe_ops import AggregateSpecOp, AggregateSpecParams
+
+    rs = np.random.RandomState(3)
+    n, cap, d, b, k = 4, 6, 5, 6, 2
+    gate = jnp.asarray(rs.rand(b, k), jnp.float32)
+    assign = jnp.asarray(rs.randint(0, n, (b, k)), jnp.int32)
+    stacked = jnp.asarray(rs.randn(n, cap, d), jnp.float32)
+    p = AggregateSpecParams(n, lambda_bal=0.01)
+
+    def f(gate, stacked):
+        (out,) = AggregateSpecOp.lower(p, [gate, assign, stacked], {}, _ctx())
+        return jnp.sum(out**2), out
+
+    (loss, out), grads = jax.value_and_grad(f, argnums=(0, 1), has_aux=True)(gate, stacked)
+    assert out.shape == (b * k, d)
+    g_gate, g_exp = grads
+    assert g_gate.shape == (b, k) and np.all(np.isfinite(np.asarray(g_gate)))
+    assert g_exp.shape == stacked.shape and np.any(np.asarray(g_exp) != 0)
+    # forward ignores gate numerically, yet gate still receives the
+    # speculative-routing gradient — the defining property of the spec op
+    (out2,) = AggregateSpecOp.lower(p, [gate * 2.0, assign, stacked], {}, _ctx())
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    assert np.any(np.asarray(g_gate) != 0)
