@@ -1,35 +1,104 @@
-"""Benchmark driver: BERT training throughput on the available TPU.
+"""Benchmark driver: BERT training throughput, searched strategy vs data-parallel.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-The reference publishes no absolute numbers (BASELINE.md) — its story is
-searched-strategy vs data-parallel on identical hardware. Single-chip,
-we report training throughput and MFU; vs_baseline is MFU relative to
-the 45%-MFU north star from BASELINE.json.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+The reference's headline is searched-strategy vs data-parallel on identical
+hardware (scripts/osdi22ae/bert.sh); we report both MFUs.  vs_baseline is
+the searched MFU relative to the 45%-MFU north star from BASELINE.json.
 
-Measurement notes for the tunneled chip ("axon"): jax.block_until_ready
-does not reliably block through the tunnel, so every flush is a scalar
-readback (float(loss)), and steady state is measured over a long chained
-run after two warmup+flush rounds (the first absorbs trace+XLA compile,
-the second any lazy backend recompilation).
+Resilience (round-1 failure mode: the tunneled 'axon' TPU backend errored
+at init and the bench died with no JSON, BENCH_r01.json rc=1): the parent
+process re-execs the actual benchmark as a child with retry + backoff; if
+the TPU never comes up it falls back to CPU so a parseable JSON line is
+always produced.
+
+Peak FLOPs are derived from the detected chip (device_kind), not
+hardcoded (round-1 weakness: v5e 197e12 was assumed).
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+_CHILD_ENV = "_FF_BENCH_CHILD"
 
-def main():
+# (device_kind substring, peak bf16 FLOP/s per jax device), most specific first.
+# v2/v3 expose one core per jax device; v4+ one (mega)chip per device.
+_PEAK_BF16 = [
+    ("v6e", 918e12),
+    ("v6 lite", 918e12),
+    ("v6", 918e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 61.25e12),
+    ("v2", 22.5e12),
+]
+
+
+def peak_flops_per_device(device_kind: str, backend: str) -> float:
+    kind = device_kind.lower()
+    if backend == "cpu":
+        return 1e12  # nominal; CPU MFU is not meaningful
+    for sub, peak in _PEAK_BF16:
+        if sub in kind:
+            return peak
+    return 197e12  # unknown TPU: conservative default
+
+
+def _bench_one(ex, batch, cfg, iters):
+    """Measure steady-state step time of a compiled executor.
+
+    jax.block_until_ready does not reliably block through the axon
+    tunnel, so every flush is a scalar readback (float(loss)); steady
+    state is a long chained run after two warmup+flush rounds.
+    """
     import jax
     import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(batch, cfg.seq_length, cfg.hidden_size), cfg.dtype.jnp)
+    y = jnp.asarray(rs.randn(batch, cfg.seq_length, cfg.hidden_size), cfg.dtype.jnp)
+    rng = jax.random.key(0)
+    mets = ex.train_batch([x], y, rng)  # trace + compile + first run
+    float(mets["loss"])
+    for _ in range(3):  # absorb lazy recompilation
+        mets = ex.train_batch([x], y, rng)
+    float(mets["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        mets = ex.train_batch([x], y, rng)
+    float(mets["loss"])  # single device->host readback flushes the chain
+    dt = time.perf_counter() - t0
+    return dt / iters
+
+
+def child_main():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the hosted-TPU sitecustomize force-selects its platform via
+        # jax.config.update, overriding the env var — override it back
+        # before any backend initializes (same trick as tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
 
     from flexflow_tpu import DataType, FFConfig, LossType, SGDOptimizer
     from flexflow_tpu.models import TransformerConfig, build_transformer
 
     backend = jax.default_backend()
-    n_dev = len(jax.devices())
-    # BERT-Base-shaped encoder, bf16 activations
+    devs = jax.devices()
+    n_dev = len(devs)
+    kind = getattr(devs[0], "device_kind", backend)
+    peak = peak_flops_per_device(kind, backend) * n_dev
+
+    # BERT-Base-shaped encoder, bf16 activations (flash attention on TPU)
     cfg = TransformerConfig(
         num_layers=12,
         hidden_size=768,
@@ -39,55 +108,166 @@ def main():
         dtype=DataType.BFLOAT16,
     )
     batch = 16 * n_dev
-    config = FFConfig(batch_size=batch)
-    model = build_transformer(config, cfg)
-    model.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=LossType.MEAN_SQUARED_ERROR)
-    ex = model.executor
+    iters = 40 if backend != "cpu" else 3
+    if backend == "cpu":  # keep the fallback path fast enough to finish
+        cfg = TransformerConfig(
+            num_layers=4, hidden_size=256, num_heads=4, ff_size=1024,
+            seq_length=128, dtype=DataType.BFLOAT16,
+        )
+        batch = 4 * n_dev
 
-    rs = np.random.RandomState(0)
-    x = jnp.asarray(rs.randn(batch, cfg.seq_length, cfg.hidden_size), cfg.dtype.jnp)
-    y = jnp.asarray(rs.randn(batch, cfg.seq_length, cfg.hidden_size), cfg.dtype.jnp)
-    rng = jax.random.key(0)
+    def build(only_dp: bool, budget: int):
+        config = FFConfig(
+            batch_size=batch,
+            workers_per_node=n_dev,
+            num_nodes=1,
+            only_data_parallel=only_dp,
+            search_budget=budget,
+        )
+        model = build_transformer(config, cfg)
+        model.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=LossType.MEAN_SQUARED_ERROR)
+        return model
 
-    # warmup round 1: trace + compile + first execution
-    mets = ex.train_batch([x], y, rng)
-    float(mets["loss"])
-    # warmup round 2: absorb any lazily-triggered recompilation
-    for _ in range(3):
-        mets = ex.train_batch([x], y, rng)
-    float(mets["loss"])
+    model_dp = build(only_dp=True, budget=0)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(model_dp.executor.params))
+    flops_per_token = 6.0 * n_params
+    step_dp = _bench_one(model_dp.executor, batch, cfg, iters)
 
-    iters = 40 if backend != "cpu" else 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        mets = ex.train_batch([x], y, rng)
-    float(mets["loss"])  # single device->host readback flushes the chain
-    dt = time.perf_counter() - t0
-    step_ms = dt * 1e3 / iters
+    # simulator validation (VERDICT r1 weakness 4): predicted vs measured
+    sim_dp_ratio = None
+    try:
+        from flexflow_tpu.search.unity import predict_step_time
 
-    samples_per_s = iters * batch / dt
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(ex.params))
-    tokens_per_s = samples_per_s * cfg.seq_length
-    train_flops_per_token = 6.0 * n_params
-    achieved_flops = tokens_per_s * train_flops_per_token
-    peak = 197e12 * n_dev if backend != "cpu" else 1e12  # v5e bf16 peak per chip
-    mfu = achieved_flops / peak
+        pred_dp = predict_step_time(model_dp.graph, model_dp.config)
+        sim_dp_ratio = round(pred_dp / step_dp, 3)
+    except Exception as e:
+        print(f"simulator prediction failed: {e!r}", file=sys.stderr)
+        pred_dp = None
+
+    t_search = time.perf_counter()
+    step_s = sim_s_ratio = None
+    try:
+        model_s = build(only_dp=False, budget=5)
+        search_s = time.perf_counter() - t_search
+        step_s = _bench_one(model_s.executor, batch, cfg, iters)
+        sr = getattr(model_s, "_search_result", None)
+        if sr is not None and sr.best_cost > 0:
+            sim_s_ratio = round(sr.best_cost / step_s, 3)
+    except Exception as e:  # searched path must never kill the bench
+        search_s = time.perf_counter() - t_search
+        print(f"searched-strategy bench failed: {e!r}", file=sys.stderr)
+
+    def mfu(step):
+        if step is None:
+            return None
+        toks = batch * cfg.seq_length / step
+        return round(toks * flops_per_token / peak, 4)
+
+    # headline value and MFU describe the SAME configuration: the
+    # searched strategy when it benched, else data-parallel
+    headline_step = step_s if step_s is not None else step_dp
+    samples_per_s = batch / headline_step
+    dp_mfu, searched_mfu = mfu(step_dp), mfu(step_s)
+    headline = mfu(headline_step)
     result = {
         "metric": "bert_base_seq128_train_throughput",
         "value": round(samples_per_s, 2),
         "unit": "samples/s",
-        "vs_baseline": round(mfu / 0.45, 4),
+        "vs_baseline": round(headline / 0.45, 4),
         "extra": {
             "backend": backend,
+            "device_kind": kind,
             "devices": n_dev,
             "batch": batch,
             "params": n_params,
-            "step_ms": round(step_ms, 2),
-            "mfu": round(mfu, 4),
+            "peak_flops": peak,
+            "dp_step_ms": round(step_dp * 1e3, 2),
+            "searched_step_ms": round(step_s * 1e3, 2) if step_s is not None else None,
+            "dp_mfu": dp_mfu,
+            "searched_mfu": searched_mfu,
+            "mfu": headline,
+            "search_s": round(search_s, 1),
+            "sim_pred_over_measured_dp": sim_dp_ratio,
+            "sim_pred_over_measured_searched": sim_s_ratio,
         },
     }
     print(json.dumps(result))
 
 
+def _run_child(args, extra_env=None, timeout=None):
+    env = dict(os.environ)
+    env[_CHILD_ENV] = "1"
+    env.update(extra_env or {})
+    try:
+        proc = subprocess.run(
+            [sys.executable] + args,
+            env=env,
+            capture_output=True,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timed out after {timeout}s"
+    sys.stderr.write(proc.stderr[-4000:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict) and "metric" in obj:
+                return obj, None
+        except json.JSONDecodeError:
+            continue
+    tail = (proc.stderr or proc.stdout or "")[-800:]
+    return None, f"rc={proc.returncode}: {tail}"
+
+
+_PROBE = (
+    "import jax, json; d = jax.devices(); "
+    "print(json.dumps({'metric': 'probe', 'backend': jax.default_backend(), 'n': len(d)}))"
+)
+
+
+def main():
+    me = os.path.abspath(__file__)
+    errors = []
+    tpu_ok = False
+    # Backend init over the tunnel can hang, not just error (round-1 it
+    # errored; this session it hangs) — probe it in a killable child first.
+    for delay in (0, 5, 15, 30):
+        if delay:
+            time.sleep(delay)
+        obj, err = _run_child(["-c", _PROBE], timeout=90)
+        if obj is not None:
+            tpu_ok = obj.get("backend") != "cpu"
+            break
+        errors.append(f"probe: {err}")
+    if tpu_ok:
+        obj, err = _run_child([me], timeout=1800)
+        if obj is not None:
+            print(json.dumps(obj))
+            return
+        errors.append(f"bench: {err}")
+    # TPU never came up (or bench died on it): CPU fallback so the
+    # driver still gets a parseable number
+    obj, err = _run_child([me], {"JAX_PLATFORMS": "cpu"}, timeout=1800)
+    if obj is not None:
+        if errors:
+            obj.setdefault("extra", {})["fallback"] = "cpu_after_tpu_failure"
+            obj["extra"]["tpu_errors"] = [e[-200:] for e in errors]
+        print(json.dumps(obj))
+        return
+    errors.append(f"cpu: {err}")
+    print(json.dumps({
+        "metric": "bert_base_seq128_train_throughput",
+        "value": 0.0,
+        "unit": "samples/s",
+        "vs_baseline": 0.0,
+        "extra": {"error": (errors[-1] or "unknown")[-500:], "attempts": len(errors)},
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get(_CHILD_ENV) == "1":
+        child_main()
+    else:
+        main()

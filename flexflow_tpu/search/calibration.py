@@ -1,0 +1,315 @@
+"""On-device op-cost calibration for the search's cost model.
+
+Reference: the reference times every candidate op on the real device
+(Op::measure_operator_cost via inner_measure_operator_cost,
+include/flexflow/operator.h:127) and caches the result keyed by op
+params + machine view (src/runtime/simulator.cc:588-628).
+
+TPU-native twist (SURVEY §7 hard part 1): XLA fuses aggressively, so a
+per-op wall-clock microbenchmark taken in isolation over-charges fusion
+boundaries. The primary calibration is therefore *class-level*: a small
+suite of representative ops is timed once per device kind, the ratio
+measured/analytic-roofline becomes a derate for that op class
+(matmul-bound vs memory-bound), and exact per-op measurements are layered
+on top when `measure` mode is on. Everything persists to an on-disk JSON
+cache keyed by device kind, with factory tables committed under
+``calibration_data/`` so searches on known chips are calibrated without
+ever touching the device.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.tensor import TensorSpec
+from ..core.types import DataType, OpType
+from ..ops.base import get_op_def
+from ..parallel.machine import MachineSpec, TPUChipSpec
+
+# op classes for derate sharing: FLOPs-dominated ops ride the MXU,
+# everything else is HBM-bandwidth-bound
+MATMUL_OPS = frozenset(
+    {
+        OpType.LINEAR,
+        OpType.BATCH_MATMUL,
+        OpType.MULTIHEAD_ATTENTION,
+        OpType.CONV2D,
+    }
+)
+
+
+def op_class(op_type: OpType) -> str:
+    return "matmul" if op_type in MATMUL_OPS else "memory"
+
+
+def cost_key(op_type: OpType, params, input_specs: Sequence[TensorSpec], n_parts: int) -> str:
+    shapes = ";".join(f"{tuple(s.shape)}:{s.dtype.name}" for s in input_specs)
+    return f"{op_type.name}|{params!r}|{shapes}|{n_parts}"
+
+
+@dataclasses.dataclass
+class Calibration:
+    """Measured timing data for one device kind."""
+
+    device_kind: str = "analytic"
+    # class -> multiplier applied to the analytic roofline time
+    # (>1 = device slower than roofline; seeded at 1.0 = trust roofline)
+    derates: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # exact measured seconds per op signature (reference: the
+    # hash_to_operator_cost cache, simulator.cc:588-628)
+    entries: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    def derate(self, op_type: OpType) -> float:
+        return self.derates.get(op_class(op_type), 1.0)
+
+    def lookup(self, op_type: OpType, params, input_specs, n_parts: int) -> Optional[float]:
+        return self.entries.get(cost_key(op_type, params, input_specs, n_parts))
+
+    # ----------------------------------------------------------- persist
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=1, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Calibration":
+        d = json.loads(text)
+        return cls(
+            device_kind=d.get("device_kind", "analytic"),
+            derates=dict(d.get("derates", {})),
+            entries=dict(d.get("entries", {})),
+        )
+
+    def save(self, path: Optional[Path] = None) -> Path:
+        path = path or (cache_dir() / f"opcosts_{_slug(self.device_kind)}.json")
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(self.to_json())
+        tmp.replace(path)
+        return path
+
+
+def _slug(kind: str) -> str:
+    return "".join(c if c.isalnum() else "_" for c in kind.lower()).strip("_") or "unknown"
+
+
+def cache_dir() -> Path:
+    env = os.environ.get("FLEXFLOW_TPU_CACHE")
+    if env:
+        return Path(env)
+    return Path(os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache")) / "flexflow_tpu"
+
+
+_DATA_DIR = Path(__file__).parent / "calibration_data"
+
+
+def load_calibration(device_kind: str) -> Optional[Calibration]:
+    """User cache first, then the committed factory table."""
+    for base in (cache_dir(), _DATA_DIR):
+        p = base / f"opcosts_{_slug(device_kind)}.json"
+        if p.exists():
+            try:
+                return Calibration.from_json(p.read_text())
+            except (json.JSONDecodeError, OSError):
+                continue
+    return None
+
+
+# ---------------------------------------------------------------------------
+# measurement
+# ---------------------------------------------------------------------------
+
+
+def measure_lowered_op(
+    op_type: OpType,
+    params,
+    input_specs: Sequence[TensorSpec],
+    n_parts: int = 1,
+    reps: int = 10,
+) -> Optional[float]:
+    """Jit one shard of the op's lowering on the default device and time
+    it (the reference's inner_measure_operator_cost, operator.h:127).
+
+    The flush is a scalar readback: jax.block_until_ready is unreliable
+    through the tunneled-TPU transport.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops.base import LowerCtx
+
+        op_def = get_op_def(op_type)
+        shard_specs = []
+        for i, s in enumerate(input_specs):
+            shape = list(s.shape)
+            if i == 0 and shape and shape[0] % n_parts == 0:
+                shape[0] //= n_parts
+            shard_specs.append(TensorSpec(tuple(shape), s.dtype))
+        rs = np.random.RandomState(0)
+        args = [jnp.asarray(rs.randn(*s.shape), s.dtype.jnp) for s in shard_specs]
+        wspecs = op_def.weight_specs(params, shard_specs)
+        weights = {
+            w.name: jnp.asarray(rs.randn(*w.spec.shape) * 0.02, w.spec.dtype.jnp)
+            for w in wspecs
+        }
+        backend = jax.default_backend()
+
+        def fn(inputs, weights):
+            ctx = LowerCtx(training=False, rng=jax.random.key(0), backend=backend)
+            outs = op_def.lower(params, inputs, weights, ctx)
+            return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+
+        jitted = jax.jit(fn)
+        float(jitted(args, weights))  # compile + first run
+        float(jitted(args, weights))
+        t0 = time.perf_counter()
+        acc = None
+        for _ in range(reps):
+            acc = jitted(args, weights)
+        float(acc)
+        return (time.perf_counter() - t0) / reps
+    except Exception:
+        return None
+
+
+def default_suite(dtype: DataType = DataType.BFLOAT16) -> List[Tuple[OpType, object, List[TensorSpec]]]:
+    """Representative (op, params, inputs) covering both op classes at
+    MXU-friendly sizes (the shapes BERT-class models actually run)."""
+    from ..ops.attention import MultiHeadAttentionParams
+    from ..ops.batch_matmul import BatchMatmulParams
+    from ..ops.elementwise import ElementUnaryParams
+    from ..ops.linear import LinearParams
+    from ..ops.norm import LayerNormParams
+    from ..ops.softmax import SoftmaxParams
+
+    B, S, H, F = 16, 128, 768, 3072
+    x = TensorSpec((B * S, H), dtype)
+    seq = TensorSpec((B, S, H), dtype)
+    return [
+        (OpType.LINEAR, LinearParams(out_dim=F, use_bias=True, dtype=dtype), [x]),
+        (OpType.LINEAR, LinearParams(out_dim=H, use_bias=True, dtype=dtype), [TensorSpec((B * S, F), dtype)]),
+        (
+            OpType.BATCH_MATMUL,
+            BatchMatmulParams(),
+            [TensorSpec((B * 12, S, 64), dtype), TensorSpec((B * 12, 64, S), dtype)],
+        ),
+        (
+            OpType.MULTIHEAD_ATTENTION,
+            MultiHeadAttentionParams(embed_dim=H, num_heads=12, dtype=dtype),
+            [seq, seq, seq],
+        ),
+        (OpType.LAYERNORM, LayerNormParams(axes=(2,), dtype=dtype), [seq]),
+        (OpType.SOFTMAX, SoftmaxParams(axis=-1), [TensorSpec((B * 12, S, S), dtype)]),
+        (OpType.RELU, ElementUnaryParams(op=OpType.RELU), [TensorSpec((B * S, F), dtype)]),
+        (OpType.GELU, ElementUnaryParams(op=OpType.GELU), [TensorSpec((B * S, F), dtype)]),
+    ]
+
+
+def calibrate(
+    machine: Optional[MachineSpec] = None,
+    device_kind: Optional[str] = None,
+    suite: Optional[Sequence] = None,
+    save: bool = True,
+) -> Calibration:
+    """Run the calibration suite on the current default device and derive
+    per-class derates (measured / analytic roofline). Ratios are combined
+    per class by geometric mean; exact measurements are kept as entries."""
+    import numpy as np
+
+    from .cost_model import CostModel
+
+    if device_kind is None:
+        try:
+            import jax
+
+            device_kind = getattr(jax.devices()[0], "device_kind", jax.default_backend())
+        except Exception:
+            device_kind = "unknown"
+    machine = machine or MachineSpec(num_nodes=1, devices_per_node=1, chip=chip_spec_for(device_kind))
+    base = CostModel(machine)  # uncalibrated roofline
+    cal = Calibration(device_kind=device_kind)
+    ratios: Dict[str, List[float]] = {}
+    for op_type, params, specs in suite or default_suite():
+        op_def = get_op_def(op_type)
+        out_specs = op_def.infer_output_specs(params, list(specs))
+        analytic = base._roofline_time(
+            *_work_of(op_def, params, specs, out_specs), specs[0].dtype
+        )
+        measured = measure_lowered_op(op_type, params, specs)
+        if measured is None or analytic <= 0:
+            continue
+        cal.entries[cost_key(op_type, params, specs, 1)] = measured
+        ratios.setdefault(op_class(op_type), []).append(measured / analytic)
+    for cls_name, rs in ratios.items():
+        cal.derates[cls_name] = float(np.exp(np.mean(np.log(rs))))
+    if save and cal.entries:
+        cal.save()
+    return cal
+
+
+def _work_of(op_def, params, input_specs, output_specs) -> Tuple[float, float]:
+    c = op_def.cost(params, list(input_specs), list(output_specs))
+    return c.flops, c.bytes_accessed
+
+
+def load_or_calibrate(
+    machine: Optional[MachineSpec] = None,
+    allow_measure: bool = False,
+) -> Calibration:
+    """Resolution order: on-disk cache -> committed factory table ->
+    live calibration (only when allow_measure) -> analytic default."""
+    device_kind = "analytic"
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        if backend != "cpu":
+            device_kind = getattr(jax.devices()[0], "device_kind", backend)
+    except Exception:
+        pass
+    if device_kind == "analytic":
+        return Calibration()
+    hit = load_calibration(device_kind)
+    if hit is not None:
+        return hit
+    if allow_measure:
+        return calibrate(machine, device_kind=device_kind)
+    return Calibration(device_kind=device_kind)
+
+
+# ---------------------------------------------------------------------------
+# chip presets (peak numbers for detected hardware; bench + cost model)
+# ---------------------------------------------------------------------------
+
+_CHIP_PRESETS = {
+    "v2": TPUChipSpec(name="v2", bf16_flops=22.5e12, f32_flops=22.5e12, hbm_bandwidth=0.35e12, hbm_capacity=8e9, ici_bandwidth=62.5e9, ici_links=4),
+    "v3": TPUChipSpec(name="v3", bf16_flops=61.25e12, f32_flops=61.25e12, hbm_bandwidth=0.45e12, hbm_capacity=16e9, ici_bandwidth=81.25e9, ici_links=4),
+    "v4": TPUChipSpec(name="v4", bf16_flops=275e12, f32_flops=137e12, hbm_bandwidth=1.23e12, hbm_capacity=32e9, ici_bandwidth=112.5e9, ici_links=6),
+    "v5e": TPUChipSpec(name="v5e", bf16_flops=197e12, f32_flops=98.5e12, hbm_bandwidth=0.82e12, hbm_capacity=16e9, ici_bandwidth=56.25e9, ici_links=4),
+    "v5p": TPUChipSpec(name="v5p", bf16_flops=459e12, f32_flops=115e12, hbm_bandwidth=2.76e12, hbm_capacity=95e9, ici_bandwidth=100e9, ici_links=6),
+    "v6e": TPUChipSpec(name="v6e", bf16_flops=918e12, f32_flops=459e12, hbm_bandwidth=1.64e12, hbm_capacity=32e9, ici_bandwidth=112.5e9, ici_links=4),
+}
+
+
+def chip_spec_for(device_kind: str) -> TPUChipSpec:
+    kind = device_kind.lower()
+    for sub, spec in (
+        ("v6e", _CHIP_PRESETS["v6e"]),
+        ("v6 lite", _CHIP_PRESETS["v6e"]),
+        ("v6", _CHIP_PRESETS["v6e"]),
+        ("v5e", _CHIP_PRESETS["v5e"]),
+        ("v5 lite", _CHIP_PRESETS["v5e"]),
+        ("v5litepod", _CHIP_PRESETS["v5e"]),
+        ("v5p", _CHIP_PRESETS["v5p"]),
+        ("v5", _CHIP_PRESETS["v5p"]),
+        ("v4", _CHIP_PRESETS["v4"]),
+        ("v3", _CHIP_PRESETS["v3"]),
+        ("v2", _CHIP_PRESETS["v2"]),
+    ):
+        if sub in kind:
+            return spec
+    return TPUChipSpec()

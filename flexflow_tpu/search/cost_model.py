@@ -50,12 +50,26 @@ class CostMetrics:
 
 
 class CostModel:
-    """Analytic (optionally calibrated) op + collective cost model."""
+    """Analytic (optionally calibrated) op + collective cost model.
 
-    def __init__(self, machine: Optional[MachineSpec] = None, measure: bool = False):
+    ``calibration`` supplies per-class derates and exact measured op
+    times from search/calibration.py; ``measure`` additionally times any
+    op the calibration has no entry for, live on the default device, and
+    writes the result through to the on-disk cache.
+    """
+
+    def __init__(
+        self,
+        machine: Optional[MachineSpec] = None,
+        measure: bool = False,
+        calibration=None,
+    ):
+        from .calibration import Calibration
+
         self.machine = machine or MachineSpec()
         self.chip = self.machine.chip
         self.measure = measure
+        self.calibration = calibration if calibration is not None else Calibration()
         # cache: (op_type, params, shard shapes) -> CostMetrics
         # (reference: hash_to_operator_cost, simulator.cc:588-628)
         self._cache: Dict[Tuple, CostMetrics] = {}
@@ -87,8 +101,11 @@ class CostModel:
         flops = cost.flops / max(1, n_parts)
         bytes_hbm = cost.bytes_accessed / max(1, n_parts)
         dtype = input_specs[0].dtype if input_specs else DataType.FLOAT
-        fwd = self._roofline_time(flops, bytes_hbm, dtype)
-        if self.measure:
+        fwd = self._roofline_time(flops, bytes_hbm, dtype) * self.calibration.derate(op_type)
+        calibrated = self.calibration.lookup(op_type, params, input_specs, n_parts)
+        if calibrated is not None:
+            fwd = calibrated
+        elif self.measure:
             measured = self._try_measure(op_type, params, input_specs, n_parts)
             if measured is not None:
                 fwd = measured
@@ -110,50 +127,24 @@ class CostModel:
         return max(t_compute, t_memory) + KERNEL_OVERHEAD
 
     def _try_measure(self, op_type, params, input_specs, n_parts) -> Optional[float]:
-        """Measured calibration: jit the op's lowering on one device and
-        time it (the reference's inner_measure_operator_cost on TPU)."""
+        """Measured calibration: jit the op's lowering on the default
+        device and time it (the reference's inner_measure_operator_cost
+        on TPU); the result is written through to the on-disk cache."""
         key = (op_type, params, tuple((s.shape, s.dtype) for s in input_specs), n_parts)
         if key in self._measure_cache:
             return self._measure_cache[key]
-        try:
-            import time
+        from .calibration import cost_key, measure_lowered_op
 
-            import jax
-            import jax.numpy as jnp
-            import numpy as np
-
-            from ..ops.base import LowerCtx
-
-            op_def = get_op_def(op_type)
-            shard_specs = []
-            for i, s in enumerate(input_specs):
-                shape = list(s.shape)
-                if i == 0 and shape and shape[0] % n_parts == 0:
-                    shape[0] //= n_parts
-                shard_specs.append(TensorSpec(tuple(shape), s.dtype))
-            rs = np.random.RandomState(0)
-            args = [jnp.asarray(rs.randn(*s.shape), s.dtype.jnp) for s in shard_specs]
-            wspecs = op_def.weight_specs(params, shard_specs)
-            weights = {w.name: jnp.asarray(rs.randn(*w.spec.shape), w.spec.dtype.jnp) for w in wspecs}
-
-            def fn(inputs, weights):
-                ctx = LowerCtx(training=False, rng=jax.random.key(0), backend="cpu")
-                return op_def.lower(params, inputs, weights, ctx)
-
-            jitted = jax.jit(fn)
-            out = jitted(args, weights)
-            jax.block_until_ready(out)
-            reps = 5
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                out = jitted(args, weights)
-            jax.block_until_ready(out)
-            t = (time.perf_counter() - t0) / reps
-            self._measure_cache[key] = t
-            return t
-        except Exception:
-            self._measure_cache[key] = None  # type: ignore
-            return None
+        t = measure_lowered_op(op_type, params, input_specs, n_parts)
+        self._measure_cache[key] = t  # type: ignore
+        if t is not None:
+            self.calibration.entries[cost_key(op_type, params, input_specs, n_parts)] = t
+            if self.calibration.device_kind != "analytic":
+                try:
+                    self.calibration.save()
+                except OSError:
+                    pass
+        return t
 
     # ------------------------------------------------------- comm costs
     def link_bandwidth(self, intra_node: bool) -> float:

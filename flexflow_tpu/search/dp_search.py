@@ -60,23 +60,41 @@ class SearchHelper:
         cost_model: Optional[CostModel] = None,
         simulator: Optional[Simulator] = None,
         max_parallel_degree: Optional[int] = None,
+        enable_2d_views: bool = False,
     ):
         self.machine = machine or MachineSpec()
         self.cost_model = cost_model or CostModel(self.machine)
         self.simulator = simulator or Simulator(self.machine, self.cost_model)
         self.max_degree = max_parallel_degree or self.machine.num_devices
+        self.enable_2d_views = enable_2d_views
         self._memo: Dict[Tuple[int, MachineResource], DPResult] = {}
 
     # ------------------------------------------------------------- views
-    def candidate_views(self, resource: MachineResource, batch_limit: int = 0) -> List[MachineView]:
-        """1-D power-of-two runs inside the resource (reference:
-        get_valid_machine_views; restricted per SURVEY §7)."""
+    def candidate_views(
+        self, resource: MachineResource, batch_limit: int = 0, attr_limit: int = 0
+    ) -> List[MachineView]:
+        """1-D power-of-two runs plus (when enabled) 2-D sample x attribute
+        tiles inside the resource (reference enumerates 1-D AND 2-D device
+        grids: register_all_machine_views, model.h:671 — round-1 gap #2).
+        ``attr_limit`` bounds the second dim (it must divide a spatial
+        extent); 0 disables 2-D views."""
         out = []
         k = 1
         while k <= resource.size and k <= self.max_degree:
             if not batch_limit or batch_limit % k == 0:
                 out.append(MachineView(resource.start, (k,), (1,)))
             k *= 2
+        if self.enable_2d_views and attr_limit > 0:
+            a = 1
+            while a <= resource.size:
+                if not batch_limit or batch_limit % a == 0:
+                    b = 2
+                    while a * b <= resource.size and a * b <= self.max_degree:
+                        if attr_limit % b == 0:
+                            # row-major tile: sample axis strides over b-runs
+                            out.append(MachineView(resource.start, (a, b), (b, 1)))
+                        b *= 2
+                a *= 2
         return out or [MachineView(resource.start, (1,), (1,))]
 
     # -------------------------------------------------------------- cost
@@ -218,8 +236,17 @@ class SearchHelper:
             if n.op_type == OpType.INPUT:
                 batch = specs["out"][n.guid][0].shape[0] if specs["out"][n.guid][0].shape else 0
                 break
+        # attribute-parallel second view dim: gcd of the H extents of all
+        # 4-D activations (NCHW); 0 when the subgraph has none
+        attr = 0
+        for n in graph.topo_order():
+            if n.op_type in (OpType.INPUT, OpType.WEIGHT, OpType.NOOP):
+                continue
+            for s in specs["out"][n.guid]:
+                if s.ndim == 4:
+                    attr = s.shape[2] if attr == 0 else math.gcd(attr, s.shape[2])
         best: Optional[DPResult] = None
-        for view in self.candidate_views(resource, batch_limit=batch):
+        for view in self.candidate_views(resource, batch_limit=batch, attr_limit=attr):
             total_t = 0.0
             total_mem = 0.0
             views: Dict[int, MachineView] = {}

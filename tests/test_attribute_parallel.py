@@ -1,0 +1,138 @@
+"""Attribute parallelism + 2-D machine views (round-2: VERDICT items 3/6).
+
+Reference: spatial-dim partitioning of conv/pool via
+create_mapping_xfers<Conv2D/Pool2D> (substitution.cc:1797-1800), machine
+views enumerated as 1-D AND 2-D device grids
+(register_all_machine_views, model.h:671).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+from flexflow_tpu.core.types import ActiMode, OpType
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.ops.parallel_ops import CombineParams, RepartitionParams
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.search.dp_search import MachineResource, SearchHelper
+from flexflow_tpu.search.unity import strategy_from_pcg
+
+
+def _conv_net(batch=4, workers=8, **cfg_kw):
+    config = FFConfig(batch_size=batch, workers_per_node=workers, **cfg_kw)
+    m = FFModel(config)
+    x = m.create_tensor((batch, 3, 32, 32), name="image")
+    t = m.conv2d(x, 16, 3, 3, 1, 1, 1, 1, ActiMode.RELU, name="conv1")
+    t = m.pool2d(t, 2, 2, 2, 2, 0, 0, name="pool1")
+    t = m.conv2d(t, 32, 3, 3, 1, 1, 1, 1, ActiMode.RELU, name="conv2")
+    t = m.flat(t, name="flat")
+    t = m.dense(t, 10, name="fc")
+    m.softmax(t, name="sm")
+    return m
+
+
+def test_candidate_views_include_2d_tiles():
+    helper = SearchHelper(MachineSpec(num_nodes=1, devices_per_node=8), enable_2d_views=True)
+    views = helper.candidate_views(MachineResource(0, 8), batch_limit=4, attr_limit=32)
+    dims = {v.dims for v in views}
+    assert (4,) in dims and (1,) in dims
+    assert (4, 2) in dims, dims  # sample x attribute tile
+    assert (2, 4) in dims, dims
+    # 1-D only when disabled
+    helper1 = SearchHelper(MachineSpec(num_nodes=1, devices_per_node=8))
+    views1 = helper1.candidate_views(MachineResource(0, 8), batch_limit=4, attr_limit=32)
+    assert all(len(v.dims) == 1 for v in views1)
+
+
+def test_2d_views_respect_attr_limit():
+    helper = SearchHelper(MachineSpec(num_nodes=1, devices_per_node=8), enable_2d_views=True)
+    views = helper.candidate_views(MachineResource(0, 8), batch_limit=8, attr_limit=0)
+    assert all(len(v.dims) == 1 for v in views)  # no 4-D activations -> no tiles
+    views = helper.candidate_views(MachineResource(0, 8), batch_limit=8, attr_limit=2)
+    assert any(v.dims == (1, 2) for v in views)
+    assert not any(len(v.dims) == 2 and v.dims[1] == 4 for v in views)  # 4 !| 2
+
+
+def test_spatial_repartition_lowers_to_mesh_axis_and_trains():
+    """The VERDICT-flagged gap: partition(dim=H) -> conv -> combine must
+    lower to a spatial mesh-axis sharding and execute (P3)."""
+    m = _conv_net(batch=4)
+    g = m.graph
+    conv = next(n for n in g.topo_order() if n.name == "conv1")
+    inp = next(n for n in g.topo_order() if n.op_type == OpType.INPUT)
+    part = g.new_node(OpType.REPARTITION, RepartitionParams(dim=2, degree=2), "part_h")
+    comb = g.new_node(OpType.COMBINE, CombineParams(dim=2, degree=2), "comb_h")
+    (e_in,) = g.in_edges(conv)
+    g.remove_edge(e_in)
+    g.add_edge(inp, part)
+    g.add_edge(part, conv, 0, 0)
+    for e in list(g.out_edges(conv)):
+        g.remove_edge(e)
+        g.add_edge(comb, e.dst, 0, e.dst_idx)
+    g.add_edge(conv, comb)
+
+    st = strategy_from_pcg(g, {}, 8)
+    assert st.axis_sizes["model"] == 2
+    (conv_spec,) = st.node_shardings[conv.guid].outputs
+    assert conv_spec is not None and conv_spec[2] == ("model",), conv_spec  # H dim sharded
+
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=st,
+    )
+    rs = np.random.RandomState(0)
+    xb = jnp.asarray(rs.randn(4, 3, 32, 32), jnp.float32)
+    yb = jnp.asarray(rs.randint(0, 10, (4,)), jnp.int32)
+    losses = [float(m.executor.train_batch([xb], yb, jax.random.key(0))["loss"]) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_conv_net_searched_with_attribute_parallel_trains():
+    """unity_optimize over a conv net with attr xfers + 2-D views enabled
+    compiles and trains on the CPU mesh."""
+    m = _conv_net(
+        batch=4,
+        search_budget=8,
+        enable_attribute_parallel=True,
+        enable_parameter_parallel=True,
+    )
+    m.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    assert m._search_result is not None
+    assert m._search_result.candidates_explored > 1
+    rs = np.random.RandomState(0)
+    xb = jnp.asarray(rs.randn(4, 3, 32, 32), jnp.float32)
+    yb = jnp.asarray(rs.randint(0, 10, (4,)), jnp.int32)
+    losses = [float(m.executor.train_batch([xb], yb, jax.random.key(0))["loss"]) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_2d_view_realized_in_strategy():
+    """A searched 2-D (sample x attribute) view must be REALIZED by
+    strategy_from_pcg, not just scored (round-2 review finding)."""
+    from flexflow_tpu.parallel.machine import MachineView
+
+    m = _conv_net(batch=4)
+    g = m.graph
+    view2d = MachineView(0, (2, 4), (4, 1))
+    views = {n.guid: view2d for n in g.topo_order()}
+    st = strategy_from_pcg(g, views, 8)
+    assert st.axis_sizes == {"data": 2, "model": 4}
+    conv = next(n for n in g.topo_order() if n.name == "conv1")
+    (spec,) = st.node_shardings[conv.guid].outputs
+    assert spec is not None
+    assert spec[0] == ("data",) and spec[2] == ("model",), spec
+
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=st,
+    )
+    rs = np.random.RandomState(0)
+    xb = jnp.asarray(rs.randn(4, 3, 32, 32), jnp.float32)
+    yb = jnp.asarray(rs.randint(0, 10, (4,)), jnp.int32)
+    loss = float(m.executor.train_batch([xb], yb, jax.random.key(0))["loss"])
+    assert np.isfinite(loss)
