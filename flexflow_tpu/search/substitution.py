@@ -322,9 +322,11 @@ def create_replicate_embedding_combine(degree: int) -> GraphXfer:
     )
 
 
-def create_partition_attention_combine(degree: int) -> GraphXfer:
-    """MHA => Combine(MHA(Replicate(q,k,v))) — head parallelism
-    (reference: create_partition_attention_combine substitution.cc:1768)."""
+def create_replicate_attention_reduce(degree: int) -> GraphXfer:
+    """MHA => Reduction(MHA(Replicate(q,k,v))) — head parallelism via the
+    replica dim: each replica computes its head subset (weights sharded by
+    the strategy layer), partial outputs sum in the Reduction (reference:
+    create_replicate_attention_reduce substitution.cc:3197)."""
 
     def ok(node: Node) -> bool:
         return getattr(node.params, "num_heads", 0) % degree == 0
@@ -357,10 +359,143 @@ def create_partition_attention_combine(degree: int) -> GraphXfer:
         ),
     ]
     return GraphXfer(
+        name=f"replicate_attention_reduce_{degree}",
+        src_ops=src,
+        dst_ops=dst,
+        mapped_outputs={(0, 0): (4, 0)},
+    )
+
+
+def create_partition_attention_combine(degree: int) -> GraphXfer:
+    """MHA => Combine(MHA(Repartition(q,k,v))) — sample parallelism over
+    the batch dim (reference: create_partition_attention_combine
+    substitution.cc:3169; the reference partitions a data dim, attention
+    over the full sequence stays exact when that dim is the batch)."""
+    src = [
+        _x(
+            OpType.MULTIHEAD_ATTENTION,
+            TensorX(-1, 0),
+            TensorX(-1, 1),
+            TensorX(-1, 2),
+        )
+    ]
+    dst = [
+        _x(OpType.REPARTITION, TensorX(-1, 0), make_params=lambda m: RepartitionParams(dim=0, degree=degree)),
+        _x(OpType.REPARTITION, TensorX(-1, 1), make_params=lambda m: RepartitionParams(dim=0, degree=degree)),
+        _x(OpType.REPARTITION, TensorX(-1, 2), make_params=lambda m: RepartitionParams(dim=0, degree=degree)),
+        _x(
+            OpType.MULTIHEAD_ATTENTION,
+            TensorX(0, 0),
+            TensorX(1, 0),
+            TensorX(2, 0),
+            make_params=lambda m: m[0].params,
+            reuse_src=0,
+        ),
+        _x(
+            OpType.COMBINE,
+            TensorX(3, 0),
+            make_params=lambda m: CombineParams(dim=0, degree=degree),
+        ),
+    ]
+    return GraphXfer(
         name=f"partition_attention_combine_{degree}",
         src_ops=src,
         dst_ops=dst,
         mapped_outputs={(0, 0): (4, 0)},
+    )
+
+
+def create_partition_concat_combine(degree: int, num_inputs: int = 2) -> GraphXfer:
+    """Concat(xs) => Combine(Concat(Repartition(xs))) on a non-concat dim
+    (reference: create_partition_concat_combine substitution.cc:3380)."""
+
+    def concat_params(m: List[Node]):
+        if m[0].params.axis == 0:  # partition dim (0) must not be the concat axis
+            return None
+        return m[0].params
+
+    src = [_x(OpType.CONCAT, *[TensorX(-1, i) for i in range(num_inputs)])]
+    dst = (
+        [
+            _x(
+                OpType.REPARTITION,
+                TensorX(-1, i),
+                make_params=lambda m: RepartitionParams(dim=0, degree=degree),
+            )
+            for i in range(num_inputs)
+        ]
+        + [
+            _x(
+                OpType.CONCAT,
+                *[TensorX(i, 0) for i in range(num_inputs)],
+                make_params=concat_params,
+                reuse_src=0,
+            ),
+            _x(
+                OpType.COMBINE,
+                TensorX(num_inputs, 0),
+                make_params=lambda m: CombineParams(dim=0, degree=degree),
+            ),
+        ]
+    )
+    return GraphXfer(
+        name=f"partition_concat_combine_{num_inputs}_{degree}",
+        src_ops=src,
+        dst_ops=dst,
+        mapped_outputs={(0, 0): (num_inputs + 1, 0)},
+    )
+
+
+def leading_relu_branch_combine(degree: int, num_combines: int = 2, dim: int = 0) -> GraphXfer:
+    """A tensor feeding one Repartition plus N Combines (a branching point
+    after e.g. a partitioned relu): drop the redundant Combines — branches
+    consume the tensor directly (reference: leading_relu_branch_combine
+    substitution.cc:3464; the Combines become NoOps)."""
+
+    def keep_partition(m: List[Node]):
+        p = m[0].params
+        for c in m[1:]:
+            if c.params.dim != p.dim or c.params.degree != p.degree:
+                return None
+        return p
+
+    src = [_x(OpType.REPARTITION, TensorX(-1, 0), constraints={"dim": dim, "degree": degree})] + [
+        _x(OpType.COMBINE, TensorX(-1, 0), constraints={"dim": dim, "degree": degree})
+        for _ in range(num_combines)
+    ]
+    from ..ops.io_ops import NoOpParams
+
+    dst = [_x(OpType.REPARTITION, TensorX(-1, 0), make_params=keep_partition, reuse_src=0)] + [
+        _x(OpType.NOOP, TensorX(-1, 0), make_params=lambda m: NoOpParams())
+        for _ in range(num_combines)
+    ]
+    return GraphXfer(
+        name=f"leading_relu_branch_combine_{num_combines}_{degree}",
+        src_ops=src,
+        dst_ops=dst,
+        mapped_outputs={(i, 0): (i, 0) for i in range(num_combines + 1)},
+    )
+
+
+def leading_relu_branch_partition(degree: int, num_partitions: int = 2, dim: int = 0) -> GraphXfer:
+    """A tensor feeding N identical Repartitions: dedupe to one, the rest
+    become NoOps of its output (reference: leading_relu_branch_partition
+    substitution.cc:1841)."""
+    from ..ops.io_ops import NoOpParams
+
+    src = [
+        _x(OpType.REPARTITION, TensorX(-1, 0), constraints={"dim": dim, "degree": degree})
+        for _ in range(num_partitions)
+    ]
+    dst = [_x(OpType.REPARTITION, TensorX(-1, 0), make_params=lambda m: m[0].params, reuse_src=0)] + [
+        _x(OpType.NOOP, TensorX(0, 0), make_params=lambda m: NoOpParams())
+        for _ in range(num_partitions - 1)
+    ]
+    return GraphXfer(
+        name=f"leading_relu_branch_partition_{num_partitions}_{degree}",
+        src_ops=src,
+        dst_ops=dst,
+        mapped_outputs={(0, 0): (0, 0), **{(i, 0): (i, 0) for i in range(1, num_partitions)}},
     )
 
 
@@ -471,12 +606,16 @@ def generate_all_pcg_xfers(
         if enable_parameter_parallel:
             xfers.append(create_replicate_linear_combine(d))
             xfers.append(create_partition_linear_combine(d))
-            xfers.append(create_partition_attention_combine(d))
+            xfers.append(create_replicate_attention_reduce(d))
             xfers.append(create_replicate_embedding_combine(d))
+        xfers.append(create_partition_attention_combine(d))
         xfers.append(create_partition_add_combine(d))
         xfers.append(_partition_unary_combine(OpType.RELU, d))
         xfers.append(_partition_unary_combine(OpType.SOFTMAX, d))
+        xfers.append(create_partition_concat_combine(d))
         xfers.append(create_combine_inception(d))
+        xfers.append(leading_relu_branch_combine(d))
+        xfers.append(leading_relu_branch_partition(d))
         if enable_attribute_parallel:
             # partition spatial dims of conv/pool (reference:
             # create_mapping_xfers<Conv2D/Pool2D> substitution.cc:1797-1800)
