@@ -30,7 +30,9 @@ def apply_activation(mode: ActiMode, x: jax.Array) -> jax.Array:
     if mode == ActiMode.TANH:
         return jnp.tanh(x)
     if mode == ActiMode.GELU:
-        return jax.nn.gelu(x)
+        # exact erf form: aligns with torch F.gelu default and the
+        # reference's erf-based CUDA kernel (element_unary.cu)
+        return jax.nn.gelu(x, approximate=False)
     raise ValueError(f"unknown activation {mode}")
 
 
@@ -52,7 +54,7 @@ _UNARY_FNS = {
     OpType.SIGMOID: jax.nn.sigmoid,
     OpType.TANH: jnp.tanh,
     OpType.ELU: jax.nn.elu,
-    OpType.GELU: jax.nn.gelu,
+    OpType.GELU: lambda x: jax.nn.gelu(x, approximate=False),
     OpType.IDENTITY: lambda x: x,
     OpType.EXP: jnp.exp,
     OpType.SIN: jnp.sin,
