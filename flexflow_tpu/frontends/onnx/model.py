@@ -47,6 +47,21 @@ def _attrs(node) -> Dict[str, object]:
     return out
 
 
+class _NamedNode:
+    """Read-only view of a NodeProto with a generated name — keeps the
+    user-owned ModelProto unmutated (an importer assigning node.name was
+    an unexpected side effect on caller input)."""
+
+    __slots__ = ("_node", "name")
+
+    def __init__(self, node, name: str):
+        self._node = node
+        self.name = name
+
+    def __getattr__(self, attr):
+        return getattr(self._node, attr)
+
+
 class ONNXModel:
     """Reference: ONNXModel (onnx/model.py:56)."""
 
@@ -66,17 +81,27 @@ class ONNXModel:
 
     def apply(self, ffmodel, input_tensors: Dict[str, object]) -> List:
         """Replay the graph; input_tensors maps graph input name -> ff
-        Tensor. Returns the graph outputs (reference: ONNXModel.apply)."""
+        Tensor. Returns the graph outputs (reference: ONNXModel.apply).
+
+        The caller's ModelProto is never mutated: ONNX node names are
+        optional, so anonymous nodes get generated names held in a local
+        wrapper, uniquified against user-supplied ones."""
         graph = self.model.graph
         env: Dict[str, object] = dict(input_tensors)
         for init in graph.initializer:
             self.initializers[init.name] = _to_numpy(init)
+        taken = {n.name for n in graph.node if n.name}
+        named_nodes = []
         for i, node in enumerate(graph.node):
-            if not node.name:
-                # node names are optional in ONNX; weight_map and FF node
-                # lookup need them unique and non-empty
-                node.name = f"{node.op_type.lower()}_{i}"
-        for node in graph.node:
+            if node.name:
+                named_nodes.append(node)
+                continue
+            name = f"{node.op_type.lower()}_{i}"
+            while name in taken:
+                name += "_"
+            taken.add(name)
+            named_nodes.append(_NamedNode(node, name))
+        for node in named_nodes:
             handler = getattr(self, f"handle{node.op_type}", None)
             if handler is None:
                 raise NotImplementedError(f"unsupported ONNX op {node.op_type}")
@@ -253,12 +278,55 @@ class ONNXModel:
         return ff.pool2d(x, h, w, 1, 1, 0, 0, pool_type=PoolType.AVG, name=node.name)
 
     def handleBatchNormalization(self, ff, node, env):
-        return ff.batch_norm(env[node.input[0]], relu=False, name=node.name)
+        """BatchNormalization(X, scale, B, mean, var) — the trained
+        statistics ride weight_map/state (reference: onnx/model.py's
+        handleBatchNormalization; round-1 dropped the initializers)."""
+        at = _attrs(node)
+        ws = {}
+        for wname, inp_idx in (("scale", 1), ("bias", 2), ("running_mean", 3), ("running_var", 4)):
+            if len(node.input) > inp_idx:
+                v = self.initializers.get(node.input[inp_idx])
+                if v is not None:
+                    ws[wname] = v
+        if ws:
+            self.weight_map[node.name] = ws
+        return ff.batch_norm(
+            env[node.input[0]], relu=False, eps=at.get("epsilon", 1e-5), name=node.name
+        )
+
+    def handleLayerNormalization(self, ff, node, env):
+        """LayerNormalization (opset 17; HF BERT exports use it)."""
+        at = _attrs(node)
+        x = env[node.input[0]]
+        axis = at.get("axis", -1)
+        axis = axis % x.ndim
+        axes = list(range(axis, x.ndim))
+        ws = {}
+        if len(node.input) > 1:
+            s = self.initializers.get(node.input[1])
+            if s is not None:
+                ws["scale"] = s
+        if len(node.input) > 2:
+            b = self.initializers.get(node.input[2])
+            if b is not None:
+                ws["bias"] = b
+        if ws:
+            self.weight_map[node.name] = ws
+        return ff.layer_norm(x, axes=axes, eps=at.get("epsilon", 1e-5), name=node.name)
 
     # -- linear -------------------------------------------------------
     def handleGemm(self, ff, node, env):
-        """Gemm(x, W, b): W is [out, in] when transB=1 (the common export)."""
+        """Gemm(x, W, b): W is [out, in] when transB=1 (the common export).
+
+        alpha/beta/transA deviating from the defaults would silently
+        change numerics — fail at import instead (ADVICE r1)."""
         at = _attrs(node)
+        if at.get("alpha", 1.0) != 1.0 or at.get("beta", 1.0) != 1.0 or at.get("transA", 0):
+            raise NotImplementedError(
+                f"Gemm node {node.name!r} uses alpha={at.get('alpha', 1.0)}, "
+                f"beta={at.get('beta', 1.0)}, transA={at.get('transA', 0)}; "
+                "only the default (1.0, 1.0, 0) configuration is supported"
+            )
         w = self.initializers.get(node.input[1])
         assert w is not None
         out_dim = w.shape[0] if at.get("transB", 0) else w.shape[1]
@@ -286,11 +354,114 @@ class ONNXModel:
             return ff.dense(env[node.input[0]], w.shape[-1], use_bias=False, name=node.name)
         return ff.batch_matmul(env[node.input[0]], env[rhs], name=node.name)
 
+    # -- gather / reductions / misc (round-2: VERDICT item 9) ---------
+    def handleGather(self, ff, node, env):
+        """ONNX Gather = np.take. Supported forms: (a) embedding lookup —
+        constant data table + integer index tensor on axis 0; (b) constant
+        scalar index on any axis — lowered to split + reshape (the
+        CLS-token slice pattern of BERT exports)."""
+        at = _attrs(node)
+        axis = at.get("axis", 0)
+        data_name, idx_name = node.input[0], node.input[1]
+        if data_name in self.initializers and axis == 0:
+            table = self.initializers[data_name]
+            assert table.ndim == 2, f"Gather table must be 2-D, got {table.shape}"
+            self.weight_map[node.name] = {"embedding": table}
+            return ff.embedding(env[idx_name], table.shape[0], table.shape[1], name=node.name)
+        if idx_name in self.initializers:
+            idx = self.initializers[idx_name]
+            if idx.size == 1:
+                x = env[data_name]
+                i = int(idx.reshape(-1)[0]) % x.shape[axis]
+                sizes = []
+                if i > 0:
+                    sizes.append(i)
+                sizes.append(1)
+                if x.shape[axis] - i - 1 > 0:
+                    sizes.append(x.shape[axis] - i - 1)
+                parts = ff.split(x, sizes, axis, name=f"{node.name}_split")
+                picked = parts[1 if i > 0 else 0]
+                new_shape = tuple(s for d, s in enumerate(picked.shape) if d != axis)
+                return ff.reshape(picked, new_shape, name=node.name)
+        raise NotImplementedError(
+            f"Gather node {node.name!r}: only constant-table axis-0 lookup "
+            "or constant scalar index is supported"
+        )
+
+    def handleReduceMean(self, ff, node, env):
+        at = _attrs(node)
+        axes = at.get("axes")
+        if axes is None and len(node.input) > 1 and node.input[1] in self.initializers:
+            axes = [int(v) for v in self.initializers[node.input[1]]]
+        assert axes is not None, "ReduceMean without axes unsupported"
+        return ff.mean(env[node.input[0]], list(axes), keepdims=bool(at.get("keepdims", 1)), name=node.name)
+
+    def handleReduceSum(self, ff, node, env):
+        at = _attrs(node)
+        axes = at.get("axes")
+        if axes is None and len(node.input) > 1 and node.input[1] in self.initializers:
+            axes = [int(v) for v in self.initializers[node.input[1]]]
+        assert axes is not None, "ReduceSum without axes unsupported"
+        return ff.reduce_sum(env[node.input[0]], list(axes), keepdims=bool(at.get("keepdims", 1)), name=node.name)
+
+    def handlePow(self, ff, node, env):
+        exp = self.initializers.get(node.input[1])
+        assert exp is not None and exp.size == 1, "Pow exponent must be a scalar initializer"
+        return ff.pow(env[node.input[0]], float(exp.reshape(-1)[0]), name=node.name)
+
+    def handleSqrt(self, ff, node, env):
+        return ff.pow(env[node.input[0]], 0.5, name=node.name)
+
+    def handleGelu(self, ff, node, env):  # com.microsoft / opset 20
+        return ff.gelu(env[node.input[0]], name=node.name)
+
+    def handleAttention(self, ff, node, env):
+        """com.microsoft Attention: input [B,S,H], combined qkv weight
+        [H, 3*H] + bias [3*H] — lowered to MultiHeadAttention with the
+        packed projections split into wq/wk/wv (reference parity target:
+        the onnx attention handlers VERDICT item 9 called out)."""
+        at = _attrs(node)
+        num_heads = at["num_heads"]
+        x = env[node.input[0]]
+        hidden = x.shape[-1]
+        w = self.initializers.get(node.input[1])
+        assert w is not None and w.shape == (hidden, 3 * hidden), (
+            f"Attention weight must be [{hidden}, {3 * hidden}], got "
+            f"{None if w is None else w.shape}"
+        )
+        head_dim = hidden // num_heads
+        wq, wk, wv = (w[:, i * hidden : (i + 1) * hidden] for i in range(3))
+        ws = {
+            "wq": wq.reshape(hidden, num_heads, head_dim),
+            "wk": wk.reshape(hidden, num_heads, head_dim),
+            "wv": wv.reshape(hidden, num_heads, head_dim),
+        }
+        use_bias = len(node.input) > 2 and node.input[2] in self.initializers
+        if use_bias:
+            b = self.initializers[node.input[2]]
+            bq, bk, bv = (b[i * hidden : (i + 1) * hidden] for i in range(3))
+            ws.update(
+                bq=bq.reshape(num_heads, head_dim),
+                bk=bk.reshape(num_heads, head_dim),
+                bv=bv.reshape(num_heads, head_dim),
+                bo=np.zeros(hidden, w.dtype),
+            )
+            # our MHA couples use_bias to an output bias too; Attention has
+            # no output projection at all, so wo must become identity
+        ws["wo"] = np.eye(hidden, dtype=w.dtype).reshape(num_heads, head_dim, hidden)
+        self.weight_map[node.name] = ws
+        return ff.multihead_attention(x, x, x, hidden, num_heads, bias=use_bias, name=node.name)
+
 
 def _load_weights_impl(onnx_model: "ONNXModel", ffmodel) -> int:
     """Port the graph's initializer weights into the compiled executor
     (serving parity with triton/src/onnx_parser.cc, which parses weight
-    tensors out of the ModelProto). Returns the number of nodes updated."""
+    tensors out of the ModelProto). Returns the number of nodes updated.
+
+    Every initializer is validated against the compiled parameter's shape
+    before placement — a mismatch raises immediately naming the node,
+    instead of corrupting params and surfacing later as an opaque XLA
+    shape error (ADVICE r1)."""
     from ...runtime.executor import _node_key
 
     ex = ffmodel.executor
@@ -302,14 +473,27 @@ def _load_weights_impl(onnx_model: "ONNXModel", ffmodel) -> int:
         if node is None:
             continue
         key = _node_key(node)
-        if key not in ex.params:
-            continue
-        cur = dict(ex.params[key])
-        for wname, value in ws.items():
-            if wname in cur:
-                cur[wname] = ex._place_weight(node.guid, wname, np.asarray(value))
-        ex.params[key] = cur
-        updated += 1
+        touched = False
+        for store in (ex.params, ex.state):
+            if key not in store:
+                continue
+            cur = dict(store[key])
+            for wname, value in ws.items():
+                if wname not in cur:
+                    continue
+                value = np.asarray(value)
+                want = tuple(cur[wname].shape)
+                if tuple(value.shape) != want:
+                    raise ValueError(
+                        f"ONNX initializer for node {ff_name!r} weight {wname!r} "
+                        f"has shape {tuple(value.shape)}, compiled parameter "
+                        f"expects {want}"
+                    )
+                cur[wname] = ex._place_weight(node.guid, wname, value)
+                touched = True
+            store[key] = cur
+        if touched:
+            updated += 1
     return updated
 
 

@@ -3,6 +3,6 @@
 Reference: python/flexflow/torch/model.py (2607 LoC): symbolic-trace a
 torch.nn.Module and replay each fx node as an FFModel builder call.
 """
-from .model import PyTorchModel, copy_weights, torch_to_flexflow
+from .model import PyTorchModel, copy_weights, replay_ff, torch_to_flexflow
 
-__all__ = ["PyTorchModel", "torch_to_flexflow", "copy_weights"]
+__all__ = ["PyTorchModel", "torch_to_flexflow", "copy_weights", "replay_ff"]

@@ -294,6 +294,130 @@ class PyTorchModel:
             recs.append(f"{node.name};{ins};{node.op};{node.target}")
         return recs
 
+    def export_ff(self, path: str, ffmodel_factory, input_shapes: Sequence[tuple]) -> None:
+        """Serialize the traced model to a ``.ff`` file that replays into
+        an FFModel WITHOUT torch (reference: the flat-file format written
+        by python/flexflow/torch/model.py and replayed by
+        PyTorchModel.apply). The file records the FF builder calls the
+        import makes, so every supported module/function round-trips.
+
+        ffmodel_factory() -> a fresh FFModel; input_shapes: one (shape,
+        dtype-name?) per placeholder."""
+        import json as _json
+
+        ff = ffmodel_factory()
+        rec = _FFRecorder(ff)
+        inputs = [ff.create_tensor(tuple(s), name=f"input{i}") for i, s in enumerate(input_shapes)]
+        for i, t in enumerate(inputs):
+            rec.bind(t, f"$in{i}")
+        outs = self.torch_to_ff(rec, inputs)
+        payload = {
+            "format": "flexflow_tpu.ff.v1",
+            "inputs": [list(map(int, s)) for s in input_shapes],
+            "records": rec.records,
+            "outputs": [rec.ref_of(t) for t in outs],
+        }
+        with open(path, "w") as f:
+            f.write(_json.dumps(payload, indent=1))
+
+
+def replay_ff(path: str, ffmodel, input_tensors: Sequence) -> List:
+    """Rebuild a model from a ``.ff`` file into ``ffmodel`` — no torch
+    needed (reference: PyTorchModel.apply replaying the flat file)."""
+    import json as _json
+
+    with open(path) as f:
+        payload = _json.loads(f.read())
+    assert payload.get("format") == "flexflow_tpu.ff.v1", payload.get("format")
+    env: Dict[str, object] = {f"$in{i}": t for i, t in enumerate(input_tensors)}
+
+    def resolve(v):
+        if isinstance(v, str) and v.startswith("$"):
+            return env[v]
+        if isinstance(v, list):
+            return [resolve(x) for x in v]
+        if isinstance(v, dict) and "__enum__" in v:
+            return _decode_enum(v["__enum__"])
+        if isinstance(v, dict) and "__tuple__" in v:
+            return tuple(resolve(x) for x in v["__tuple__"])
+        return v
+
+    last = None
+    for r in payload["records"]:
+        fn = getattr(ffmodel, r["op"])
+        args = [resolve(a) for a in r["args"]]
+        kwargs = {k: resolve(v) for k, v in r["kwargs"].items()}
+        out = fn(*args, **kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for ref, t in zip(r["out"], outs):
+            env[ref] = t
+        last = out
+    return [env[ref] for ref in payload["outputs"]]
+
+
+def _decode_enum(s: str):
+    from ...core import types as _types
+
+    cls_name, member = s.split(".")
+    return getattr(getattr(_types, cls_name), member)
+
+
+class _FFRecorder:
+    """Proxy over FFModel that records every builder call as pure data
+    (the .ff serialization) while executing it for real."""
+
+    def __init__(self, ff):
+        self._ff = ff
+        self.records: List[dict] = []
+        self._refs: Dict[int, str] = {}
+        self._count = 0
+
+    def bind(self, tensor, ref: str):
+        self._refs[id(tensor)] = ref
+
+    def ref_of(self, tensor) -> str:
+        return self._refs[id(tensor)]
+
+    def _encode(self, v):
+        import enum
+
+        if id(v) in self._refs:
+            return self._refs[id(v)]
+        if isinstance(v, enum.Enum):
+            return {"__enum__": f"{type(v).__name__}.{v.name}"}
+        if isinstance(v, tuple):
+            return {"__tuple__": [self._encode(x) for x in v]}
+        if isinstance(v, list):
+            return [self._encode(x) for x in v]
+        if isinstance(v, (int, float, str, bool)) or v is None:
+            return v
+        if isinstance(v, np.integer):
+            return int(v)
+        raise TypeError(f"cannot serialize builder arg {v!r} to .ff")
+
+    def __getattr__(self, name):
+        target = getattr(self._ff, name)
+        if not callable(target):
+            return target
+
+        def wrapper(*args, **kwargs):
+            enc_args = [self._encode(a) for a in args]
+            enc_kwargs = {k: self._encode(v) for k, v in kwargs.items()}
+            out = target(*args, **kwargs)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            refs = []
+            for t in outs:
+                ref = f"$t{self._count}"
+                self._count += 1
+                self._refs[id(t)] = ref
+                refs.append(ref)
+            self.records.append(
+                {"op": name, "args": enc_args, "kwargs": enc_kwargs, "out": refs}
+            )
+            return out
+
+        return wrapper
+
 
 def torch_to_flexflow(module, ffmodel, input_tensors, seq_length=None):
     """Reference: flexflow.torch.fx.torch_to_flexflow (README.md:10-17)."""

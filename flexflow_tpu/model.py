@@ -293,8 +293,8 @@ class FFModel:
         p = LayerNormParams(tuple(axes), elementwise_affine, eps, input.dtype)
         return self._one(OpType.LAYERNORM, p, [input], name=name)
 
-    def batch_norm(self, input: Tensor, relu: bool = True, name: str = "") -> Tensor:
-        p = BatchNormParams(relu=relu, dtype=input.dtype)
+    def batch_norm(self, input: Tensor, relu: bool = True, eps: float = 1e-5, name: str = "") -> Tensor:
+        p = BatchNormParams(relu=relu, eps=eps, dtype=input.dtype)
         return self._one(OpType.BATCHNORM, p, [input], name=name)
 
     def batch_matmul(

@@ -258,3 +258,184 @@ def test_onnx_add_with_zero_scalar_initializer():
     ff.compile(optimizer=SGDOptimizer(lr=0.0), loss_type=LossType.MEAN_SQUARED_ERROR, outputs=outs)
     xv = np.random.RandomState(3).randn(2, 4).astype(np.float32)
     np.testing.assert_allclose(np.asarray(ff.predict([xv])), xv, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# round-2 additions (VERDICT item 9 + ADVICE r1): BatchNormalization with
+# trained stats, Gather, LayerNormalization, Attention, Gemm attr guards,
+# weight validation, no caller-proto mutation
+# ---------------------------------------------------------------------------
+
+
+def _compile_inference(ff, outs):
+    from flexflow_tpu.core.types import CompMode
+
+    ff.compile(comp_mode=CompMode.INFERENCE, outputs=outs)
+    return ff
+
+
+def test_onnx_batchnorm_loads_trained_stats():
+    rs = np.random.RandomState(0)
+    scale = rs.rand(3).astype(np.float32) + 0.5
+    bias = rs.randn(3).astype(np.float32)
+    mean = rs.randn(3).astype(np.float32)
+    var = rs.rand(3).astype(np.float32) + 0.5
+    g = GraphProto(
+        node=[NodeProto("BatchNormalization", ["x", "s", "b", "m", "v"], ["y"], "bn",
+                        [Attr("epsilon", 1, f=1e-5)])],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[Init("s", scale), Init("b", bias), Init("m", mean), Init("v", var)],
+    )
+    ff = FFModel(FFConfig(batch_size=2, workers_per_node=1))
+    x = ff.create_tensor((2, 3, 4, 4))
+    om = ONNXModel(ModelProto(g))
+    outs = om.apply(ff, {"x": x})
+    _compile_inference(ff, outs)
+    assert om.load_weights(ff) == 1
+    xv = rs.randn(2, 3, 4, 4).astype(np.float32)
+    got = np.asarray(ff.executor.predict([xv])[0])
+    want = (xv - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-5)
+    want = want * scale[None, :, None, None] + bias[None, :, None, None]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_gather_embedding_lookup():
+    rs = np.random.RandomState(1)
+    table = rs.randn(6, 4).astype(np.float32)
+    g = GraphProto(
+        node=[NodeProto("Gather", ["table", "ids"], ["y"], "gat", [Attr("axis", 2, i=0)])],
+        input=[ValueInfo("ids")],
+        output=[ValueInfo("y")],
+        initializer=[Init("table", table)],
+    )
+    ff = FFModel(FFConfig(batch_size=3, workers_per_node=1))
+    ids = ff.create_tensor((3, 5), DataType.INT32)
+    om = ONNXModel(ModelProto(g))
+    outs = om.apply(ff, {"ids": ids})
+    assert outs[0].shape == (3, 5, 4)
+    _compile_inference(ff, outs)
+    om.load_weights(ff)
+    iv = rs.randint(0, 6, (3, 5)).astype(np.int32)
+    got = np.asarray(ff.executor.predict([iv])[0])
+    np.testing.assert_allclose(got, table[iv], rtol=1e-6)
+
+
+def test_onnx_gather_scalar_index_slices():
+    g = GraphProto(
+        node=[NodeProto("Gather", ["x", "idx"], ["y"], "cls", [Attr("axis", 2, i=1)])],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[Init("idx", np.array(0, np.int64))],
+    )
+    ff = FFModel(FFConfig(batch_size=2, workers_per_node=1))
+    x = ff.create_tensor((2, 5, 3))
+    outs = ONNXModel(ModelProto(g)).apply(ff, {"x": x})
+    assert outs[0].shape == (2, 3)  # CLS-token slice, axis squeezed
+    _compile_inference(ff, outs)
+    rs = np.random.RandomState(2)
+    xv = rs.randn(2, 5, 3).astype(np.float32)
+    got = np.asarray(ff.executor.predict([xv])[0])
+    np.testing.assert_allclose(got, xv[:, 0, :], rtol=1e-6)
+
+
+def test_onnx_layernorm_handler():
+    rs = np.random.RandomState(3)
+    scale = rs.rand(6).astype(np.float32) + 0.5
+    bias = rs.randn(6).astype(np.float32)
+    g = GraphProto(
+        node=[NodeProto("LayerNormalization", ["x", "s", "b"], ["y"], "ln",
+                        [Attr("axis", 2, i=-1), Attr("epsilon", 1, f=1e-5)])],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[Init("s", scale), Init("b", bias)],
+    )
+    ff = FFModel(FFConfig(batch_size=2, workers_per_node=1))
+    x = ff.create_tensor((2, 4, 6))
+    om = ONNXModel(ModelProto(g))
+    outs = om.apply(ff, {"x": x})
+    _compile_inference(ff, outs)
+    om.load_weights(ff)
+    xv = rs.randn(2, 4, 6).astype(np.float32)
+    got = np.asarray(ff.executor.predict([xv])[0])
+    mu = xv.mean(-1, keepdims=True)
+    want = (xv - mu) / np.sqrt(xv.var(-1, keepdims=True) + 1e-5) * scale + bias
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_attention_handler_numerics():
+    rs = np.random.RandomState(4)
+    H, heads, B, S = 8, 2, 2, 5
+    w = (rs.randn(H, 3 * H) * 0.3).astype(np.float32)
+    g = GraphProto(
+        node=[NodeProto("Attention", ["x", "w"], ["y"], "attn", [Attr("num_heads", 2, i=heads)])],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[Init("w", w)],
+    )
+    ff = FFModel(FFConfig(batch_size=B, workers_per_node=1))
+    x = ff.create_tensor((B, S, H))
+    om = ONNXModel(ModelProto(g))
+    outs = om.apply(ff, {"x": x})
+    _compile_inference(ff, outs)
+    assert om.load_weights(ff) == 1
+    xv = rs.randn(B, S, H).astype(np.float32)
+    got = np.asarray(ff.executor.predict([xv])[0])
+    # numpy reference: packed qkv, per-head softmax(qk/sqrt(d)) v, no out-proj
+    q, k, v = xv @ w[:, :H], xv @ w[:, H:2*H], xv @ w[:, 2*H:]
+    d = H // heads
+    want = np.zeros_like(xv)
+    for h in range(heads):
+        qs, ks, vs = (t[:, :, h*d:(h+1)*d] for t in (q, k, v))
+        att = np.einsum("bqd,bkd->bqk", qs, ks) / np.sqrt(d)
+        att = np.exp(att - att.max(-1, keepdims=True))
+        att = att / att.sum(-1, keepdims=True)
+        want[:, :, h*d:(h+1)*d] = np.einsum("bqk,bkd->bqd", att, vs)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_onnx_gemm_nondefault_attrs_rejected():
+    import pytest as _pytest
+
+    g = GraphProto(
+        node=[NodeProto("Gemm", ["x", "w", "b"], ["y"], "g", [Attr("alpha", 1, f=0.5)])],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[Init("w", np.zeros((4, 8), np.float32)), Init("b", np.zeros(4, np.float32))],
+    )
+    ff = FFModel(FFConfig(batch_size=2, workers_per_node=1))
+    x = ff.create_tensor((2, 8))
+    with _pytest.raises(NotImplementedError, match="alpha"):
+        ONNXModel(ModelProto(g)).apply(ff, {"x": x})
+
+
+def test_onnx_load_weights_shape_mismatch_raises():
+    import pytest as _pytest
+
+    g = GraphProto(
+        node=[NodeProto("Gemm", ["x", "w", "b"], ["y"], "g", [Attr("transB", 2, i=1)])],
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[Init("w", np.zeros((4, 8), np.float32)), Init("b", np.zeros(4, np.float32))],
+    )
+    ff = FFModel(FFConfig(batch_size=2, workers_per_node=1))
+    x = ff.create_tensor((2, 8))
+    om = ONNXModel(ModelProto(g))
+    outs = om.apply(ff, {"x": x})
+    _compile_inference(ff, outs)
+    om.weight_map["g"]["kernel"] = np.zeros((7, 7), np.float32)  # corrupt
+    with _pytest.raises(ValueError, match="'g'.*kernel"):
+        om.load_weights(ff)
+
+
+def test_onnx_apply_does_not_mutate_caller_proto():
+    g = GraphProto(
+        node=[NodeProto("Relu", ["x"], ["y"])],  # anonymous node
+        input=[ValueInfo("x")],
+        output=[ValueInfo("y")],
+        initializer=[],
+    )
+    ff = FFModel(FFConfig(batch_size=2, workers_per_node=1))
+    x = ff.create_tensor((2, 4))
+    ONNXModel(ModelProto(g)).apply(ff, {"x": x})
+    assert g.node[0].name == ""  # untouched

@@ -195,3 +195,121 @@ def test_flatten_with_nonunit_start_dim_rejected():
     t = ff.create_tensor([4, 2, 3])
     with pytest.raises(AssertionError):
         PyTorchModel(M()).torch_to_ff(ff, [t])
+
+
+# ---------------------------------------------------------------------------
+# round-2 (VERDICT item 9): HF-style BERT encoder via function/method
+# nodes, and the .ff export/replay path
+# ---------------------------------------------------------------------------
+
+
+class _BertSelfAttention(nn.Module):
+    """HF-style manual attention: q/k/v/o Linears + view/permute/matmul —
+    exercises exactly the function-call nodes round 1 lacked."""
+
+    def __init__(self, hidden, heads, seq):
+        super().__init__()
+        self.q = nn.Linear(hidden, hidden)
+        self.k = nn.Linear(hidden, hidden)
+        self.v = nn.Linear(hidden, hidden)
+        self.o = nn.Linear(hidden, hidden)
+        self.heads, self.hd, self.seq, self.hidden = heads, hidden // heads, seq, hidden
+
+    def forward(self, x):
+        q = self.q(x).view(-1, self.seq, self.heads, self.hd).permute(0, 2, 1, 3)
+        k = self.k(x).view(-1, self.seq, self.heads, self.hd).permute(0, 2, 1, 3)
+        v = self.v(x).view(-1, self.seq, self.heads, self.hd).permute(0, 2, 1, 3)
+        att = torch.matmul(q, k.transpose(-1, -2)) / (self.hd ** 0.5)
+        att = torch.nn.functional.softmax(att, dim=-1)
+        ctx = torch.matmul(att, v).permute(0, 2, 1, 3).reshape(-1, self.seq, self.hidden)
+        return self.o(ctx)
+
+
+class _BertLayer(nn.Module):
+    def __init__(self, hidden, heads, ff_dim, seq):
+        super().__init__()
+        self.attn = _BertSelfAttention(hidden, heads, seq)
+        self.ln1 = nn.LayerNorm(hidden)
+        self.ln2 = nn.LayerNorm(hidden)
+        self.fc1 = nn.Linear(hidden, ff_dim)
+        self.fc2 = nn.Linear(ff_dim, hidden)
+
+    def forward(self, x):
+        x = self.ln1(x + self.attn(x))
+        h = self.fc2(torch.nn.functional.gelu(self.fc1(x)))
+        return self.ln2(x + h)
+
+
+class _BertEncoder(nn.Module):
+    def __init__(self, hidden=16, heads=2, ff_dim=32, seq=6, layers=2):
+        super().__init__()
+        self.layers = nn.ModuleList(
+            [_BertLayer(hidden, heads, ff_dim, seq) for _ in range(layers)]
+        )
+
+    def forward(self, x):
+        for l in self.layers:
+            x = l(x)
+        return x
+
+
+def test_hf_style_bert_encoder_imports_and_aligns():
+    torch.manual_seed(3)
+    module = _BertEncoder()
+    rs = np.random.RandomState(5)
+    x = rs.randn(4, 6, 16).astype(np.float32)
+    from flexflow_tpu import DataType
+
+    import_and_compare(module, [x], [DataType.FLOAT], atol=5e-5)
+
+
+def test_hf_style_bert_encoder_trains():
+    torch.manual_seed(4)
+    module = _BertEncoder()
+    cfg = FFConfig(batch_size=4)
+    ff = FFModel(cfg)
+    pt = PyTorchModel(module)
+    outs = pt.torch_to_ff(ff, [ff.create_tensor((4, 6, 16))])
+    ff.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=LossType.MEAN_SQUARED_ERROR, outputs=outs)
+    rs = np.random.RandomState(6)
+    x = rs.randn(4, 6, 16).astype(np.float32)
+    y = rs.randn(4, 6, 16).astype(np.float32)
+    import jax
+
+    losses = [
+        float(ff.executor.train_batch([x], y, jax.random.key(0))["loss"]) for _ in range(4)
+    ]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_ff_file_export_and_replay(tmp_path):
+    """The .ff flat-file path (reference: torch/model.py writes a .ff file
+    replayed by PyTorchModel.apply): export records, replay WITHOUT torch
+    into a fresh FFModel, port the same weights — identical predictions."""
+    from flexflow_tpu.frontends.torch.model import replay_ff
+
+    torch.manual_seed(7)
+    module = _BertEncoder(layers=1)
+    path = str(tmp_path / "model.ff")
+    pt = PyTorchModel(module)
+    pt.export_ff(path, lambda: FFModel(FFConfig(batch_size=4)), [(4, 6, 16)])
+
+    # direct import path
+    ff1 = FFModel(FFConfig(batch_size=4))
+    pt1 = PyTorchModel(module)
+    outs1 = pt1.torch_to_ff(ff1, [ff1.create_tensor((4, 6, 16))])
+    ff1.compile(optimizer=SGDOptimizer(lr=0.0), loss_type=LossType.MEAN_SQUARED_ERROR, outputs=outs1)
+    copy_weights(module, ff1, pt1.name_map)
+
+    # replay path (no torch objects involved in graph construction)
+    ff2 = FFModel(FFConfig(batch_size=4))
+    outs2 = replay_ff(path, ff2, [ff2.create_tensor((4, 6, 16))])
+    ff2.compile(optimizer=SGDOptimizer(lr=0.0), loss_type=LossType.MEAN_SQUARED_ERROR, outputs=outs2)
+    copy_weights(module, ff2, pt1.name_map)
+
+    rs = np.random.RandomState(8)
+    x = rs.randn(4, 6, 16).astype(np.float32)
+    got1 = np.asarray(ff1.predict([x]))
+    got2 = np.asarray(ff2.predict([x]))
+    np.testing.assert_allclose(got1, got2, rtol=1e-5, atol=1e-6)
