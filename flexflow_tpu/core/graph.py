@@ -252,6 +252,29 @@ class PCGraph:
         ]
         return json.dumps({"nodes": nodes, "edges": edges}, indent=1)
 
+    @classmethod
+    def from_json(cls, text: str) -> "PCGraph":
+        """Inverse of to_json: params dataclasses are rebuilt from the op
+        registry with enum/tuple fields coerced from their field types
+        (enables graph persistence for the serving model repository)."""
+        from ..ops.base import get_op_def
+
+        d = json.loads(text)
+        g = cls()
+        for nd in d["nodes"]:
+            op_type = OpType(nd["op_type"])
+            params_cls = get_op_def(op_type).params_cls
+            raw = nd["params"] or {}
+            kwargs = {}
+            for f in dataclasses.fields(params_cls):
+                if f.name not in raw:
+                    continue
+                kwargs[f.name] = _coerce_field(f.type, raw[f.name])
+            g.add_node(Node(nd["guid"], op_type, params_cls(**kwargs), nd.get("name", "")))
+        for e in d["edges"]:
+            g.add_edge(e["src"], e["dst"], e.get("src_idx", 0), e.get("dst_idx", 0))
+        return g
+
     def to_dot(self, label_fn: Optional[Callable[[Node], str]] = None) -> str:
         """DOT export (reference: --compgraph export, graph.h:339)."""
         lines = ["digraph PCG {"]
@@ -264,6 +287,33 @@ class PCGraph:
                 lines.append(f"  n{e.src} -> n{e.dst};")
         lines.append("}")
         return "\n".join(lines)
+
+
+def _coerce_field(field_type, value):
+    """Rebuild a params field from its JSON form using the dataclass's
+    resolved type hint: enums from .value, tuples from lists, everything
+    else passed through."""
+    import enum
+    import typing
+
+    if isinstance(field_type, str):
+        # ops modules use `from __future__ import annotations`; resolve
+        # the string against the core.types namespace
+        from . import types as _types
+
+        field_type = getattr(_types, field_type, None) or {
+            "int": int, "float": float, "str": str, "bool": bool, "tuple": tuple
+        }.get(field_type, None)
+    origin = typing.get_origin(field_type)
+    if isinstance(field_type, type) and issubclass(field_type, enum.Enum):
+        return field_type(value)
+    if field_type is tuple or origin is tuple:
+        return tuple(
+            tuple(v) if isinstance(v, list) else v for v in value
+        ) if isinstance(value, list) else value
+    if isinstance(value, list):
+        return tuple(tuple(v) if isinstance(v, list) else v for v in value)
+    return value
 
 
 def _jsonable(x):

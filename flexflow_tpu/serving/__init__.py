@@ -5,6 +5,15 @@ Reference: triton/ (16k LoC Legion-based Triton backend, SURVEY §2.9).
 """
 from .batcher import DynamicBatcher
 from .model import InferenceModel, TensorMeta
+from .repository import ModelRepository, load_model, save_model
 from .server import InferenceServer
 
-__all__ = ["DynamicBatcher", "InferenceModel", "InferenceServer", "TensorMeta"]
+__all__ = [
+    "DynamicBatcher",
+    "InferenceModel",
+    "InferenceServer",
+    "ModelRepository",
+    "TensorMeta",
+    "load_model",
+    "save_model",
+]

@@ -38,6 +38,11 @@ class DynamicBatcher:
         self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        # one-slot holdover for a request that didn't fit the last batch:
+        # it leads the NEXT batch instead of re-queueing behind newer
+        # arrivals (FIFO re-queue starved large requests under sustained
+        # small-request load)
+        self._pending: Optional[_Request] = None
 
     # ------------------------------------------------------------ control
     def start(self):
@@ -57,6 +62,10 @@ class DynamicBatcher:
             self._thread = None
         # drain stale sentinels/requests so a later start() gets a clean
         # queue (a re-queued None would kill the new collector instantly)
+        if self._pending is not None:
+            if not self._pending.future.done():
+                self._pending.future.set_exception(RuntimeError("batcher stopped"))
+            self._pending = None
         while True:
             try:
                 item = self._q.get_nowait()
@@ -94,12 +103,16 @@ class DynamicBatcher:
     # ------------------------------------------------------------ internals
     def _collect(self) -> List[_Request]:
         """Block for the first request, then drain until the batch is full
-        or max_delay_s has passed."""
+        or max_delay_s has passed. A held-over request (one that didn't
+        fit the previous batch) always leads."""
         import time
 
-        first = self._q.get()
-        if first is None:
-            return []
+        if self._pending is not None:
+            first, self._pending = self._pending, None
+        else:
+            first = self._q.get()
+            if first is None:
+                return []
         batch = [first]
         total = first.n
         deadline = time.monotonic() + self.max_delay_s
@@ -115,7 +128,7 @@ class DynamicBatcher:
                 self._q.put(None)  # keep the shutdown signal
                 break
             if total + nxt.n > self.model.max_batch:
-                self._q.put(nxt)  # doesn't fit: next round
+                self._pending = nxt  # doesn't fit: leads the next batch
                 break
             batch.append(nxt)
             total += nxt.n

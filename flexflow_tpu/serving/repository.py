@@ -1,0 +1,121 @@
+"""Model repository: on-disk model store with load/unload lifecycle.
+
+Reference: the Triton backend's model-repository layout — triton loads
+models from a repository directory, and its v2 protocol exposes
+repository index/load/unload (triton/src/model.cc + strategy.cc load a
+model + partition strategy from disk; Triton core manages lifecycle).
+
+Layout per model: ``<root>/<name>/``
+  config.json   -- batch size, input metadata, outputs, comp mode
+  graph.json    -- the PCG (PCGraph.to_json)
+  strategy.json -- optional ParallelStrategy (searched or hand-written;
+                   the trainer's --export-strategy file drops in here)
+  weights.npz   -- executor params (+ non-trainable state), keys
+                   "<node_key>::<weight_name>"
+"""
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.graph import PCGraph
+from ..core.types import CompMode, DataType
+from .model import InferenceModel
+
+
+def save_model(im: InferenceModel, root: str) -> str:
+    """Persist a servable model (its graph, strategy, and weights)."""
+    d = Path(root) / im.name
+    d.mkdir(parents=True, exist_ok=True)
+    model = im.model
+    ex = model.executor
+    (d / "graph.json").write_text(model.graph.to_json())
+    if model.strategy is not None:
+        (d / "strategy.json").write_text(model.strategy.to_json())
+    cfg = {
+        "name": im.name,
+        "max_batch": im.max_batch,
+        "batch_size": model.config.batch_size,
+        "input_names": [m.name for m in im.inputs],
+        "outputs": [[g, i] for g, i in ex.outputs],
+    }
+    (d / "config.json").write_text(json.dumps(cfg, indent=1))
+    flat: Dict[str, np.ndarray] = {}
+    for store, prefix in ((ex.params, "p"), (ex.state, "s")):
+        for nkey, ws in store.items():
+            for wname, arr in ws.items():
+                flat[f"{prefix}::{nkey}::{wname}"] = np.asarray(arr)
+    np.savez(d / "weights.npz", **flat)
+    return str(d)
+
+
+def load_model(root: str, name: str) -> InferenceModel:
+    """Rebuild a servable model from the repository (graph + strategy +
+    weights); compiles for inference on the current mesh."""
+    from ..config import FFConfig
+    from ..model import FFModel, Tensor
+    from ..parallel.propagation import infer_all_specs
+    from ..parallel.strategy import ParallelStrategy
+
+    d = Path(root) / name
+    cfg = json.loads((d / "config.json").read_text())
+    graph = PCGraph.from_json((d / "graph.json").read_text())
+    strategy = None
+    spath = d / "strategy.json"
+    if spath.exists():
+        strategy = ParallelStrategy.from_json(spath.read_text())
+    model = FFModel(FFConfig(batch_size=cfg["batch_size"]))
+    model.graph = graph
+    specs = infer_all_specs(graph)
+    outputs = [
+        Tensor(model, graph.nodes[g], i, specs[g][i]) for g, i in cfg["outputs"]
+    ]
+    model.compile(comp_mode=CompMode.INFERENCE, outputs=outputs, strategy=strategy)
+    ex = model.executor
+    with np.load(d / "weights.npz") as z:
+        for key in z.files:
+            prefix, nkey, wname = key.split("::", 2)
+            store = ex.params if prefix == "p" else ex.state
+            if nkey not in store or wname not in store[nkey]:
+                continue
+            guid = int(nkey.rsplit("_", 1)[-1])
+            cur = dict(store[nkey])
+            value = z[key]
+            want = tuple(cur[wname].shape)
+            if tuple(value.shape) != want:
+                raise ValueError(
+                    f"repository weight {key} has shape {tuple(value.shape)}, "
+                    f"compiled parameter expects {want}"
+                )
+            cur[wname] = ex._place_weight(guid, wname, value)
+            store[nkey] = cur
+    return InferenceModel(
+        model, name=cfg["name"], max_batch=cfg["max_batch"], input_names=cfg["input_names"]
+    )
+
+
+class ModelRepository:
+    """Directory of servable models with Triton-style lifecycle."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def available(self) -> List[str]:
+        return sorted(
+            p.name
+            for p in Path(self.root).iterdir()
+            if p.is_dir() and (p / "config.json").exists()
+        )
+
+    def load(self, name: str) -> InferenceModel:
+        if name not in self.available():
+            raise KeyError(f"model {name!r} not in repository {self.root}")
+        return load_model(self.root, name)
+
+    def save(self, im: InferenceModel) -> str:
+        return save_model(im, self.root)

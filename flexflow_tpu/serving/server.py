@@ -35,14 +35,30 @@ _NP_TO_V2 = {
 
 
 class InferenceServer:
-    """Serves one or more InferenceModels over HTTP with dynamic batching."""
+    """Serves one or more InferenceModels over HTTP with dynamic batching.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000, max_delay_s: float = 0.005):
+    With a ModelRepository attached, the Triton v2 repository lifecycle
+    endpoints are live (reference: Triton's model-repository management
+    above triton/src/model.cc):
+
+      POST /v2/repository/index                  -> available + loaded state
+      POST /v2/repository/models/{name}/load     -> load from disk
+      POST /v2/repository/models/{name}/unload   -> stop serving + drop
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        max_delay_s: float = 0.005,
+        repository=None,
+    ):
         self.host = host
         self.port = port
         self.models: Dict[str, InferenceModel] = {}
         self.batchers: Dict[str, DynamicBatcher] = {}
         self.max_delay_s = max_delay_s
+        self.repository = repository
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -52,6 +68,12 @@ class InferenceServer:
         self.batchers[model.name] = b
         if self._httpd is not None:
             b.start()
+
+    def unregister(self, name: str) -> bool:
+        b = self.batchers.pop(name, None)
+        if b is not None:
+            b.stop()
+        return self.models.pop(name, None) is not None
 
     # ------------------------------------------------------------ control
     def start(self):
@@ -69,6 +91,33 @@ class InferenceServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _repository(self, parts):
+                repo = server.repository
+                if repo is None:
+                    return self._json(400, {"error": "no model repository configured"})
+                if len(parts) == 4 and parts[3] == "index":
+                    return self._json(200, [
+                        {
+                            "name": n,
+                            "state": "READY" if n in server.models else "UNAVAILABLE",
+                        }
+                        for n in sorted(set(repo.available()) | set(server.models))
+                    ])
+                if len(parts) == 6 and parts[3] == "models" and parts[5] in ("load", "unload"):
+                    name = parts[4]
+                    if parts[5] == "load":
+                        try:
+                            server.register(repo.load(name))
+                        except KeyError as e:
+                            return self._json(404, {"error": str(e)})
+                        except Exception as e:
+                            return self._json(500, {"error": str(e)})
+                        return self._json(200, {"name": name, "state": "READY"})
+                    if not server.unregister(name):
+                        return self._json(404, {"error": f"model {name} not loaded"})
+                    return self._json(200, {"name": name, "state": "UNAVAILABLE"})
+                return self._json(404, {"error": "not found"})
+
             def do_GET(self):
                 if self.path == "/v2/health/ready":
                     return self._json(200, {"ready": True})
@@ -84,6 +133,8 @@ class InferenceServer:
 
             def do_POST(self):
                 parts = self.path.split("/")
+                if len(parts) >= 3 and parts[1] == "v2" and parts[2] == "repository":
+                    return self._repository(parts)
                 if len(parts) < 5 or parts[1] != "v2" or parts[2] != "models" or parts[4] != "infer":
                     return self._json(404, {"error": "not found"})
                 name = parts[3]
@@ -91,6 +142,9 @@ class InferenceServer:
                 model = server.models.get(name)
                 if batcher is None or model is None:
                     return self._json(404, {"error": f"unknown model {name}"})
+                # request parsing/validation errors -> 400; server-side
+                # inference failures -> 500; timeout -> 504 (round-1
+                # conflated them all into 400)
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length))
@@ -102,9 +156,17 @@ class InferenceServer:
                             raise ValueError(f"missing input {meta.name}")
                         dt = _V2_DTYPES.get(t.get("datatype", "FP32"), np.float32)
                         arrays.append(np.asarray(t["data"], dtype=dt).reshape(t["shape"]))
-                    outs = batcher.infer(arrays, timeout=60.0)
+                    fut = batcher.submit(arrays)
+                except RuntimeError as e:  # batcher stopped: server-side
+                    return self._json(500, {"error": str(e)})
                 except Exception as e:
                     return self._json(400, {"error": str(e)})
+                try:
+                    outs = fut.result(timeout=60.0)
+                except TimeoutError:
+                    return self._json(504, {"error": "inference timed out"})
+                except Exception as e:
+                    return self._json(500, {"error": str(e)})
                 resp = {
                     "model_name": name,
                     "outputs": [

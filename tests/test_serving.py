@@ -206,3 +206,134 @@ def test_batcher_restart_after_stop(served_model):
     (out,) = b.infer([np.zeros((1, 16), np.float32)], timeout=30)
     assert out.shape == (1, 4)
     b.stop()
+
+
+# ---------------------------------------------------------------------------
+# round-2 (VERDICT item 10 + ADVICE r1): strategy-parallel inference,
+# model-repository lifecycle, batcher holdover, 400/500 separation
+# ---------------------------------------------------------------------------
+
+
+def test_strategy_parallel_inference_on_mesh():
+    """A searched/tensor-parallel strategy drives multi-device inference
+    (reference: triton/src/strategy.cc loading a partition strategy)."""
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+    from flexflow_tpu.parallel.strategy import megatron_strategy
+
+    cfg = TransformerConfig(num_layers=2, hidden_size=32, num_heads=2, ff_size=64, seq_length=8)
+    config = FFConfig(batch_size=8, workers_per_node=8)
+    m = build_transformer(config, cfg)
+    strategy = megatron_strategy(m.graph, dp=4, tp=2)
+    m.compile(comp_mode=CompMode.INFERENCE, strategy=strategy)
+    assert dict(zip(m.mesh.axis_names, m.mesh.devices.shape)) == {"data": 4, "model": 2}
+    im = InferenceModel(m, name="bert_tp", max_batch=8)
+    x = np.random.RandomState(0).randn(3, 8, 32).astype(np.float32)
+    (out,) = im.infer([x])
+    assert out.shape == (3, 8, 32)
+    assert np.all(np.isfinite(out))
+    # per-device shards actually exist (tp weights split over "model")
+    ex = m.executor
+    sharded = [
+        arr
+        for ws in ex.params.values()
+        for arr in ws.values()
+        if arr.sharding.spec and "model" in str(arr.sharding.spec)
+    ]
+    assert sharded, "no tensor-parallel weight shards found"
+
+
+def test_model_repository_roundtrip(tmp_path):
+    from flexflow_tpu.serving import ModelRepository, save_model
+
+    cfg = FFConfig(batch_size=4, workers_per_node=1)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([4, 6], name="x")
+    t = ff.dense(x, 8, activation="relu", name="fc1")
+    out = ff.softmax(ff.dense(t, 3, name="fc2"))
+    ff.compile(comp_mode=CompMode.INFERENCE, outputs=[out])
+    im = InferenceModel(ff, name="repo_mlp", max_batch=4)
+    xv = np.random.RandomState(1).randn(2, 6).astype(np.float32)
+    (want,) = im.infer([xv])
+
+    repo = ModelRepository(str(tmp_path))
+    repo.save(im)
+    assert repo.available() == ["repo_mlp"]
+    im2 = repo.load("repo_mlp")
+    (got,) = im2.infer([xv])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_repository_http_lifecycle(tmp_path):
+    from flexflow_tpu.serving import ModelRepository, save_model
+
+    cfg = FFConfig(batch_size=4, workers_per_node=1)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([4, 6], name="x")
+    out = ff.softmax(ff.dense(x, 3, name="fc"))
+    ff.compile(comp_mode=CompMode.INFERENCE, outputs=[out])
+    im = InferenceModel(ff, name="lc", max_batch=4)
+    repo = ModelRepository(str(tmp_path))
+    repo.save(im)
+
+    def post(base, path):
+        return urllib.request.urlopen(
+            urllib.request.Request(base + path, data=b"{}", method="POST"))
+
+    server = InferenceServer(port=0, repository=repo)
+    with server:
+        base = f"http://127.0.0.1:{server.port}"
+        idx = json.load(post(base, "/v2/repository/index"))
+        assert idx == [{"name": "lc", "state": "UNAVAILABLE"}]
+        assert json.load(post(base, "/v2/repository/models/lc/load"))["state"] == "READY"
+        idx = json.load(post(base, "/v2/repository/index"))
+        assert idx[0]["state"] == "READY"
+        # it serves
+        xv = np.random.RandomState(2).randn(1, 6).astype(np.float32)
+        req = json.dumps({"inputs": [{"name": "x", "shape": [1, 6], "datatype": "FP32",
+                                      "data": xv.reshape(-1).tolist()}]}).encode()
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"{base}/v2/models/lc/infer", data=req))
+        assert r.status == 200
+        # unload -> infer 404s
+        assert json.load(post(base, "/v2/repository/models/lc/unload"))["state"] == "UNAVAILABLE"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/v2/models/lc/infer", data=req))
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(base, "/v2/repository/models/ghost/load")
+        assert ei.value.code == 404
+
+
+def test_batcher_holds_over_nonfitting_request(served_model):
+    """ADVICE r1: a request that doesn't fit the current batch must lead
+    the NEXT batch, not re-queue behind newer arrivals."""
+    b = DynamicBatcher(served_model, max_delay_s=0.05)
+    rs = np.random.RandomState(4)
+    b.start()
+    try:
+        futs = [
+            b.submit([rs.randn(5, 16).astype(np.float32)]),  # batch 1 (5/8)
+            b.submit([rs.randn(6, 16).astype(np.float32)]),  # doesn't fit -> holds over
+            b.submit([rs.randn(1, 16).astype(np.float32)]),  # joins batch 1
+        ]
+        outs = [f.result(timeout=30) for f in futs]
+        assert [o[0].shape[0] for o in outs] == [5, 6, 1]
+        assert b._pending is None
+    finally:
+        b.stop()
+
+
+def test_server_returns_500_for_stopped_batcher(served_model):
+    server = InferenceServer(port=0)
+    server.register(served_model)
+    with server:
+        base = f"http://127.0.0.1:{server.port}"
+        server.batchers["mlp"].stop()  # simulate backend failure
+        x = np.zeros((1, 16), np.float32)
+        req = json.dumps({"inputs": [{"name": "x", "shape": [1, 16], "datatype": "FP32",
+                                      "data": x.reshape(-1).tolist()}]}).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/v2/models/mlp/infer", data=req))
+        assert ei.value.code == 500
