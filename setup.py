@@ -41,7 +41,10 @@ setup(
     description="TPU-native auto-parallelizing deep learning framework "
     "(FlexFlow/Unity capabilities on JAX/XLA/Pallas)",
     packages=find_packages(include=["flexflow_tpu", "flexflow_tpu.*"]),
-    package_data={"flexflow_tpu._native": ["libffcore.so"]},
+    package_data={
+        "flexflow_tpu._native": ["libffcore.so"],
+        "flexflow_tpu.search": ["calibration_data/*.json"],
+    },
     python_requires=">=3.10",
     install_requires=["jax", "numpy"],
     extras_require={
