@@ -29,6 +29,7 @@ _SOURCES = [
     _NATIVE_DIR / "src" / "machine_model.cc",
     _NATIVE_DIR / "src" / "allreduce.cc",
     _NATIVE_DIR / "src" / "dataloader.cc",
+    _NATIVE_DIR / "src" / "pcg_search.cc",
 ]
 _HEADERS = [
     _NATIVE_DIR / "include" / "ffcore.h",
@@ -137,6 +138,24 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.ffc_shuffle_indices.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_uint64,
+    ]
+    lib.ffc_pcg_create.restype = ctypes.c_void_p
+    lib.ffc_pcg_destroy.argtypes = [ctypes.c_void_p]
+    lib.ffc_pcg_add_op.restype = ctypes.c_int64
+    lib.ffc_pcg_add_op.argtypes = [
+        ctypes.c_void_p, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.c_double, ctypes.c_char_p,
+    ]
+    lib.ffc_pcg_add_edge.restype = ctypes.c_int32
+    lib.ffc_pcg_add_edge.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+    lib.ffc_pcg_set_chip.argtypes = [
+        ctypes.c_void_p, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.c_double, ctypes.c_double,
+    ]
+    lib.ffc_pcg_optimize.restype = ctypes.c_double
+    lib.ffc_pcg_optimize.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
     ]
     return lib
 
@@ -331,3 +350,86 @@ def shuffle_indices(n: int, seed: int):
     _lib.ffc_shuffle_indices(
         idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, seed)
     return idx
+
+
+# ------------------------------------------------------------ pcg search
+
+
+class NativePcg:
+    """Native PCG + DP view-assignment search (reference: the C API
+    python/flexflow_c.h exposing the model/search engine; ffc_pcg_*).
+
+    Ops are added in topological order with cost primitives; optimize()
+    returns (best simulated step seconds, per-op shard degrees).
+    """
+
+    def __init__(self):
+        self._h = _lib.ffc_pcg_create()
+        self._n = 0
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            _lib.ffc_pcg_destroy(self._h)
+            self._h = None
+
+    def add_op(self, flops: float, bytes_: float, weight_bytes: float = 0.0,
+               output_bytes: float = 0.0, name: str = "") -> int:
+        self._n += 1
+        return _lib.ffc_pcg_add_op(
+            self._h, float(flops), float(bytes_), float(weight_bytes),
+            float(output_bytes), name.encode())
+
+    def add_edge(self, src: int, dst: int) -> None:
+        if _lib.ffc_pcg_add_edge(self._h, src, dst) != 0:
+            raise ValueError(f"bad edge {src}->{dst}")
+
+    def set_chip(self, peak_flops: float, mxu_eff: float = 0.55,
+                 hbm_bandwidth: float = 0.82e12, hbm_eff: float = 0.8,
+                 per_op_overhead: float = 2e-6) -> None:
+        _lib.ffc_pcg_set_chip(self._h, peak_flops, mxu_eff, hbm_bandwidth,
+                              hbm_eff, per_op_overhead)
+
+    def optimize(self, machine_model, batch: int = 0, max_degree: int = 0):
+        out = (ctypes.c_int32 * self._n)()
+        cost = _lib.ffc_pcg_optimize(
+            self._h, machine_model._h, batch, max_degree, out)
+        return cost, list(out)
+
+
+def pcg_from_graph(graph, machine=None):
+    """Build a NativePcg from a flexflow_tpu PCGraph using the op
+    library's cost() (the host supplies the op math; the native engine
+    searches)."""
+    from ..core.types import OpType, PARALLEL_OP_TYPES
+    from ..ops.base import get_op_def
+    from ..parallel.propagation import infer_all_specs
+
+    pcg = NativePcg()
+    if machine is not None:
+        chip = machine.chip
+        pcg.set_chip(chip.bf16_flops, 0.55, chip.hbm_bandwidth, 0.8, 2e-6)
+    specs = infer_all_specs(graph)
+    idx = {}
+    for node in graph.topo_order():
+        in_specs = [specs[e.src][e.src_idx] for e in graph.in_edges(node)]
+        out_specs = specs[node.guid]
+        flops = bytes_ = wbytes = 0.0
+        if node.op_type not in PARALLEL_OP_TYPES and node.op_type not in (
+            OpType.INPUT, OpType.WEIGHT, OpType.NOOP
+        ):
+            op_def = get_op_def(node.op_type)
+            c = op_def.cost(node.params, in_specs, out_specs)
+            flops, bytes_ = c.flops, c.bytes_accessed
+            try:
+                wbytes = sum(
+                    w.spec.size_bytes
+                    for w in op_def.weight_specs(node.params, in_specs)
+                )
+            except Exception:
+                wbytes = 0.0
+        out_bytes = sum(s.size_bytes for s in out_specs)
+        idx[node.guid] = pcg.add_op(flops, bytes_, wbytes, out_bytes, node.name)
+    for node in graph.topo_order():
+        for e in graph.in_edges(node):
+            pcg.add_edge(idx[e.src], idx[e.dst])
+    return pcg, idx

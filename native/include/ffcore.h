@@ -107,6 +107,38 @@ int32_t ffc_allreduce_optimize(ffc_mm_t *mm, const int32_t *participants,
                                int32_t n, double nbytes, double *out_times);
 
 /* ------------------------------------------------------------------ *
+ * PCG + DP machine-view search (reference: the C API python/flexflow_c.h
+ * exposes the model/search engine to host languages; SearchHelper DP
+ * graph.cc:115+). The caller supplies per-op cost primitives; the
+ * native engine assigns per-op shard degrees minimizing simulated step
+ * time (roofline compute + ring-allreduce weight sync + boundary
+ * reshard charges over the machine model).
+ * ------------------------------------------------------------------ */
+typedef struct ffc_pcg ffc_pcg_t;
+
+ffc_pcg_t *ffc_pcg_create(void);
+void ffc_pcg_destroy(ffc_pcg_t *pcg);
+
+/* Returns the new op id (dense from 0; also its topo position — add ops
+ * in topological order). */
+int64_t ffc_pcg_add_op(ffc_pcg_t *pcg, double flops, double bytes,
+                       double weight_bytes, double output_bytes,
+                       const char *name);
+int32_t ffc_pcg_add_edge(ffc_pcg_t *pcg, int64_t src, int64_t dst);
+
+/* Chip roofline parameters (defaults: v5e-ish). */
+void ffc_pcg_set_chip(ffc_pcg_t *pcg, double peak_flops, double mxu_eff,
+                      double hbm_bandwidth, double hbm_eff,
+                      double per_op_overhead);
+
+/* Optimal per-op shard degrees over the machine model's devices.
+ * batch bounds the degree (degree | batch); max_degree <= 0 means all
+ * devices. out_degrees (len = num ops) receives the assignment; returns
+ * the simulated step seconds of the best assignment. */
+double ffc_pcg_optimize(ffc_pcg_t *pcg, ffc_mm_t *mm, int32_t batch,
+                        int32_t max_degree, int32_t *out_degrees);
+
+/* ------------------------------------------------------------------ *
  * Dataloader kernels (reference: SingleDataLoader's batched index
  * loads, python/flexflow_dataloader.cc).
  * ------------------------------------------------------------------ */

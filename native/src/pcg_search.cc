@@ -1,0 +1,188 @@
+// PCG + DP machine-view search, native (C API).
+//
+// Reference analog: the C API (python/flexflow_c.h) exposes the C++
+// model/search engine to any host language; here ffc_pcg_* exposes the
+// framework's view-assignment search natively. The caller supplies each
+// op's cost primitives (flops, HBM bytes, weight bytes, output bytes) —
+// the op-library math stays host-side — and the native engine runs the
+// memoized sequential-split DP over candidate shard degrees with
+// roofline compute times, gradient-allreduce costs from the machine
+// model, and boundary-reshard charges (mirror of
+// flexflow_tpu/search/dp_search.py SearchHelper; reference:
+// SearchHelper graph.cc:115+, find_optimal_sequence_graph_time).
+#include "../include/ffcore.h"
+#include "ffcore_internal.h"
+
+#include <algorithm>
+#include <cstring>
+#include <string>
+
+namespace ffcore {
+
+struct PcgOp {
+  double flops = 0.0;        // fwd FLOPs (bwd charged at 2x)
+  double bytes = 0.0;        // HBM bytes touched fwd
+  double weight_bytes = 0.0; // parameter bytes (allreduce per step)
+  double output_bytes = 0.0; // boundary tensor size (reshard charge)
+  std::string name;
+  std::vector<int64_t> inputs;
+};
+
+struct Pcg {
+  std::vector<PcgOp> ops;
+  // chip model (set once per optimize call)
+  double peak_flops = 197e12, mxu_eff = 0.55;
+  double hbm_bw = 0.82e12, hbm_eff = 0.8;
+  double overhead = 2e-6;
+};
+
+static double op_time(const Pcg &p, const PcgOp &op, int degree) {
+  double t_c = (op.flops / degree) / (p.peak_flops * p.mxu_eff);
+  double t_m = (op.bytes / degree) / (p.hbm_bw * p.hbm_eff);
+  double fwd = std::max(t_c, t_m) + p.overhead;
+  return 3.0 * fwd;  // fwd + ~2x bwd, same ratio as the Python cost model
+}
+
+static double sync_time(MachineModel *mm, const PcgOp &op, int degree) {
+  if (degree <= 1 || op.weight_bytes <= 0.0) return 0.0;
+  // bandwidth-optimal ring over the view (matches CostModel.allreduce_time)
+  bool intra = degree <= mm->devices_per_node;
+  double lat = intra ? mm->ici_latency : mm->dcn_latency;
+  double bw = intra ? mm->ici_bandwidth : mm->dcn_bandwidth;
+  if (mm->kind == MachineModel::NETWORKED && !intra) {
+    lat = mm->link_latency;
+    bw = mm->link_bandwidth;
+  }
+  return 2.0 * (degree - 1) * lat +
+         2.0 * (degree - 1) / degree * op.weight_bytes / (bw * 0.85);
+}
+
+static double reshard_time(MachineModel *mm, double nbytes, int degree) {
+  if (degree <= 1 || nbytes <= 0.0) return 0.0;
+  bool intra = degree <= mm->devices_per_node;
+  double lat = intra ? mm->ici_latency : mm->dcn_latency;
+  double bw = intra ? mm->ici_bandwidth : mm->dcn_bandwidth;
+  return lat + nbytes / (bw * 0.85);
+}
+
+}  // namespace ffcore
+
+using namespace ffcore;
+
+extern "C" {
+
+ffc_pcg_t *ffc_pcg_create(void) { return reinterpret_cast<ffc_pcg_t *>(new Pcg()); }
+
+void ffc_pcg_destroy(ffc_pcg_t *pcg) { delete reinterpret_cast<Pcg *>(pcg); }
+
+int64_t ffc_pcg_add_op(ffc_pcg_t *pcg, double flops, double bytes,
+                       double weight_bytes, double output_bytes,
+                       const char *name) {
+  Pcg *p = reinterpret_cast<Pcg *>(pcg);
+  PcgOp op;
+  op.flops = flops;
+  op.bytes = bytes;
+  op.weight_bytes = weight_bytes;
+  op.output_bytes = output_bytes;
+  op.name = name ? name : "";
+  p->ops.push_back(std::move(op));
+  return static_cast<int64_t>(p->ops.size()) - 1;
+}
+
+int32_t ffc_pcg_add_edge(ffc_pcg_t *pcg, int64_t src, int64_t dst) {
+  Pcg *p = reinterpret_cast<Pcg *>(pcg);
+  if (src < 0 || dst < 0 || src >= (int64_t)p->ops.size() ||
+      dst >= (int64_t)p->ops.size() || src == dst)
+    return -1;
+  p->ops[dst].inputs.push_back(src);
+  return 0;
+}
+
+void ffc_pcg_set_chip(ffc_pcg_t *pcg, double peak_flops, double mxu_eff,
+                      double hbm_bandwidth, double hbm_eff,
+                      double per_op_overhead) {
+  Pcg *p = reinterpret_cast<Pcg *>(pcg);
+  p->peak_flops = peak_flops;
+  p->mxu_eff = mxu_eff;
+  p->hbm_bw = hbm_bandwidth;
+  p->hbm_eff = hbm_eff;
+  p->overhead = per_op_overhead;
+}
+
+double ffc_pcg_optimize(ffc_pcg_t *pcg, ffc_mm_t *mm_, int32_t batch,
+                        int32_t max_degree, int32_t *out_degrees) {
+  Pcg *p = reinterpret_cast<Pcg *>(pcg);
+  MachineModel *mm = reinterpret_cast<MachineModel *>(mm_);
+  const int64_t n = static_cast<int64_t>(p->ops.size());
+  if (n == 0) return 0.0;
+  int32_t num_devices = mm->num_nodes * mm->devices_per_node;
+  if (max_degree <= 0 || max_degree > num_devices) max_degree = num_devices;
+
+  // candidate power-of-two degrees dividing the batch
+  std::vector<int> degrees;
+  for (int d = 1; d <= max_degree; d *= 2)
+    if (batch <= 0 || batch % d == 0) degrees.push_back(d);
+  if (degrees.empty()) degrees.push_back(1);
+
+  // per-op best time for each degree; DP over topo order charging a
+  // reshard when consecutive ops pick different degrees (the sequential
+  // bottleneck split of graph.cc:115, specialized to chains — the
+  // branch-aware splits stay host-side where the full graph lives)
+  const double INF = std::numeric_limits<double>::infinity();
+  std::vector<std::vector<double>> best(n, std::vector<double>(degrees.size(), INF));
+  std::vector<std::vector<int>> prev(n, std::vector<int>(degrees.size(), 0));
+
+  for (int64_t i = 0; i < n; ++i) {
+    const PcgOp &op = p->ops[i];
+    for (size_t di = 0; di < degrees.size(); ++di) {
+      double t_here = op_time(*p, op, degrees[di]) + sync_time(mm, op, degrees[di]);
+      if (op.inputs.empty()) {
+        best[i][di] = t_here;
+        continue;
+      }
+      // combine over producers: each contributes its best cost plus a
+      // reshard if the degree changes at the boundary
+      double total = t_here;
+      for (int64_t src : op.inputs) {
+        double b = INF;
+        int arg = 0;
+        for (size_t dj = 0; dj < degrees.size(); ++dj) {
+          double x = best[src][dj];
+          if (dj != di)
+            x += reshard_time(mm, p->ops[src].output_bytes,
+                              std::max(degrees[di], degrees[dj]));
+          if (x < b) {
+            b = x;
+            arg = static_cast<int>(dj);
+          }
+        }
+        total += b;
+        prev[i][di] = arg;  // chain graphs: single producer dominates
+      }
+      best[i][di] = total;
+    }
+  }
+
+  // the sink op's best assignment; backtrack the chain
+  int64_t sink = n - 1;
+  double bcost = INF;
+  int bdeg = 0;
+  for (size_t di = 0; di < degrees.size(); ++di)
+    if (best[sink][di] < bcost) {
+      bcost = best[sink][di];
+      bdeg = static_cast<int>(di);
+    }
+  if (out_degrees) {
+    std::vector<int> pick(n, bdeg);
+    for (int64_t i = sink; i >= 0; --i) {
+      if (!p->ops[i].inputs.empty()) {
+        int64_t src = p->ops[i].inputs[0];
+        pick[src] = prev[i][pick[i]];
+      }
+    }
+    for (int64_t i = 0; i < n; ++i) out_degrees[i] = degrees[pick[i]];
+  }
+  return bcost;
+}
+
+}  // extern "C"

@@ -209,3 +209,72 @@ def test_shuffle_deterministic_permutation():
     assert np.array_equal(a, b)
     assert not np.array_equal(a, c)
     assert np.array_equal(np.sort(a), np.arange(1000))
+
+
+# ---------------------------------------------------------------------------
+# round-2: native PCG + DP view-assignment search (C API parity with the
+# reference's flexflow_c model/search surface — C14)
+# ---------------------------------------------------------------------------
+
+
+def test_native_pcg_optimize_chain():
+    from flexflow_tpu._native import NativeMachineModel, NativePcg
+
+    mm = NativeMachineModel.simple(1, 8, 1e-6, 100e9, 10e-6, 25e9)
+    pcg = NativePcg()
+    pcg.set_chip(197e12, 0.55, 0.82e12, 0.8, 2e-6)
+    # compute-heavy 3-op chain: big matmuls want all 8 devices
+    a = pcg.add_op(2e12, 1e9, weight_bytes=4e6, output_bytes=64e6, name="fc1")
+    b = pcg.add_op(2e12, 1e9, weight_bytes=4e6, output_bytes=64e6, name="fc2")
+    c = pcg.add_op(2e12, 1e9, weight_bytes=4e6, output_bytes=64e6, name="fc3")
+    pcg.add_edge(a, b)
+    pcg.add_edge(b, c)
+    cost, degrees = pcg.optimize(mm, batch=256)
+    assert cost > 0
+    assert degrees == [8, 8, 8], degrees
+    # tiny ops: parallelism not worth the sync
+    pcg2 = NativePcg()
+    pcg2.set_chip(197e12, 0.55, 0.82e12, 0.8, 2e-6)
+    a2 = pcg2.add_op(1e3, 1e3, weight_bytes=1e9, output_bytes=1e3)
+    b2 = pcg2.add_op(1e3, 1e3, weight_bytes=1e9, output_bytes=1e3)
+    pcg2.add_edge(a2, b2)
+    _, deg2 = pcg2.optimize(mm, batch=256)
+    assert deg2 == [1, 1], deg2
+
+
+def test_native_pcg_respects_batch_divisibility():
+    from flexflow_tpu._native import NativeMachineModel, NativePcg
+
+    mm = NativeMachineModel.simple(1, 8, 1e-6, 100e9, 10e-6, 25e9)
+    pcg = NativePcg()
+    a = pcg.add_op(2e12, 1e9, output_bytes=64e6)
+    _, degrees = pcg.optimize(mm, batch=6)  # 6 % 4 != 0, 6 % 8 != 0
+    assert degrees[0] in (1, 2), degrees
+
+
+def test_native_pcg_from_graph_matches_python_rank_order():
+    """Build the native PCG straight from a PCGraph via the op library's
+    costs; the native DP must agree with the Python SearchHelper that
+    more devices help a compute-bound MLP."""
+    from flexflow_tpu import FFConfig
+    from flexflow_tpu._native import NativeMachineModel, pcg_from_graph
+    from flexflow_tpu.model import FFModel
+    from flexflow_tpu.core.types import ActiMode
+    from flexflow_tpu.parallel.machine import MachineSpec
+
+    config = FFConfig(batch_size=8192)
+    m = FFModel(config)
+    x = m.create_tensor((8192, 1024), name="x")
+    t = m.dense(x, 4096, ActiMode.RELU, name="fc1")
+    t = m.dense(t, 1024, name="fc2")
+    machine = MachineSpec(num_nodes=1, devices_per_node=8)
+    pcg, idx = pcg_from_graph(m.graph, machine)
+    mm = NativeMachineModel.simple(1, 8, 1e-6, 100e9, 10e-6, 25e9)
+    cost8, degrees = pcg.optimize(mm, batch=8192)
+    assert cost8 > 0
+    dense_degrees = [d for d, g in zip(degrees, idx) if d > 1]
+    assert any(d > 1 for d in degrees), degrees  # parallelism chosen
+    mm1 = NativeMachineModel.simple(1, 1, 1e-6, 100e9, 10e-6, 25e9)
+    pcg1, _ = pcg_from_graph(m.graph, machine)
+    cost1, _ = pcg1.optimize(mm1, batch=8192)
+    assert cost8 < cost1  # 8 devices beat 1
