@@ -1,0 +1,43 @@
+"""Pipeline-parallel training from compile() (round-2 capability).
+
+The reference has NO pipeline implementation (OP_PIPELINE is a
+placeholder enum, ffconst.h:160); here `FFConfig(pipeline_stages=S)`
+auto-detects the transformer's repeated block stack, stacks stage params
+[S, r, ...] over the "pipe" mesh axis, and trains under the GPipe
+schedule (lax.scan + ppermute).
+
+Run on any machine:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  JAX_PLATFORMS=cpu python examples/pipeline_parallel.py
+"""
+import numpy as np
+
+from flexflow_tpu import FFConfig, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import TransformerConfig, build_transformer
+
+
+def main():
+    import jax
+
+    n_dev = len(jax.devices())
+    pp = max(d for d in (4, 2, 1) if n_dev % d == 0 and d <= n_dev)
+    cfg = TransformerConfig(num_layers=2 * pp, hidden_size=128, num_heads=4, ff_size=512, seq_length=64)
+    config = FFConfig(batch_size=32, pipeline_stages=pp, epochs=2)
+    model = build_transformer(config, cfg)
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    print("mesh:", dict(zip(model.mesh.axis_names, model.mesh.devices.shape)))
+    pa = model.strategy.pipeline
+    print(f"pipeline: {pa.n_stages} stages x {cfg.num_layers // pa.n_stages} blocks, "
+          f"{pa.n_microbatches} microbatches")
+    rs = np.random.RandomState(0)
+    X = rs.randn(128, cfg.seq_length, cfg.hidden_size).astype(np.float32)
+    Y = 0.5 * X
+    model.fit(X, Y, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    main()
