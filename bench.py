@@ -1,18 +1,26 @@
-"""Benchmark driver: BERT training throughput, searched strategy vs data-parallel.
+"""Benchmark driver: transformer training throughput, searched strategy
+vs data-parallel vs tensor-parallel.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 The reference's headline is searched-strategy vs data-parallel on identical
-hardware (scripts/osdi22ae/bert.sh); we report both MFUs.  vs_baseline is
-the searched MFU relative to the 45%-MFU north star from BASELINE.json.
+hardware (scripts/osdi22ae/bert.sh); we report MFU for each strategy plus
+simulator-validation ratios (predicted/measured) and the rank agreement
+between simulated and measured strategy ordering.
 
-Resilience (round-1 failure mode: the tunneled 'axon' TPU backend errored
-at init and the bench died with no JSON, BENCH_r01.json rc=1): the parent
-process re-execs the actual benchmark as a child with retry + backoff; if
-the TPU never comes up it falls back to CPU so a parseable JSON line is
-always produced.
+TPU acquisition is a CAMPAIGN, not a retry (round-2 failure mode: 4x90s
+probes gave up after ~7 min while the backend hung): explicit
+JAX_PLATFORMS=tpu probes with exponential backoff under a total budget of
+FF_BENCH_TPU_BUDGET_S (default 780s), each attempt's stderr recorded.
+On first TPU contact the calibration suite runs and the measured op-cost
+table is written both to the user cache and to the committed factory dir
+(flexflow_tpu/search/calibration_data/) — reference analog: measured op
+costs feeding the search, src/runtime/simulator.cc:588-628.
 
-Peak FLOPs are derived from the detected chip (device_kind), not
-hardcoded (round-1 weakness: v5e 197e12 was assumed).
+If the TPU never comes up the bench falls back to CPU on an 8-virtual-
+device mesh (xla_force_host_platform_device_count) so dp-vs-searched
+still exercises distinct strategies, the model is shrunk, and the metric
+is renamed accordingly (a 4-layer/256-hidden model must not report a
+bert_base metric).
 """
 from __future__ import annotations
 
@@ -21,6 +29,7 @@ import os
 import subprocess
 import sys
 import time
+from pathlib import Path
 
 import numpy as np
 
@@ -80,6 +89,29 @@ def _bench_one(ex, batch, cfg, iters):
     return dt / iters
 
 
+def _capture_calibration(backend: str, kind: str):
+    """On TPU contact, run the calibration suite and persist the measured
+    table into the committed factory dir so every later search on this
+    chip kind is calibrated (VERDICT r2 missing #1). Returns the repo
+    path or None."""
+    if backend == "cpu":
+        return None
+    try:
+        from flexflow_tpu.search.calibration import _slug, load_or_calibrate
+
+        cal = load_or_calibrate(allow_measure=True, device_kind=kind)
+        if not cal.entries:
+            return None
+        repo_dir = Path(__file__).parent / "flexflow_tpu" / "search" / "calibration_data"
+        path = repo_dir / f"opcosts_{_slug(kind)}.json"
+        cal.save(path)
+        print(f"calibration table written: {path}", file=sys.stderr)
+        return str(path)
+    except Exception as e:
+        print(f"calibration capture failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def child_main():
     import jax
 
@@ -98,6 +130,8 @@ def child_main():
     kind = getattr(devs[0], "device_kind", backend)
     peak = peak_flops_per_device(kind, backend) * n_dev
 
+    calibration_path = _capture_calibration(backend, kind)
+
     # BERT-Base-shaped encoder, bf16 activations (flash attention on TPU)
     cfg = TransformerConfig(
         num_layers=12,
@@ -109,14 +143,17 @@ def child_main():
     )
     batch = 16 * n_dev
     iters = 40 if backend != "cpu" else 3
-    if backend == "cpu":  # keep the fallback path fast enough to finish
+    metric = "bert_base_seq128_train_throughput"
+    if backend == "cpu":  # keep the fallback path fast enough to finish;
+        # the metric name must describe the model actually run (ADVICE r2)
         cfg = TransformerConfig(
             num_layers=4, hidden_size=256, num_heads=4, ff_size=1024,
             seq_length=128, dtype=DataType.BFLOAT16,
         )
         batch = 4 * n_dev
+        metric = "tiny_transformer_4l_h256_seq128_train_throughput"
 
-    def build(only_dp: bool, budget: int):
+    def build(only_dp: bool, budget: int, strategy=None):
         config = FFConfig(
             batch_size=batch,
             workers_per_node=n_dev,
@@ -125,24 +162,90 @@ def child_main():
             search_budget=budget,
         )
         model = build_transformer(config, cfg)
-        model.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=LossType.MEAN_SQUARED_ERROR)
+        model.compile(
+            optimizer=SGDOptimizer(lr=0.01),
+            loss_type=LossType.MEAN_SQUARED_ERROR,
+            strategy=strategy,
+        )
         return model
 
     model_dp = build(only_dp=True, budget=0)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(model_dp.executor.params))
     flops_per_token = 6.0 * n_params
     step_dp = _bench_one(model_dp.executor, batch, cfg, iters)
+    graph = model_dp.graph
+    del model_dp
 
-    # simulator validation (VERDICT r1 weakness 4): predicted vs measured
+    # ---- honest simulator validation (VERDICT r2 weak #2): on CPU the
+    # chip spec must be a CPU spec calibrated against measurement, never a
+    # v5p roofline compared to a CPU wall clock
+    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.search.calibration import chip_spec_for, load_or_calibrate
+
+    chip = chip_spec_for(kind) if backend != "cpu" else chip_spec_for("cpu")
+    # calibrate against the UNSCALED chip (the suite runs on one device
+    # with the whole machine behind it); the derates must not bake in the
+    # virtual-device scaling below or the two factors cancel
+    cal_machine = MachineSpec(num_nodes=1, devices_per_node=n_dev, chip=chip)
+    calibration = load_or_calibrate(cal_machine, allow_measure=True, device_kind=kind)
+    if backend == "cpu" and n_dev > 1:
+        # N virtual CPU devices share ONE physical machine (thread pool):
+        # per-device peak is 1/N of what the single-device calibration
+        # suite measures
+        import dataclasses as _dc
+
+        chip = _dc.replace(
+            chip,
+            bf16_flops=chip.bf16_flops / n_dev,
+            f32_flops=chip.f32_flops / n_dev,
+            hbm_bandwidth=chip.hbm_bandwidth / n_dev,
+        )
+    machine = MachineSpec(num_nodes=1, devices_per_node=n_dev, chip=chip)
+
     sim_dp_ratio = None
+    pred = {}
     try:
-        from flexflow_tpu.search.unity import predict_step_time
+        from flexflow_tpu.parallel.strategy import (
+            data_parallel_strategy,
+            megatron_strategy,
+        )
+        from flexflow_tpu.search.simulator import predict_strategy_time
 
-        pred_dp = predict_step_time(model_dp.graph, model_dp.config)
-        sim_dp_ratio = round(pred_dp / step_dp, 3)
+        strategies = {"dp": data_parallel_strategy(graph, n_dev)}
+        # tp and hybrid candidates (skip shapes that don't divide)
+        if n_dev >= 2 and cfg.num_heads % 2 == 0:
+            strategies["tp"] = megatron_strategy(graph, dp=1, tp=min(n_dev, cfg.num_heads))
+            if n_dev >= 4:
+                strategies["hybrid"] = megatron_strategy(graph, dp=n_dev // 2, tp=2)
+        for name, st in strategies.items():
+            try:  # one failing candidate must not discard the others
+                pred[name] = predict_strategy_time(graph, st, machine, calibration=calibration)
+            except Exception as e:
+                print(f"{name} prediction failed: {e!r}", file=sys.stderr)
     except Exception as e:
         print(f"simulator prediction failed: {e!r}", file=sys.stderr)
-        pred_dp = None
+    sim_dp_ratio = round(pred["dp"] / step_dp, 3) if pred.get("dp") else None
+
+    # ---- measure tp / hybrid so simulated vs measured rank order is a
+    # reported fact, not an assumption (VERDICT r2 next-round #2)
+    measured = {"dp": step_dp}
+    for name in ("tp", "hybrid"):
+        if name not in pred:
+            continue
+        try:
+            m = build(only_dp=True, budget=0, strategy=strategies[name])
+            measured[name] = _bench_one(m.executor, batch, cfg, iters)
+            del m
+        except Exception as e:
+            print(f"{name} strategy bench failed: {e!r}", file=sys.stderr)
+    rank_agreement = best_agreement = None
+    sim_ratios = {}
+    if len(measured) >= 2 and all(n in pred for n in measured):
+        sim_rank = sorted(measured, key=lambda n: pred[n])
+        meas_rank = sorted(measured, key=lambda n: measured[n])
+        rank_agreement = sim_rank == meas_rank
+        best_agreement = sim_rank[0] == meas_rank[0]
+        sim_ratios = {n: round(pred[n] / measured[n], 3) for n in measured}
 
     t_search = time.perf_counter()
     step_s = sim_s_ratio = None
@@ -150,9 +253,17 @@ def child_main():
         model_s = build(only_dp=False, budget=5)
         search_s = time.perf_counter() - t_search
         step_s = _bench_one(model_s.executor, batch, cfg, iters)
-        sr = getattr(model_s, "_search_result", None)
-        if sr is not None and sr.best_cost > 0:
-            sim_s_ratio = round(sr.best_cost / step_s, 3)
+        # predict the searched strategy with the SAME machine/calibration
+        # as the other ratios (the search's internal best_cost is costed
+        # against the TPU chip it optimizes for, which is no signal when
+        # the bench ran on a different backend)
+        try:
+            pred_s = predict_strategy_time(
+                model_s.graph, model_s.strategy, machine, calibration=calibration
+            )
+            sim_s_ratio = round(pred_s / step_s, 3)
+        except Exception as e:
+            print(f"searched-strategy prediction failed: {e!r}", file=sys.stderr)
     except Exception as e:  # searched path must never kill the bench
         search_s = time.perf_counter() - t_search
         print(f"searched-strategy bench failed: {e!r}", file=sys.stderr)
@@ -170,7 +281,7 @@ def child_main():
     dp_mfu, searched_mfu = mfu(step_dp), mfu(step_s)
     headline = mfu(headline_step)
     result = {
-        "metric": "bert_base_seq128_train_throughput",
+        "metric": metric,
         "value": round(samples_per_s, 2),
         "unit": "samples/s",
         "vs_baseline": round(headline / 0.45, 4),
@@ -183,12 +294,19 @@ def child_main():
             "peak_flops": peak,
             "dp_step_ms": round(step_dp * 1e3, 2),
             "searched_step_ms": round(step_s * 1e3, 2) if step_s is not None else None,
+            "tp_step_ms": round(measured["tp"] * 1e3, 2) if "tp" in measured else None,
+            "hybrid_step_ms": round(measured["hybrid"] * 1e3, 2) if "hybrid" in measured else None,
             "dp_mfu": dp_mfu,
             "searched_mfu": searched_mfu,
             "mfu": headline,
             "search_s": round(search_s, 1),
             "sim_pred_over_measured_dp": sim_dp_ratio,
             "sim_pred_over_measured_searched": sim_s_ratio,
+            "sim_pred_over_measured": sim_ratios or None,
+            "sim_rank_agreement": rank_agreement,
+            "sim_best_strategy_agreement": best_agreement,
+            "calibration_table": calibration_path,
+            "calibration_kind": calibration.device_kind,
         },
     }
     print(json.dumps(result))
@@ -207,8 +325,14 @@ def _run_child(args, extra_env=None, timeout=None):
             cwd=os.path.dirname(os.path.abspath(__file__)),
             timeout=timeout,
         )
-    except subprocess.TimeoutExpired:
-        return None, f"timed out after {timeout}s"
+    except subprocess.TimeoutExpired as e:
+        tail = ""
+        for label, s in (("stderr", e.stderr), ("stdout", e.stdout)):
+            if s:
+                text = s.decode(errors="replace") if isinstance(s, bytes) else s
+                tail = f"; {label}: {text[-300:]}"
+                break
+        return None, f"timed out after {timeout:.0f}s{tail}"
     sys.stderr.write(proc.stderr[-4000:])
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
@@ -221,9 +345,15 @@ def _run_child(args, extra_env=None, timeout=None):
     return None, f"rc={proc.returncode}: {tail}"
 
 
+# the probe runs a real (tiny) matmul so a backend that initializes but
+# hangs at dispatch is caught at probe time, not mid-bench
 _PROBE = (
-    "import jax, json; d = jax.devices(); "
-    "print(json.dumps({'metric': 'probe', 'backend': jax.default_backend(), 'n': len(d)}))"
+    "import os, json; os.environ['JAX_PLATFORMS'] = 'tpu'; import jax; "
+    "jax.config.update('jax_platforms', 'tpu'); d = jax.devices(); "
+    "import jax.numpy as jnp; x = jnp.ones((256, 256), jnp.bfloat16); "
+    "v = float((x @ x).sum()); "
+    "print(json.dumps({'metric': 'probe', 'backend': jax.default_backend(), "
+    "'n': len(d), 'kind': getattr(d[0], 'device_kind', ''), 'sum': v}))"
 )
 
 
@@ -231,34 +361,52 @@ def main():
     me = os.path.abspath(__file__)
     errors = []
     tpu_ok = False
-    # Backend init over the tunnel can hang, not just error (round-1 it
-    # errored; this session it hangs) — probe it in a killable child first.
-    for delay in (0, 5, 15, 30):
-        if delay:
-            time.sleep(delay)
-        obj, err = _run_child(["-c", _PROBE], timeout=90)
-        if obj is not None:
-            tpu_ok = obj.get("backend") != "cpu"
+    # TPU acquisition campaign (VERDICT r2 next-round #1): explicit
+    # JAX_PLATFORMS=tpu, total budget ~13 min, exponential backoff,
+    # per-attempt timeout 150s, full stderr capture per attempt.
+    budget = float(os.environ.get("FF_BENCH_TPU_BUDGET_S", "780"))
+    start = time.monotonic()
+    delays = [0, 10, 20, 40, 60, 90]
+    attempt = 0
+    while True:
+        elapsed = time.monotonic() - start
+        if elapsed >= budget:
+            errors.append(f"budget exhausted after {elapsed:.0f}s / {attempt} probes")
             break
-        errors.append(f"probe: {err}")
+        delay = delays[min(attempt, len(delays) - 1)]
+        if delay:
+            time.sleep(min(delay, max(0.0, budget - (time.monotonic() - start))))
+        per_try = min(150.0, max(30.0, budget - (time.monotonic() - start)))
+        obj, err = _run_child(["-c", _PROBE], {"JAX_PLATFORMS": "tpu"}, timeout=per_try)
+        if obj is not None and obj.get("backend") not in (None, "cpu"):
+            tpu_ok = True
+            break
+        errors.append(f"probe[{attempt}] t+{elapsed:.0f}s: {err or 'backend=cpu'}")
+        attempt += 1
     if tpu_ok:
-        obj, err = _run_child([me], timeout=1800)
+        obj, err = _run_child([me], {"JAX_PLATFORMS": "tpu"}, timeout=2400)
         if obj is not None:
             print(json.dumps(obj))
             return
         errors.append(f"bench: {err}")
-    # TPU never came up (or bench died on it): CPU fallback so the
-    # driver still gets a parseable number
-    obj, err = _run_child([me], {"JAX_PLATFORMS": "cpu"}, timeout=1800)
+    # TPU never came up (or bench died on it): CPU fallback on an
+    # 8-virtual-device mesh so dp-vs-searched still compares distinct
+    # strategies (ADVICE r2: a devices=1 comparison carries no signal)
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    cpu_env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (xla_flags + " --xla_force_host_platform_device_count=8").strip(),
+    }
+    obj, err = _run_child([me], cpu_env, timeout=2400)
     if obj is not None:
         if errors:
             obj.setdefault("extra", {})["fallback"] = "cpu_after_tpu_failure"
-            obj["extra"]["tpu_errors"] = [e[-200:] for e in errors]
+            obj["extra"]["tpu_errors"] = [e[-400:] for e in errors]
         print(json.dumps(obj))
         return
     errors.append(f"cpu: {err}")
     print(json.dumps({
-        "metric": "bert_base_seq128_train_throughput",
+        "metric": "train_throughput_bench_failed",
         "value": 0.0,
         "unit": "samples/s",
         "vs_baseline": 0.0,
