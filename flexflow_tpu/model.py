@@ -526,8 +526,13 @@ class FFModel:
         self.comp_mode = comp_mode
         self._outputs = list(outputs) if outputs else [self._default_output()]
         num_devices = self.config.num_devices
+        from .parallel.distributed import maybe_initialize_from_env
         from .parallel.mesh import build_mesh
         from .parallel.strategy import data_parallel_strategy
+
+        # multi-host entry (reference: GASNet multi-node; here one process
+        # per host joins via jax.distributed when the env declares a job)
+        maybe_initialize_from_env()
 
         if strategy is not None:
             self.strategy = strategy

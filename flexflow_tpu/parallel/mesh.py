@@ -41,6 +41,13 @@ def build_mesh(
     sizes = {k: v for k, v in axis_sizes.items() if v > 1}
     if not sizes:
         sizes = {DATA_AXIS: 1}
+    if devices is None and jax.process_count() > 1:
+        # multi-host job: one axis spans hosts over DCN, the rest stay
+        # inside a host on ICI (parallel/distributed.py)
+        from .distributed import multihost_mesh_arrays
+
+        dev_array, names = multihost_mesh_arrays(sizes)
+        return Mesh(dev_array, names)
     if devices is None:
         devices = jax.devices()
     total = int(np.prod(list(sizes.values())))

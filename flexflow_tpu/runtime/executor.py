@@ -223,7 +223,7 @@ class CompiledExecutor:
         from jax.sharding import NamedSharding
 
         spec = self.strategy.weight_spec(guid, name) if self.strategy else None
-        return jax.device_put(arr, NamedSharding(self.mesh, to_partition_spec(spec)))
+        return _put_global(arr, NamedSharding(self.mesh, to_partition_spec(spec)), full=True)
 
     # ----------------------------------------------------------- forward
     def _forward_impl(self, params, state, inputs: Sequence[jax.Array], rng, training: bool):
@@ -418,6 +418,8 @@ class CompiledExecutor:
 
     def train_batch(self, inputs: Sequence[jax.Array], label: jax.Array, rng: jax.Array) -> Dict[str, Any]:
         inputs = self._shard_inputs(inputs)
+        if jax.process_count() > 1:
+            label = self.shard_label(label)
         self.params, self.opt_state, self.state, mets = self._train_step(
             self.params, self.opt_state, self.state, tuple(inputs), label, rng
         )
@@ -425,6 +427,8 @@ class CompiledExecutor:
 
     def eval_batch(self, inputs: Sequence[jax.Array], label: jax.Array, rng: Optional[jax.Array] = None) -> Dict[str, Any]:
         inputs = self._shard_inputs(inputs)
+        if jax.process_count() > 1:
+            label = self.shard_label(label)
         if rng is None:
             rng = jax.random.key(0)
         return self._eval_step(self.params, self.state, tuple(inputs), label, rng)
@@ -460,7 +464,36 @@ class CompiledExecutor:
         if self.mesh is None:
             return [jnp.asarray(x) for x in inputs]
         shardings, _ = self.input_shardings()
-        return [jax.device_put(jnp.asarray(x), s) for x, s in zip(inputs, shardings)]
+        return [_put_global(jnp.asarray(x), s, full=False) for x, s in zip(inputs, shardings)]
+
+    def shard_label(self, label):
+        """Place a label batch on the mesh (multi-host: ``label`` is this
+        process's shard of the global batch)."""
+        if self.mesh is None:
+            return jnp.asarray(label)
+        _, ls = self.input_shardings()
+        if ls is None:
+            return jnp.asarray(label)
+        return _put_global(jnp.asarray(label), ls, full=False)
+
+
+def _put_global(x, sharding, full: bool):
+    """Place host data on a (possibly multi-host) sharding. Single
+    process: plain device_put. Multi-process, ``full=True``: ``x`` is the
+    complete global array on every process (weights — deterministic init
+    computes them identically everywhere), and each process slices its
+    addressable shards from it, which stays correct whichever mesh axis
+    rides DCN. ``full=False``: ``x`` is this process's slice of the
+    global batch (the TPU-native analog of the reference's per-node
+    dataloader partitions, flexflow_dataloader.cc)."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    import numpy as np
+
+    arr = np.asarray(x)
+    if full:
+        return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+    return jax.make_array_from_process_local_data(sharding, arr)
 
 
 def _apply_state_updates(state, updates: Dict, graph: PCGraph):

@@ -1,0 +1,73 @@
+"""Worker process for the multi-host execution test (the TPU-native
+analog of the reference's MPI-on-localhost multinode harness,
+/root/reference/tests/multinode_helpers/mpi_wrapper1.sh): each process is
+one "host" with 4 virtual CPU devices; jax.distributed + gloo provide the
+cross-process collectives; ONE global dp x tp SPMD program runs on all.
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port>
+"""
+import os
+import sys
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["FF_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+os.environ["FF_NUM_PROCESSES"] = str(nproc)
+os.environ["FF_PROCESS_ID"] = str(pid)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.parallel.strategy import megatron_strategy
+
+GLOBAL_BATCH = 16
+HIDDEN = 32
+
+
+def main():
+    # dp=4 across 2 hosts (DCN) x tp=2 inside each host (ICI analog)
+    config = FFConfig(batch_size=GLOBAL_BATCH, num_nodes=nproc, workers_per_node=4)
+    m = FFModel(config)
+    x = m.create_tensor((GLOBAL_BATCH, HIDDEN), name="x")
+    t = m.dense(x, 64, name="ff1")
+    t = m.relu(t)
+    t = m.dense(t, HIDDEN, name="ff2")
+    strategy = megatron_strategy(m.graph, dp=4, tp=2)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.MEAN_SQUARED_ERROR,
+        strategy=strategy,
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.device_count() == 4 * nproc
+    mesh_shape = dict(zip(m.mesh.axis_names, m.mesh.devices.shape))
+    assert mesh_shape == {"data": 4, "model": 2}, mesh_shape
+
+    # per-process batch shard (executor contract: each host feeds its own
+    # slice of the global batch, reference dataloader-style)
+    rs = np.random.RandomState(0)
+    xg = rs.randn(GLOBAL_BATCH, HIDDEN).astype(np.float32)
+    yg = rs.randn(GLOBAL_BATCH, HIDDEN).astype(np.float32)
+    lo = pid * (GLOBAL_BATCH // nproc)
+    hi = lo + GLOBAL_BATCH // nproc
+    xl, yl = xg[lo:hi], yg[lo:hi]
+
+    losses = []
+    for _ in range(3):
+        mets = m.executor.train_batch([xl], yl, jax.random.key(0))
+        losses.append(float(mets["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    print(f"MULTIHOST_OK pid={pid} losses={losses}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
