@@ -30,6 +30,7 @@ _SOURCES = [
     _NATIVE_DIR / "src" / "allreduce.cc",
     _NATIVE_DIR / "src" / "dataloader.cc",
     _NATIVE_DIR / "src" / "pcg_search.cc",
+    _NATIVE_DIR / "src" / "model_capi.cc",
 ]
 _HEADERS = [
     _NATIVE_DIR / "include" / "ffcore.h",
@@ -52,15 +53,30 @@ def _build() -> None:
     # compile to a per-process temp path, then rename atomically so a
     # concurrent process never dlopens a half-written library
     tmp = _LIB_PATH.with_suffix(f".so.tmp{os.getpid()}")
-    cmd = [
-        os.environ.get("CXX", "g++"),
-        "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
-        "-I", str(_NATIVE_DIR / "include"),
-        *[str(s) for s in _SOURCES],
-        "-o", str(tmp),
-    ]
+    import sysconfig
+
+    def cmd_for(sources):
+        return [
+            os.environ.get("CXX", "g++"),
+            "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+            "-I", str(_NATIVE_DIR / "include"),
+            # model_capi.cc embeds CPython (reference analog:
+            # python/main.cc); symbols resolve from the hosting process
+            # or the -lpython of a pure-C embedder, so no -lpython here
+            "-I", sysconfig.get_path("include"),
+            *[str(s) for s in sources],
+            "-o", str(tmp),
+        ]
+
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        try:
+            subprocess.run(cmd_for(_SOURCES), check=True, capture_output=True, timeout=120)
+        except subprocess.CalledProcessError:
+            # no CPython dev headers: drop the embedded-interpreter model
+            # C API but keep every other native component (simulator,
+            # search, allreduce, dataloader) instead of losing them all
+            slim = [s for s in _SOURCES if s.name != "model_capi.cc"]
+            subprocess.run(cmd_for(slim), check=True, capture_output=True, timeout=120)
         os.replace(tmp, _LIB_PATH)
     finally:
         if tmp.exists():
@@ -154,6 +170,11 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.ffc_pcg_optimize.restype = ctypes.c_double
     lib.ffc_pcg_optimize.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.ffc_pcg_uniform_best.restype = ctypes.c_double
+    lib.ffc_pcg_uniform_best.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int32),
     ]
@@ -394,6 +415,14 @@ class NativePcg:
         cost = _lib.ffc_pcg_optimize(
             self._h, machine_model._h, batch, max_degree, out)
         return cost, list(out)
+
+    def uniform_best(self, machine_model, batch: int = 0, max_degree: int = 0):
+        """(cost, degree) of the best SHARED degree — the DP leaf scan
+        (dp_search.py _leaf_cost) as a native fast path."""
+        out = ctypes.c_int32(1)
+        cost = _lib.ffc_pcg_uniform_best(
+            self._h, machine_model._h, batch, max_degree, ctypes.byref(out))
+        return cost, int(out.value)
 
 
 def pcg_from_graph(graph, machine=None):

@@ -138,6 +138,51 @@ void ffc_pcg_set_chip(ffc_pcg_t *pcg, double peak_flops, double mxu_eff,
 double ffc_pcg_optimize(ffc_pcg_t *pcg, ffc_mm_t *mm, int32_t batch,
                         int32_t max_degree, int32_t *out_degrees);
 
+/* One SHARED degree for the whole graph (the DP leaf's uniform-view
+ * scan, dp_search.py _leaf_cost): returns the best cost, *out_degree
+ * receives the chosen power-of-two degree. */
+double ffc_pcg_uniform_best(ffc_pcg_t *pcg, ffc_mm_t *mm, int32_t batch,
+                            int32_t max_degree, int32_t *out_degree);
+
+/* ------------------------------------------------------------------ *
+ * Full-model C API (reference: python/flexflow_c.h wraps FFModel for
+ * host languages). Here the compute path is JAX/XLA, so these entry
+ * points embed a CPython interpreter (like the reference's
+ * python/main.cc) and drive the framework through it: a pure-C host
+ * linking libffcore + libpython builds, unity-compiles, and trains a
+ * model with no Python source of its own. The host process must have
+ * flexflow_tpu importable (PYTHONPATH) and should set JAX_PLATFORMS.
+ * ------------------------------------------------------------------ */
+typedef struct ffc_model ffc_model_t;
+
+ffc_model_t *ffc_model_create(int32_t batch_size, int32_t workers_per_node,
+                              int32_t num_nodes, int32_t search_budget);
+void ffc_model_destroy(ffc_model_t *model);
+
+/* Tensor handles are dense int64 ids (-1 on error). */
+int64_t ffc_model_input(ffc_model_t *model, const int64_t *dims,
+                        int32_t ndims, const char *name);
+/* activation: "none" | "relu" | "sigmoid" | "tanh" | "gelu" */
+int64_t ffc_model_dense(ffc_model_t *model, int64_t input, int32_t out_dim,
+                        const char *activation, const char *name);
+int64_t ffc_model_mha(ffc_model_t *model, int64_t query, int64_t key,
+                      int64_t value, int32_t embed_dim, int32_t num_heads,
+                      const char *name);
+int64_t ffc_model_softmax(ffc_model_t *model, int64_t input, const char *name);
+
+/* loss_type: "mean_squared_error" | "sparse_categorical_crossentropy" | ...
+ * (core/types.py LossType values). Returns 0 on success. */
+int32_t ffc_model_compile(ffc_model_t *model, double learning_rate,
+                          const char *loss_type);
+
+/* One optimizer step on (x, y); x is float64 row-major (cast to f32 on
+ * the way in), y likewise — y_is_labels casts y to int32 class ids.
+ * Returns the step loss, or a negative value on error. */
+double ffc_model_fit_step(ffc_model_t *model, const double *x,
+                          const int64_t *x_shape, int32_t x_ndims,
+                          const double *y, const int64_t *y_shape,
+                          int32_t y_ndims, int32_t y_is_labels);
+
 /* ------------------------------------------------------------------ *
  * Dataloader kernels (reference: SingleDataLoader's batched index
  * loads, python/flexflow_dataloader.cc).

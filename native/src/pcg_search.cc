@@ -40,7 +40,10 @@ static double op_time(const Pcg &p, const PcgOp &op, int degree) {
   double t_c = (op.flops / degree) / (p.peak_flops * p.mxu_eff);
   double t_m = (op.bytes / degree) / (p.hbm_bw * p.hbm_eff);
   double fwd = std::max(t_c, t_m) + p.overhead;
-  return 3.0 * fwd;  // fwd + ~2x bwd, same ratio as the Python cost model
+  // fwd + bwd; bwd ~ 2x fwd for matmul-bound ops, ~1x for memory-bound
+  // (exactly CostModel.op_cost_metrics' rule, cost_model.py)
+  double bwd_factor = op.flops > 0.0 ? 2.0 : 1.0;
+  return (1.0 + bwd_factor) * fwd;
 }
 
 static double sync_time(MachineModel *mm, const PcgOp &op, int degree) {
@@ -124,29 +127,32 @@ double ffc_pcg_optimize(ffc_pcg_t *pcg, ffc_mm_t *mm_, int32_t batch,
     if (batch <= 0 || batch % d == 0) degrees.push_back(d);
   if (degrees.empty()) degrees.push_back(1);
 
-  // per-op best time for each degree; DP over topo order charging a
-  // reshard when consecutive ops pick different degrees (the sequential
-  // bottleneck split of graph.cc:115, specialized to chains — the
-  // branch-aware splits stay host-side where the full graph lives)
+  // Per-op best time for each degree; DP over topo order charging a
+  // reshard when producer and consumer pick different degrees (the
+  // sequential bottleneck split of graph.cc:115). Message passing is
+  // exact on (in-)trees; on DAGs a producer shared by several consumers
+  // has its subtree charged once per consumer (tree relaxation — the
+  // branch-aware HORIZONTAL splits stay host-side where the full graph
+  // lives). Backtracking keeps a PER-PRODUCER argmin table, so branchy
+  // graphs recover a consistent assignment (round-2 review: a single
+  // shared `prev` backpointer returned wrong assignments off the chain).
   const double INF = std::numeric_limits<double>::infinity();
-  std::vector<std::vector<double>> best(n, std::vector<double>(degrees.size(), INF));
-  std::vector<std::vector<int>> prev(n, std::vector<int>(degrees.size(), 0));
+  const size_t nd = degrees.size();
+  std::vector<std::vector<double>> best(n, std::vector<double>(nd, INF));
+  // prev[i][di * n_inputs + k] = argmin degree index of input k
+  std::vector<std::vector<int>> prev(n);
 
   for (int64_t i = 0; i < n; ++i) {
     const PcgOp &op = p->ops[i];
-    for (size_t di = 0; di < degrees.size(); ++di) {
-      double t_here = op_time(*p, op, degrees[di]) + sync_time(mm, op, degrees[di]);
-      if (op.inputs.empty()) {
-        best[i][di] = t_here;
-        continue;
-      }
-      // combine over producers: each contributes its best cost plus a
-      // reshard if the degree changes at the boundary
-      double total = t_here;
-      for (int64_t src : op.inputs) {
+    const size_t nin = op.inputs.size();
+    prev[i].assign(nd * (nin ? nin : 1), 0);
+    for (size_t di = 0; di < nd; ++di) {
+      double total = op_time(*p, op, degrees[di]) + sync_time(mm, op, degrees[di]);
+      for (size_t k = 0; k < nin; ++k) {
+        int64_t src = op.inputs[k];
         double b = INF;
         int arg = 0;
-        for (size_t dj = 0; dj < degrees.size(); ++dj) {
+        for (size_t dj = 0; dj < nd; ++dj) {
           double x = best[src][dj];
           if (dj != di)
             x += reshard_time(mm, p->ops[src].output_bytes,
@@ -157,31 +163,74 @@ double ffc_pcg_optimize(ffc_pcg_t *pcg, ffc_mm_t *mm_, int32_t batch,
           }
         }
         total += b;
-        prev[i][di] = arg;  // chain graphs: single producer dominates
+        prev[i][di * nin + k] = arg;
       }
       best[i][di] = total;
     }
   }
 
-  // the sink op's best assignment; backtrack the chain
-  int64_t sink = n - 1;
-  double bcost = INF;
-  int bdeg = 0;
-  for (size_t di = 0; di < degrees.size(); ++di)
-    if (best[sink][di] < bcost) {
-      bcost = best[sink][di];
-      bdeg = static_cast<int>(di);
-    }
-  if (out_degrees) {
-    std::vector<int> pick(n, bdeg);
-    for (int64_t i = sink; i >= 0; --i) {
-      if (!p->ops[i].inputs.empty()) {
-        int64_t src = p->ops[i].inputs[0];
-        pick[src] = prev[i][pick[i]];
+  // consumers per op (to find every sink, not just the last op)
+  std::vector<int> n_consumers(n, 0);
+  for (int64_t i = 0; i < n; ++i)
+    for (int64_t src : p->ops[i].inputs) n_consumers[src]++;
+
+  // cost = sum over sinks (tree semantics; shared producers counted per
+  // consuming sink); assignment backtracked from every sink, first
+  // consumer in reverse topo order wins on shared producers
+  double bcost = 0.0;
+  std::vector<int> pick(n, -1);
+  for (int64_t i = n - 1; i >= 0; --i) {
+    if (n_consumers[i] != 0) continue;  // not a sink
+    double b = INF;
+    int bdeg = 0;
+    for (size_t di = 0; di < nd; ++di)
+      if (best[i][di] < b) {
+        b = best[i][di];
+        bdeg = static_cast<int>(di);
       }
-    }
-    for (int64_t i = 0; i < n; ++i) out_degrees[i] = degrees[pick[i]];
+    bcost += b;
+    if (pick[i] < 0) pick[i] = bdeg;
   }
+  for (int64_t i = n - 1; i >= 0; --i) {
+    if (pick[i] < 0) continue;  // unreachable from any sink (shouldn't happen)
+    const size_t nin = p->ops[i].inputs.size();
+    for (size_t k = 0; k < nin; ++k) {
+      int64_t src = p->ops[i].inputs[k];
+      if (pick[src] < 0) pick[src] = prev[i][pick[i] * nin + k];
+    }
+  }
+  if (out_degrees)
+    for (int64_t i = 0; i < n; ++i)
+      out_degrees[i] = degrees[pick[i] < 0 ? 0 : pick[i]];
+  return bcost;
+}
+
+double ffc_pcg_uniform_best(ffc_pcg_t *pcg, ffc_mm_t *mm_, int32_t batch,
+                            int32_t max_degree, int32_t *out_degree) {
+  // One SHARED degree for the whole (sub)graph — exactly the Python
+  // SearchHelper._leaf_cost scan (dp_search.py): per-op roofline at
+  // n_parts=k plus per-weight ring allreduce, minimized over candidate
+  // power-of-two degrees. This is the DP's leaf hot path; the Python
+  // side uses it as a fast selector when its cost model is analytic.
+  Pcg *p = reinterpret_cast<Pcg *>(pcg);
+  MachineModel *mm = reinterpret_cast<MachineModel *>(mm_);
+  const int64_t n = static_cast<int64_t>(p->ops.size());
+  int32_t num_devices = mm->num_nodes * mm->devices_per_node;
+  if (max_degree <= 0 || max_degree > num_devices) max_degree = num_devices;
+  double bcost = std::numeric_limits<double>::infinity();
+  int32_t bdeg = 1;
+  for (int d = 1; d <= max_degree; d *= 2) {
+    if (batch > 0 && batch % d != 0) continue;
+    double total = 0.0;
+    for (int64_t i = 0; i < n; ++i) {
+      total += op_time(*p, p->ops[i], d) + sync_time(mm, p->ops[i], d);
+    }
+    if (total < bcost) {
+      bcost = total;
+      bdeg = d;
+    }
+  }
+  if (out_degree) *out_degree = bdeg;
   return bcost;
 }
 
