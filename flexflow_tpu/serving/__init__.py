@@ -10,6 +10,7 @@ from .server import InferenceServer
 
 __all__ = [
     "DynamicBatcher",
+    "GrpcInferenceServer",
     "InferenceModel",
     "InferenceServer",
     "ModelRepository",
@@ -17,3 +18,12 @@ __all__ = [
     "load_model",
     "save_model",
 ]
+
+
+def __getattr__(name):
+    # lazy: grpc_server pulls in grpcio + protobuf only when used
+    if name == "GrpcInferenceServer":
+        from .grpc_server import GrpcInferenceServer
+
+        return GrpcInferenceServer
+    raise AttributeError(name)

@@ -1,0 +1,278 @@
+"""gRPC inference server speaking the KServe/Triton v2 gRPC protocol.
+
+Reference: the reference's serving story is a Triton backend
+(triton/src/backend.cc, instance.cc) — a C++ multi-instance server whose
+transport IS Triton's v2 gRPC service. This module implements that
+service surface directly over grpcio (wire-compatible messages,
+serving/kserve_v2.proto), sharing the SAME InferenceModel/DynamicBatcher
+instances as the HTTP front end (serving/server.py) so both transports
+drain one batching queue per model — the analog of Triton model
+instances sharing a scheduler (triton/src/instance.cc).
+
+Concurrency: grpc.server's thread pool handles requests in parallel;
+per-model DynamicBatchers coalesce them into device-efficient batches.
+The service stubs are hand-registered generic handlers (grpc-tools
+codegen is not required at runtime; messages come from the committed
+kserve_v2_pb2.py, regenerated from kserve_v2.proto with protoc).
+"""
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Dict, Optional
+
+import numpy as np
+
+from .batcher import DynamicBatcher
+from .model import InferenceModel
+
+try:
+    from . import kserve_v2_pb2 as pb
+except Exception:  # pragma: no cover - regenerate if import ever breaks
+    pb = None
+
+_SERVICE = "inference.GRPCInferenceService"
+
+_V2_TO_NP = {
+    "FP32": np.float32, "FP64": np.float64, "FP16": np.float16,
+    "INT32": np.int32, "INT64": np.int64, "BOOL": np.bool_,
+}
+_NP_TO_V2 = {
+    "float32": "FP32", "float64": "FP64", "float16": "FP16",
+    "bfloat16": "BF16", "int32": "INT32", "int64": "INT64", "bool": "BOOL",
+}
+# which InferTensorContents field carries each v2 datatype
+_CONTENTS_FIELD = {
+    "FP32": "fp32_contents", "FP64": "fp64_contents",
+    "INT32": "int_contents", "INT64": "int64_contents",
+    "BOOL": "bool_contents",
+}
+
+
+def _tensor_to_array(t) -> np.ndarray:
+    dt = _V2_TO_NP.get(t.datatype or "FP32", np.float32)
+    field = _CONTENTS_FIELD.get(t.datatype or "FP32", "fp32_contents")
+    data = list(getattr(t.contents, field))
+    return np.asarray(data, dtype=dt).reshape(list(t.shape))
+
+
+def _array_to_tensor(out, name: str, arr: np.ndarray):
+    arr = np.asarray(arr)
+    v2 = _NP_TO_V2.get(str(arr.dtype), "FP32")
+    if v2 not in _CONTENTS_FIELD:
+        arr = arr.astype(np.float32)
+        v2 = "FP32"
+    out.name = name
+    out.datatype = v2
+    out.shape.extend(arr.shape)
+    getattr(out.contents, _CONTENTS_FIELD[v2]).extend(
+        arr.reshape(-1).tolist()
+    )
+
+
+class GrpcInferenceServer:
+    """KServe v2 gRPC front end.
+
+    ``http_server`` (serving/server.py InferenceServer) may be passed to
+    SHARE its models/batchers/repository — one batching queue per model
+    across both transports. Standalone use keeps private dicts.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_workers: int = 16,
+        max_delay_s: float = 0.005,
+        http_server=None,
+        repository=None,
+    ):
+        if pb is None:
+            raise RuntimeError(
+                "kserve_v2_pb2 unavailable; regenerate with "
+                "`protoc --python_out=flexflow_tpu/serving "
+                "flexflow_tpu/serving/kserve_v2.proto`"
+            )
+        import grpc  # deferred: serving works without grpcio installed
+
+        self._grpc = grpc
+        self.host = host
+        self.port = port
+        self.max_workers = max_workers
+        self.max_delay_s = max_delay_s
+        self._shared = http_server
+        if http_server is not None:
+            self.models = http_server.models
+            self.batchers = http_server.batchers
+            self.repository = repository or http_server.repository
+        else:
+            self.models: Dict[str, InferenceModel] = {}
+            self.batchers: Dict[str, DynamicBatcher] = {}
+            self.repository = repository
+        self._server = None
+        self._started = False
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------- lifecycle
+    def register(self, model: InferenceModel):
+        if self._shared is not None:
+            return self._shared.register(model)
+        self.models[model.name] = model
+        b = DynamicBatcher(model, max_delay_s=self.max_delay_s)
+        self.batchers[model.name] = b
+        if self._started:
+            b.start()
+
+    def unregister(self, name: str) -> bool:
+        if self._shared is not None:
+            return self._shared.unregister(name)
+        b = self.batchers.pop(name, None)
+        if b is not None:
+            b.stop()
+        return self.models.pop(name, None) is not None
+
+    def start(self):
+        grpc = self._grpc
+        handlers = {
+            "ServerLive": (pb.ServerLiveRequest, self._server_live),
+            "ServerReady": (pb.ServerReadyRequest, self._server_ready),
+            "ModelReady": (pb.ModelReadyRequest, self._model_ready),
+            "ModelMetadata": (pb.ModelMetadataRequest, self._model_metadata),
+            "ModelInfer": (pb.ModelInferRequest, self._model_infer),
+            "RepositoryIndex": (pb.RepositoryIndexRequest, self._repo_index),
+            "RepositoryModelLoad": (pb.RepositoryModelLoadRequest, self._repo_load),
+            "RepositoryModelUnload": (pb.RepositoryModelUnloadRequest, self._repo_unload),
+        }
+
+        rpc_handlers = {
+            meth: grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+            for meth, (req_cls, fn) in handlers.items()
+        }
+        generic = grpc.method_handlers_generic_handler(_SERVICE, rpc_handlers)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self.max_workers)
+        )
+        self._server.add_generic_rpc_handlers((generic,))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        if self._shared is None:
+            for b in self.batchers.values():
+                b.start()
+        self._started = True
+        self._server.start()
+
+    def stop(self, grace: float = 2.0):
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+        if self._shared is None:
+            for b in self.batchers.values():
+                b.stop()
+        self._started = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------ handlers
+    def _server_live(self, request, context):
+        return pb.ServerLiveResponse(live=True)
+
+    def _server_ready(self, request, context):
+        return pb.ServerReadyResponse(ready=True)
+
+    def _model_ready(self, request, context):
+        return pb.ModelReadyResponse(ready=request.name in self.models)
+
+    def _abort(self, context, code, msg):
+        context.abort(code, msg)
+
+    def _model_metadata(self, request, context):
+        grpc = self._grpc
+        m = self.models.get(request.name)
+        if m is None:
+            self._abort(context, grpc.StatusCode.NOT_FOUND, f"unknown model {request.name}")
+        resp = pb.ModelMetadataResponse(
+            name=m.name, versions=["1"], platform="flexflow_tpu"
+        )
+        for meta in m.inputs:
+            t = resp.inputs.add()
+            t.name = meta.name
+            t.datatype = _NP_TO_V2.get(meta.dtype, "FP32")
+            t.shape.extend(meta.shape)
+        for meta in m.outputs:
+            t = resp.outputs.add()
+            t.name = meta.name
+            t.datatype = _NP_TO_V2.get(meta.dtype, "FP32")
+            t.shape.extend(meta.shape)
+        return resp
+
+    def _model_infer(self, request, context):
+        grpc = self._grpc
+        name = request.model_name
+        model = self.models.get(name)
+        batcher = self.batchers.get(name)
+        if model is None or batcher is None:
+            self._abort(context, grpc.StatusCode.NOT_FOUND, f"unknown model {name}")
+        try:
+            by_name = {t.name: t for t in request.inputs}
+            arrays = []
+            for meta in model.inputs:
+                t = by_name.get(meta.name)
+                if t is None:
+                    raise ValueError(f"missing input {meta.name}")
+                arrays.append(_tensor_to_array(t))
+            fut = batcher.submit(arrays)
+        except RuntimeError as e:  # batcher stopped
+            self._abort(context, grpc.StatusCode.UNAVAILABLE, str(e))
+        except Exception as e:
+            self._abort(context, grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        try:
+            outs = fut.result(timeout=60.0)
+        except (TimeoutError, futures.TimeoutError):
+            # futures.TimeoutError only aliases the builtin from 3.11 on
+            self._abort(context, grpc.StatusCode.DEADLINE_EXCEEDED, "inference timed out")
+        except Exception as e:
+            self._abort(context, grpc.StatusCode.INTERNAL, str(e))
+        resp = pb.ModelInferResponse(model_name=name, id=request.id)
+        for meta, o in zip(model.outputs, outs):
+            _array_to_tensor(resp.outputs.add(), meta.name, o)
+        return resp
+
+    # ---------------------------------------------------------- repository
+    def _repo_index(self, request, context):
+        resp = pb.RepositoryIndexResponse()
+        repo = self.repository
+        names = set(self.models)
+        if repo is not None:
+            names |= set(repo.available())
+        for n in sorted(names):
+            mi = resp.models.add()
+            mi.name = n
+            mi.version = "1"
+            mi.state = "READY" if n in self.models else "UNAVAILABLE"
+        return resp
+
+    def _repo_load(self, request, context):
+        grpc = self._grpc
+        if self.repository is None:
+            self._abort(context, grpc.StatusCode.FAILED_PRECONDITION, "no model repository configured")
+        try:
+            self.register(self.repository.load(request.model_name))
+        except KeyError as e:
+            self._abort(context, grpc.StatusCode.NOT_FOUND, str(e))
+        except Exception as e:
+            self._abort(context, grpc.StatusCode.INTERNAL, str(e))
+        return pb.RepositoryModelLoadResponse()
+
+    def _repo_unload(self, request, context):
+        grpc = self._grpc
+        if not self.unregister(request.model_name):
+            self._abort(context, grpc.StatusCode.NOT_FOUND, f"model {request.model_name} not loaded")
+        return pb.RepositoryModelUnloadResponse()

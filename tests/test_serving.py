@@ -337,3 +337,160 @@ def test_server_returns_500_for_stopped_batcher(served_model):
             urllib.request.urlopen(urllib.request.Request(
                 f"{base}/v2/models/mlp/infer", data=req))
         assert ei.value.code == 500
+
+
+# ------------------------------------------------------------------- gRPC
+@pytest.fixture(scope="module")
+def second_model():
+    cfg = FFConfig(batch_size=8)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 8], name="x")
+    t = ff.dense(x, 16, activation="relu")
+    out = ff.dense(t, 2)
+    ff.compile(comp_mode=CompMode.INFERENCE, outputs=[out])
+    return InferenceModel(ff, name="tiny", max_batch=8)
+
+
+def _grpc_stub(port):
+    import grpc
+
+    from flexflow_tpu.serving import kserve_v2_pb2 as pb
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+
+    def call(method, req, resp_cls):
+        fn = channel.unary_unary(
+            f"/inference.GRPCInferenceService/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        return fn(req, timeout=60)
+
+    return channel, call, pb
+
+
+def test_grpc_server_infer_and_metadata(served_model):
+    """KServe v2 gRPC transport (VERDICT r2 next-round #9): metadata +
+    infer round-trip matches a direct model call."""
+    pytest.importorskip("grpc")
+    from flexflow_tpu.serving.grpc_server import GrpcInferenceServer
+
+    srv = GrpcInferenceServer(port=0)
+    srv.register(served_model)
+    with srv:
+        channel, call, pb = _grpc_stub(srv.port)
+        assert call("ServerReady", pb.ServerReadyRequest(), pb.ServerReadyResponse).ready
+        assert call(
+            "ModelReady", pb.ModelReadyRequest(name="mlp"), pb.ModelReadyResponse
+        ).ready
+        md = call(
+            "ModelMetadata", pb.ModelMetadataRequest(name="mlp"), pb.ModelMetadataResponse
+        )
+        assert md.name == "mlp" and list(md.inputs[0].shape) == [16]
+
+        x = np.random.RandomState(0).randn(2, 16).astype(np.float32)
+        req = pb.ModelInferRequest(model_name="mlp")
+        t = req.inputs.add()
+        t.name = served_model.inputs[0].name
+        t.datatype = "FP32"
+        t.shape.extend(x.shape)
+        t.contents.fp32_contents.extend(x.reshape(-1).tolist())
+        resp = call("ModelInfer", req, pb.ModelInferResponse)
+        out = np.asarray(resp.outputs[0].contents.fp32_contents, np.float32).reshape(
+            list(resp.outputs[0].shape)
+        )
+        (direct,) = served_model.infer([x])
+        np.testing.assert_allclose(out, np.asarray(direct), rtol=1e-5, atol=1e-6)
+        channel.close()
+
+
+def test_grpc_concurrent_clients_two_models(served_model, second_model):
+    """Two models served concurrently, parallel clients on each — the
+    multi-instance concurrency story of the reference's Triton backend
+    (triton/src/instance.cc), shared-batcher edition."""
+    pytest.importorskip("grpc")
+    from flexflow_tpu.serving.grpc_server import GrpcInferenceServer
+
+    srv = GrpcInferenceServer(port=0, max_workers=16)
+    srv.register(served_model)
+    srv.register(second_model)
+    errors = []
+    with srv:
+        channel, call, pb = _grpc_stub(srv.port)
+
+        def hit(model, n_feat, reps):
+            try:
+                rs = np.random.RandomState(hash(threading.current_thread().name) % 2**31)
+                for _ in range(reps):
+                    x = rs.randn(2, n_feat).astype(np.float32)
+                    req = pb.ModelInferRequest(model_name=model.name)
+                    t = req.inputs.add()
+                    t.name = model.inputs[0].name
+                    t.datatype = "FP32"
+                    t.shape.extend(x.shape)
+                    t.contents.fp32_contents.extend(x.reshape(-1).tolist())
+                    resp = call("ModelInfer", req, pb.ModelInferResponse)
+                    out = np.asarray(
+                        resp.outputs[0].contents.fp32_contents, np.float32
+                    ).reshape(list(resp.outputs[0].shape))
+                    (want,) = model.infer([x])
+                    np.testing.assert_allclose(out, np.asarray(want), rtol=1e-4, atol=1e-5)
+            except Exception as e:  # surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=hit, args=(served_model, 16, 5)) for _ in range(4)
+        ] + [
+            threading.Thread(target=hit, args=(second_model, 8, 5)) for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        channel.close()
+    assert not errors, errors[:2]
+
+
+def test_grpc_shares_http_batchers(served_model):
+    """Both transports drain ONE batching queue per model."""
+    pytest.importorskip("grpc")
+    from flexflow_tpu.serving.grpc_server import GrpcInferenceServer
+
+    http = InferenceServer(port=0)
+    http.register(served_model)
+    grpc_srv = GrpcInferenceServer(port=0, http_server=http)
+    assert grpc_srv.batchers is http.batchers
+    http.start()
+    try:
+        with grpc_srv:
+            channel, call, pb = _grpc_stub(grpc_srv.port)
+            x = np.random.RandomState(1).randn(1, 16).astype(np.float32)
+            req = pb.ModelInferRequest(model_name="mlp")
+            t = req.inputs.add()
+            t.name = served_model.inputs[0].name
+            t.datatype = "FP32"
+            t.shape.extend(x.shape)
+            t.contents.fp32_contents.extend(x.reshape(-1).tolist())
+            resp = call("ModelInfer", req, pb.ModelInferResponse)
+            assert list(resp.outputs[0].shape) == [1, 4]
+            # HTTP path still live on the same batcher
+            body = json.dumps({
+                "inputs": [{
+                    "name": served_model.inputs[0].name,
+                    "shape": [1, 16],
+                    "datatype": "FP32",
+                    "data": x.reshape(-1).tolist(),
+                }]
+            }).encode()
+            r = urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{http.port}/v2/models/mlp/infer",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                ),
+                timeout=30,
+            )
+            assert json.loads(r.read())["outputs"][0]["shape"] == [1, 4]
+            channel.close()
+    finally:
+        http.stop()
