@@ -525,14 +525,17 @@ class FFModel:
         self.metrics = list(metrics)
         self.comp_mode = comp_mode
         self._outputs = list(outputs) if outputs else [self._default_output()]
-        num_devices = self.config.num_devices
         from .parallel.distributed import maybe_initialize_from_env
         from .parallel.mesh import build_mesh
         from .parallel.strategy import data_parallel_strategy
 
         # multi-host entry (reference: GASNet multi-node; here one process
-        # per host joins via jax.distributed when the env declares a job)
+        # per host joins via jax.distributed when the env declares a job).
+        # Must run BEFORE anything touches the backend — config.num_devices
+        # may call jax.devices(), and jax.distributed.initialize refuses
+        # to run after backend init.
         maybe_initialize_from_env()
+        num_devices = self.config.num_devices
 
         if strategy is not None:
             self.strategy = strategy
@@ -581,6 +584,7 @@ class FFModel:
             outputs=[(t.node.guid, t.idx) for t in self._outputs],
             backend=jax.default_backend(),
             comp_mode=comp_mode,
+            remat_blocks=self.config.remat_blocks,
         )
         self.executor.initialize(jax.random.key(self._seed))
         return self
@@ -717,6 +721,73 @@ class FFModel:
         return RecompileState(trigger, alter, self)
 
     # ------------------------------------------------------- introspection
+    def parallel_tensor(self, tensor: Tensor):
+        """How ``tensor`` is sharded under the compiled strategy
+        (reference: ParallelTensorBase's per-dim degree / replica dims,
+        parallel_tensor.h:36-71 — here surfaced from the strategy's
+        PartitionSpecs instead of Legion partitions)."""
+        from .core.parallel_tensor import view_from_spec
+
+        assert self.strategy is not None, "compile() first"
+        sh = self.strategy.node_shardings.get(tensor.node.guid)
+        spec = self.strategy.output_spec(tensor.node.guid, tensor.idx)
+        return view_from_spec(
+            tensor.spec,
+            spec,
+            self.strategy.axis_sizes,
+            machine_view_hash=sh.machine_view_hash if sh else 0,
+        )
+
+    def parallel_weight(self, tensor: Tensor, name: str):
+        """Sharding view of one of ``tensor``'s op's weights."""
+        from .core.parallel_tensor import view_from_spec
+        from .ops.base import get_op_def
+
+        assert self.strategy is not None, "compile() first"
+        node = tensor.node
+        specs = infer_all_specs(self.graph)
+        in_specs = [specs[e.src][e.src_idx] for e in self.graph.in_edges(node)]
+        wspecs = {w.name: w for w in get_op_def(node.op_type).weight_specs(node.params, in_specs)}
+        if name not in wspecs:
+            raise KeyError(f"op {node} has no weight {name!r}; has {sorted(wspecs)}")
+        sh = self.strategy.node_shardings.get(node.guid)
+        return view_from_spec(
+            wspecs[name].spec,
+            self.strategy.weight_spec(node.guid, name),
+            self.strategy.axis_sizes,
+            machine_view_hash=sh.machine_view_hash if sh else 0,
+        )
+
+    def get_weight(self, tensor: Tensor, name: str) -> np.ndarray:
+        """Gather one weight to host (reference:
+        ParallelTensorBase::get_tensor, parallel_tensor.h:165-169, the
+        cffi get-weights path)."""
+        assert self.executor is not None, "compile() first"
+        key = f"{tensor.node.op_type.value}_{tensor.node.guid}"
+        have = []
+        for store in (self.executor.params, self.executor.state):
+            group = store.get(key) or {}
+            if name in group:
+                return np.asarray(jax.device_get(group[name]))
+            have.extend(group)
+        raise KeyError(f"no weight {name!r} on {key}; has {sorted(have)}")
+
+    def set_weight(self, tensor: Tensor, name: str, value) -> None:
+        """Write one weight from host data, preserving its sharding
+        (reference: ParallelTensorBase::set_tensor)."""
+        assert self.executor is not None, "compile() first"
+        key = f"{tensor.node.op_type.value}_{tensor.node.guid}"
+        for store in (self.executor.params, self.executor.state):
+            group = store.get(key)
+            if group is not None and name in group:
+                cur = group[name]
+                arr = np.asarray(value, dtype=np.asarray(cur).dtype)
+                if arr.shape != cur.shape:
+                    raise ValueError(f"shape {arr.shape} != {cur.shape} for {key}.{name}")
+                group[name] = jax.device_put(arr, cur.sharding)
+                return
+        raise KeyError(f"no weight {name!r} on {key}")
+
     def get_output(self) -> Tensor:
         return self._outputs[0] if self._outputs else self._default_output()
 

@@ -98,3 +98,56 @@ def test_graph_serde_roundtrip():
     assert "linear" in js
     dot = g.to_dot()
     assert "digraph" in dot
+
+
+# --------------------------------------------------- parallel tensor views
+def test_parallel_tensor_view_dp_tp():
+    """ParallelTensorBase parity (VERDICT r2 partial C4): per-dim shard
+    degree, mesh axes, and replica degree are user-inspectable for
+    activations and weights, and weights round-trip through
+    get_weight/set_weight preserving their sharding."""
+    import jax
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+    from flexflow_tpu.model import FFModel
+    from flexflow_tpu.parallel.strategy import megatron_strategy
+
+    config = FFConfig(batch_size=16, workers_per_node=8)
+    m = FFModel(config)
+    x = m.create_tensor((16, 32), name="x")
+    h = m.dense(x, 64, name="ff1")
+    out = m.dense(h, 32, name="ff2")
+    strategy = megatron_strategy(m.graph, dp=4, tp=2)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR,
+        strategy=strategy,
+    )
+
+    # activation: batch dim sharded dp=4, feature dim unsharded
+    v = m.parallel_tensor(h)
+    assert v.dims[0].degree == 4 and v.dims[0].mesh_axes == ("data",)
+    assert v.dims[0].shard_size == 4
+    assert v.dims[1].degree == 1
+    # ff1 is column-parallel: kernel [32, 64] sharded on dim 1 over tp=2,
+    # replicated across the data axis -> replica_degree 4
+    w = m.parallel_weight(h, "kernel")
+    assert w.dims[1].degree == 2 and w.dims[1].mesh_axes == ("model",)
+    assert w.replica_degree == 4
+    assert w.num_shards == 2 and w.shard_shape == (32, 32)
+    # ff2 is row-parallel: kernel [64, 32] sharded on dim 0
+    w2 = m.parallel_weight(out, "kernel")
+    assert w2.dims[0].degree == 2
+    with pytest.raises(KeyError):
+        m.parallel_weight(h, "nope")
+
+    # get/set round-trip preserves values and sharding
+    before = m.get_weight(h, "kernel")
+    assert before.shape == (32, 64)
+    new = np.arange(before.size, dtype=before.dtype).reshape(before.shape)
+    m.set_weight(h, "kernel", new)
+    np.testing.assert_array_equal(m.get_weight(h, "kernel"), new)
+    key = f"{h.node.op_type.value}_{h.node.guid}"
+    spec = m.executor.params[key]["kernel"].sharding.spec
+    assert "model" in tuple(spec)
