@@ -44,6 +44,20 @@ class MachineResource:
         return MachineResource(self.start, k), MachineResource(self.start + k, self.size - k)
 
 
+def build_cost_specs(graph: PCGraph) -> Dict:
+    """The {"out", "in"} spec dict node_cost reads — inferred once on the
+    root graph (subgraph splits cut producers off at boundaries, so the
+    recursion threads this through instead of re-inferring)."""
+    out_map = infer_all_specs(graph)
+    return {
+        "out": out_map,
+        "in": {
+            n.guid: [out_map[e.src][e.src_idx] for e in graph.in_edges(n)]
+            for n in graph.nodes.values()
+        },
+    }
+
+
 @dataclasses.dataclass
 class DPResult:
     cost: float
@@ -140,14 +154,7 @@ class SearchHelper:
         if hit is not None:
             return hit
         if specs is None:
-            out_map = infer_all_specs(graph)
-            specs = {
-                "out": out_map,
-                "in": {
-                    n.guid: [out_map[e.src][e.src_idx] for e in graph.in_edges(n)]
-                    for n in graph.nodes.values()
-                },
-            }
+            specs = build_cost_specs(graph)
         result = self._optimal_cost_impl(graph, resource, specs)
         self._memo[key] = result
         return result

@@ -377,3 +377,58 @@ def test_horizontal_split_is_cost_weighted():
     # it only 2 of 8
     assert len(fat_devs) >= 4, sorted(fat_devs)
     assert len(fat_devs) > len(thin_devs), (sorted(fat_devs), sorted(thin_devs))
+
+
+def test_mcmc_propagate_mode_consistent_and_cheaper_proposals():
+    """FF_USE_PROPAGATE parity (reference model.cc:3599): the propagate
+    walk's incremental delta cost must stay consistent with a rebuild
+    from scratch, and the search still finds a strategy no worse than
+    plain MCMC at equal budget (both re-scored by the full simulator)."""
+    model = mlp_graph(batch=64, hidden=256, layers=4)
+    machine = MachineSpec(num_nodes=1, devices_per_node=8)
+    views_p, cost_p = mcmc_optimize(
+        model.graph, machine, budget=60, seed=3, propagate=True
+    )
+    views_0, cost_0 = mcmc_optimize(model.graph, machine, budget=60, seed=3)
+    assert cost_p > 0 and cost_0 > 0
+    sim = Simulator(machine)
+    assert sim.simulate(model.graph, views_p) == pytest.approx(cost_p, rel=1e-9)
+    # internal consistency: delta updates == rebuild for the winner
+    from flexflow_tpu.search.dp_search import SearchHelper, build_cost_specs
+    from flexflow_tpu.search.mcmc import _DeltaCost
+
+    helper = SearchHelper(machine)
+    dc = _DeltaCost(model.graph, helper, build_cost_specs(model.graph))
+    base = dc.rebuild(views_p)
+    # mutate one op through apply(), then compare against a fresh rebuild
+    guid = next(
+        n.guid for n in model.graph.topo_order() if n.op_type == OpType.LINEAR
+    )
+    views_p[guid] = (
+        MachineView(0, (2,), (1,))
+        if views_p[guid] != MachineView(0, (2,), (1,))
+        else MachineView(0, (4,), (1,))
+    )
+    incremental = dc.apply([guid], views_p)
+    fresh = _DeltaCost(model.graph, helper, build_cost_specs(model.graph)).rebuild(views_p)
+    assert incremental == pytest.approx(fresh, rel=1e-9)
+    assert incremental != pytest.approx(base, rel=1e-9)
+
+    # duplicate-edge graphs (self-attention: q=k=v feeds one op three
+    # times) must keep apply() == rebuild() — edges are keyed with
+    # dst_idx, so the three parallel edges don't collapse into one
+    m2 = FFModel(FFConfig(batch_size=8))
+    xx = m2.create_tensor((8, 4, 32), name="seq")
+    aa = m2.multihead_attention(xx, xx, xx, 32, 4, name="attn")
+    m2.add(xx, aa, name="res")
+    dc2 = _DeltaCost(m2.graph, helper, build_cost_specs(m2.graph))
+    v2 = {n.guid: MachineView(0, (8,), (1,)) for n in m2.graph.nodes.values()}
+    dc2.rebuild(v2)
+    attn_guid = next(
+        n.guid for n in m2.graph.topo_order()
+        if n.op_type == OpType.MULTIHEAD_ATTENTION
+    )
+    v2[attn_guid] = MachineView(0, (2,), (1,))
+    inc2 = dc2.apply([attn_guid], v2)
+    fresh2 = _DeltaCost(m2.graph, helper, build_cost_specs(m2.graph)).rebuild(v2)
+    assert inc2 == pytest.approx(fresh2, rel=1e-9)
