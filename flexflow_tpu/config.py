@@ -52,6 +52,11 @@ class FFConfig:
     # unimplemented placeholder, ffconst.h:160)
     pipeline_stages: int = 1
     pipeline_microbatches: int = 0  # 0 -> auto (parallel/strategy.py)
+    # activation rematerialization: recompute each repeated block's
+    # activations in the backward pass instead of storing them
+    # (jax.checkpoint per block) — the TPU-native HBM/FLOPs trade the
+    # reference never had; pairs with the memory-aware λ search
+    remat_blocks: bool = False
     # execution flags
     perform_fusion: bool = False  # XLA fuses regardless; kept for CLI parity
     profiling: bool = False
@@ -109,6 +114,7 @@ class FFConfig:
         p.add_argument("--compgraph", type=str, default="")
         p.add_argument("--include-costs-dot-graph", action="store_true")
         p.add_argument("--pipeline-stages", type=int, default=1)
+        p.add_argument("--remat-blocks", action="store_true")
         p.add_argument("--pipeline-microbatches", type=int, default=0)
         p.add_argument("--topo-file", type=str, default="")
         p.add_argument("--iteration", type=int, default=1)
@@ -148,6 +154,7 @@ class FFConfig:
             export_strategy_computation_graph_file=ns.compgraph,
             include_costs_dot_graph=ns.include_costs_dot_graph,
             pipeline_stages=ns.pipeline_stages,
+            remat_blocks=ns.remat_blocks,
             pipeline_microbatches=ns.pipeline_microbatches,
             topo_file=ns.topo_file,
             iteration=ns.iteration,

@@ -49,6 +49,7 @@ def gpipe(
     n_microbatches: int,
     mesh: Mesh,
     axis: str = PIPE_AXIS,
+    with_aux: bool = False,
 ) -> Callable[[Any, jax.Array], jax.Array]:
     """Build a pipelined apply: (stacked_params, x) -> y.
 
@@ -56,6 +57,13 @@ def gpipe(
     same activation shape in and out (a residual-block stack).
     stacked_params: pytree whose leaves have a leading stage axis [S, ...]
     sharded over ``axis``. x: [B, ...] with B divisible by n_microbatches.
+
+    with_aux=True: stage_fn returns (activation, aux_scalar) and the
+    pipelined apply returns (y, aux) where aux sums each stage's scalar
+    over its VALID (stage, microbatch) ticks — fill/drain garbage ticks
+    are masked out — averaged over microbatches and the data axis, so
+    MoE load-balance losses (aggregate.cc lambda_bal) survive inside the
+    pipelined stack instead of being rejected.
 
     The returned function must be called under jit with ``mesh`` active
     (shard_map handles the collectives).
@@ -82,6 +90,7 @@ def gpipe(
             # local microbatch shape (the batch dim may be data-sharded)
             act0 = jnp.zeros(xs_local.shape[1:], x.dtype)
             outs0 = jnp.zeros_like(xs_local)
+            aux0 = jnp.zeros((), jnp.float32)
             if hasattr(jax.lax, "pcast"):
                 # newer shard_map tracks varying manual axes: the carries
                 # must enter the scan with the variance they will have
@@ -93,14 +102,22 @@ def gpipe(
                 data_v = (_DA,) if (_DA in mesh.axis_names and mesh.shape[_DA] > 1) else ()
                 act0 = jax.lax.pcast(act0, (axis,) + data_v, to="varying")
                 outs0 = jax.lax.pcast(outs0, (axis,), to="varying")
+                aux0 = jax.lax.pcast(aux0, (axis,) + data_v, to="varying")
 
             def tick(carry, t):
-                act, outs = carry
+                act, outs, aux_acc = carry
                 # stage 0 injects microbatch t; others use the arriving act
                 inject = jnp.where(t < n_microbatches, t, 0)
                 fresh = jax.lax.dynamic_index_in_dim(xs_local, inject, keepdims=False)
                 inp = jnp.where(stage == 0, fresh, act)
-                out = stage_fn(params, inp)
+                if with_aux:
+                    out, aux_t = stage_fn(params, inp)
+                    # this stage holds microbatch t - stage; real ones only
+                    mb = t - stage
+                    live = jnp.logical_and(mb >= 0, mb < n_microbatches)
+                    aux_acc = aux_acc + jnp.where(live, aux_t.astype(jnp.float32), 0.0)
+                else:
+                    out = stage_fn(params, inp)
                 # last stage banks microbatch t - (S-1)
                 done_idx = t - (n_stages - 1)
                 is_last = stage == n_stages - 1
@@ -112,13 +129,26 @@ def gpipe(
                 # rotate activations one hop down the pipe
                 perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
                 act = jax.lax.ppermute(out, axis, perm)
-                return (act, outs), None
+                return (act, outs, aux_acc), None
 
-            (act, outs), _ = jax.lax.scan(tick, (act0, outs0), jnp.arange(ticks))
+            (act, outs, aux_acc), _ = jax.lax.scan(
+                tick, (act0, outs0, aux0), jnp.arange(ticks)
+            )
             # outs is populated only on the last stage; psum broadcasts it
             # (every other stage holds zeros)
             mask = (stage == n_stages - 1).astype(outs.dtype)
-            return jax.lax.psum(outs * mask, axis)
+            y_out = jax.lax.psum(outs * mask, axis)
+            if not with_aux:
+                return y_out
+            # sum stages (each stage = distinct blocks), average over
+            # microbatches; the data-axis mean matches how a non-pipelined
+            # GSPMD run reduces a sharded-batch aux loss
+            from .mesh import DATA_AXIS as _DA
+
+            aux = jax.lax.psum(aux_acc, axis) / n_microbatches
+            if _DA in mesh.axis_names and mesh.shape[_DA] > 1:
+                aux = jax.lax.pmean(aux, _DA)
+            return y_out, aux
 
         specs_params = jax.tree.map(lambda _: PartitionSpec(axis), stacked_params)
         # combine with data parallelism when the mesh has a "data" axis:
@@ -127,13 +157,17 @@ def gpipe(
 
         data = DATA_AXIS if DATA_AXIS in mesh.axis_names and mesh.shape[DATA_AXIS] > 1 else None
         xs_spec = PartitionSpec(None, data)
-        y = shard_map(
+        out_specs = (xs_spec, PartitionSpec()) if with_aux else xs_spec
+        result = shard_map(
             per_device,
             mesh=mesh,
             in_specs=(specs_params, xs_spec),
-            out_specs=xs_spec,
+            out_specs=out_specs,
         )(stacked_params, xs)
-        return y.reshape((b,) + y.shape[2:])
+        if with_aux:
+            y, aux = result
+            return y.reshape((b,) + y.shape[2:]), aux
+        return result.reshape((b,) + result.shape[2:])
 
     return pipelined
 

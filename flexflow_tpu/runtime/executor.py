@@ -113,6 +113,11 @@ class CompiledExecutor:
     # Changing it retraces the step with the new static shapes.
     seq_length: Optional[int] = None
 
+    # activation rematerialization: recompute each repeated block in the
+    # backward pass (jax.checkpoint per block) instead of storing its
+    # activations — HBM/FLOPs trade (FFConfig.remat_blocks)
+    remat_blocks: bool = False
+
     params: Any = None
     opt_state: Any = None
     state: Any = None  # non-trainable (batchnorm stats, ...)
@@ -120,6 +125,7 @@ class CompiledExecutor:
     _eval_step: Optional[Callable] = None
     _forward: Optional[Callable] = None
     _pipeline_plan: Any = None  # _PipelinePlan when the strategy pipelines
+    _remat_plan: Any = None  # (pre, repeats, post) when remat_blocks engaged
 
     # ----------------------------------------------------------- building
     def initialize(self, rng: jax.Array):
@@ -128,6 +134,13 @@ class CompiledExecutor:
         import zlib
 
         self._pipeline_plan = _build_pipeline_plan(self.graph, self.strategy)
+        if self.remat_blocks and self._pipeline_plan is None:
+            from ..parallel.pipeline import detect_repeats
+
+            pre, repeats, post = detect_repeats(self.graph)
+            # GPipe's scan already recomputes per-tick, so remat only
+            # applies to the plain interpreter; need >= 2 blocks to win
+            self._remat_plan = (pre, repeats, post) if len(repeats) >= 2 else None
         specs = infer_all_specs(self.graph)
         params: Dict[str, Dict[str, jax.Array]] = {}
         state: Dict[str, Dict[str, jax.Array]] = {}
@@ -172,17 +185,6 @@ class CompiledExecutor:
                     raise NotImplementedError(
                         f"pipelined op {node} has non-trainable state; "
                         "keep stateful ops (batchnorm) outside the block stack"
-                    )
-                # aux losses raised inside the stage scan would be silently
-                # dropped (only pre/post LowerCtx aux is collected)
-                if node.op_type in (OpType.AGGREGATE, OpType.AGGREGATE_SPEC) and getattr(
-                    node.params, "lambda_bal", 0.0
-                ) > 0.0:
-                    raise NotImplementedError(
-                        f"pipelined op {node} emits an aux load-balance loss "
-                        "(lambda_bal > 0), which the GPipe schedule cannot "
-                        "collect; set lambda_bal=0 or keep the MoE layer "
-                        "outside the pipelined block stack"
                     )
         S, r = plan.n_stages, len(plan.repeats) // plan.n_stages
         stacked: Dict[str, Dict[str, jax.Array]] = {}
@@ -232,6 +234,8 @@ class CompiledExecutor:
         dispatched per iteration)."""
         if self._pipeline_plan is not None:
             return self._forward_pipelined(params, state, inputs, rng, training)
+        if self._remat_plan is not None and training:
+            return self._forward_remat(params, state, inputs, rng)
         values: Dict[Tuple[int, int], jax.Array] = {}
         ctx = LowerCtx(
             training=training,
@@ -317,6 +321,14 @@ class CompiledExecutor:
         out_pos = plan.out_pos
 
         r = len(plan.repeats) // plan.n_stages
+        # blocks that can emit aux losses (MoE load balance) engage the
+        # schedule's masked aux accumulation; otherwise the plain path
+        # keeps zero overhead
+        with_aux = any(
+            node.op_type in (OpType.AGGREGATE, OpType.AGGREGATE_SPEC)
+            and getattr(node.params, "lambda_bal", 0.0) > 0.0
+            for node in template
+        )
 
         def stage_fn(stage_params, act):
             # stage_params leaves [r, ...]: scan the stage's blocks.
@@ -329,7 +341,8 @@ class CompiledExecutor:
 
             def body(carry, rep):
                 rep_params, ridx = rep
-                local = {in_src: carry}
+                act_in, aux_in = carry
+                local = {in_src: act_in}
                 ctx = LowerCtx(
                     training=training,
                     rng=jax.random.fold_in(rng, stage_idx * r + ridx),
@@ -344,18 +357,115 @@ class CompiledExecutor:
                     outs = op_def.lower(node.params, ins, rep_params.get(_node_key(node), {}), ctx)
                     for i, o in enumerate(outs):
                         local[(node.guid, i)] = o
-                return local[(template[out_pos[0]].guid, out_pos[1])], None
+                aux_out = aux_in
+                for a in ctx.aux_losses:
+                    aux_out = aux_out + a.astype(jnp.float32)
+                return (local[(template[out_pos[0]].guid, out_pos[1])], aux_out), None
 
-            act, _ = jax.lax.scan(body, act, (stage_params, jnp.arange(r)))
+            aux0 = jnp.zeros((), jnp.float32)
+            if hasattr(jax.lax, "pcast"):
+                # newer shard_map tracks varying manual axes: the aux
+                # accumulator picks up pipe (per-stage weights) and data
+                # (per-shard tokens) variance inside the scan
+                from ..parallel.mesh import DATA_AXIS, PIPE_AXIS
+
+                vaxes = (PIPE_AXIS,)
+                if DATA_AXIS in self.mesh.axis_names and self.mesh.shape[DATA_AXIS] > 1:
+                    vaxes = vaxes + (DATA_AXIS,)
+                aux0 = jax.lax.pcast(aux0, vaxes, to="varying")
+            (act, aux_sum), _ = jax.lax.scan(
+                body, (act, aux0), (stage_params, jnp.arange(r))
+            )
+            if with_aux:
+                return act, aux_sum
             return act
 
-        y = gpipe(stage_fn, n_microbatches=plan.n_microbatches, mesh=self.mesh)(
-            params[_PIPE_KEY], x
+        pipelined = gpipe(
+            stage_fn,
+            n_microbatches=plan.n_microbatches,
+            mesh=self.mesh,
+            with_aux=with_aux,
         )
+        if with_aux:
+            y, pipe_aux = pipelined(params[_PIPE_KEY], x)
+        else:
+            y = pipelined(params[_PIPE_KEY], x)
+            pipe_aux = None
         values[plan.out_src] = y
         post_ctx = self._interpret_nodes(plan.post, values, params, state, rng, training)
         aux = pre_ctx.aux_losses + post_ctx.aux_losses
+        if pipe_aux is not None:
+            aux = aux + [pipe_aux]
         updates = dict(pre_ctx.state_updates)
+        updates.update(post_ctx.state_updates)
+        new_state = _apply_state_updates(state, updates, self.graph)
+        outputs = [values[(g, i)] for g, i in self.outputs]
+        return outputs, new_state, aux
+
+    def _forward_remat(self, params, state, inputs, rng):
+        """Plain interpretation with each repeated block wrapped in
+        jax.checkpoint: the backward pass recomputes block activations
+        instead of keeping them live — the TPU-native HBM/FLOPs trade
+        ("use remat to trade FLOPs for memory"); numerically identical
+        to the plain path."""
+        pre, repeats, post = self._remat_plan
+        values: Dict[Tuple[int, int], jax.Array] = {}
+        for node in pre:
+            if node.op_type == OpType.INPUT:
+                v = inputs[node.params.input_index]
+                values[(node.guid, 0)] = self._constrain_output(node.guid, 0, v)
+        pre_ctx = self._interpret_nodes(
+            [n for n in pre if n.op_type != OpType.INPUT],
+            values, params, state, rng, training=True,
+        )
+        aux = list(pre_ctx.aux_losses)
+        updates = dict(pre_ctx.state_updates)
+        wanted = set(self.outputs)
+        for rep in repeats:
+            guids = {n.guid for n in rep}
+            ext_in = sorted(
+                {
+                    (e.src, e.src_idx)
+                    for n in rep
+                    for e in self.graph.in_edges(n)
+                    if e.src not in guids
+                }
+            )
+            ext_out = sorted(
+                {
+                    (e.src, e.src_idx)
+                    for n in rep
+                    for e in self.graph.out_edges(n)
+                    if e.dst not in guids
+                }
+                | {(g, i) for (g, i) in wanted if g in guids}
+            )
+            rep_params = {_node_key(n): params.get(_node_key(n), {}) for n in rep}
+            rep_state = {_node_key(n): state.get(_node_key(n), {}) for n in rep}
+
+            def block_fn(rep_params, rep_state, ext_vals, *, _rep=rep, _in=ext_in, _out=ext_out):
+                local = dict(zip(_in, ext_vals))
+                ctx = self._interpret_nodes(
+                    _rep, local, rep_params, rep_state, rng, training=True
+                )
+                upd = {f"{g}\x00{name}": v for (g, name), v in ctx.state_updates.items()}
+                return (
+                    tuple(local[k] for k in _out),
+                    tuple(ctx.aux_losses),
+                    upd,
+                )
+
+            outs, aux_j, upd_j = jax.checkpoint(block_fn)(
+                rep_params, rep_state, tuple(values[k] for k in ext_in)
+            )
+            for k, v in zip(ext_out, outs):
+                values[k] = v
+            aux.extend(aux_j)
+            for key, v in upd_j.items():
+                g, name = key.split("\x00", 1)
+                updates[(int(g), name)] = v
+        post_ctx = self._interpret_nodes(post, values, params, state, rng, training=True)
+        aux.extend(post_ctx.aux_losses)
         updates.update(post_ctx.state_updates)
         new_state = _apply_state_updates(state, updates, self.graph)
         outputs = [values[(g, i)] for g, i in self.outputs]
