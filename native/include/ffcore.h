@@ -170,6 +170,18 @@ int64_t ffc_model_mha(ffc_model_t *model, int64_t query, int64_t key,
                       const char *name);
 int64_t ffc_model_softmax(ffc_model_t *model, int64_t input, const char *name);
 
+/* Generic builder: call any FFModel layer method by name with
+ * JSON-encoded arguments, e.g.
+ *   ffc_model_call(m, "conv2d",
+ *     "{\"args\": [{\"__tensor__\": 0}, 8, 3, 3, 1, 1, 1, 1],"
+ *     " \"kwargs\": {\"name\": \"c1\"}}")
+ * Tensor handles encode as {"__tensor__": id}. Multi-output builders
+ * push every output tensor; the return value is the FIRST output's id
+ * and the rest follow consecutively. Full surface parity with the
+ * reference's per-function C wrappers (python/flexflow_c.cc). */
+int64_t ffc_model_call(ffc_model_t *model, const char *method,
+                       const char *json_args);
+
 /* loss_type: "mean_squared_error" | "sparse_categorical_crossentropy" | ...
  * (core/types.py LossType values). Returns 0 on success. */
 int32_t ffc_model_compile(ffc_model_t *model, double learning_rate,
@@ -182,6 +194,14 @@ double ffc_model_fit_step(ffc_model_t *model, const double *x,
                           const int64_t *x_shape, int32_t x_ndims,
                           const double *y, const int64_t *y_shape,
                           int32_t y_ndims, int32_t y_is_labels);
+
+/* Forward pass; flattens the first model output into `out` (float64).
+ * Returns elements written (-1 on error/capacity); out_shape/out_ndims
+ * (in: capacity of out_shape; out: rank) receive the output shape. */
+int64_t ffc_model_predict(ffc_model_t *model, const double *x,
+                          const int64_t *x_shape, int32_t x_ndims,
+                          double *out, int64_t out_capacity,
+                          int64_t *out_shape, int32_t *out_ndims);
 
 /* ------------------------------------------------------------------ *
  * Dataloader kernels (reference: SingleDataLoader's batched index

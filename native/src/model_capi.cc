@@ -242,6 +242,114 @@ int64_t ffc_model_softmax(ffc_model_t *handle, int64_t input,
   return push_tensor(m, t);
 }
 
+int64_t ffc_model_call(ffc_model_t *handle, const char *method,
+                       const char *json_args) {
+  // Generic builder: any FFModel layer method, args JSON-encoded, tensor
+  // handles as {"__tensor__": id}. One C entry covers the ~60-builder
+  // surface the reference's flexflow_c.cc wrapped function-by-function
+  // (1937 LoC of hand glue) — the embedded interpreter gives it to us
+  // reflectively. Multi-output builders (top_k, split, ...) push every
+  // output; the returned id is the FIRST, the rest follow consecutively.
+  Model *m = reinterpret_cast<Model *>(handle);
+  Gil gil;
+  PyObject *jsonmod = PyImport_ImportModule("json");
+  if (!jsonmod) {
+    report_and_clear();
+    return -1;
+  }
+  PyObject *parsed = PyObject_CallMethod(jsonmod, "loads", "s",
+                                         json_args ? json_args : "{}");
+  Py_DECREF(jsonmod);
+  if (!parsed) {
+    report_and_clear();
+    return -1;
+  }
+  PyObject *args_list = PyDict_GetItemString(parsed, "args");      // borrowed
+  PyObject *kwargs_in = PyDict_GetItemString(parsed, "kwargs");    // borrowed
+
+  // resolve {"__tensor__": id} placeholders (recursively for lists)
+  struct Resolver {
+    Model *m;
+    PyObject *resolve(PyObject *v) {  // returns NEW reference
+      if (PyDict_Check(v)) {
+        PyObject *tid = PyDict_GetItemString(v, "__tensor__");
+        if (tid) {
+          PyObject *t = get_tensor(m, PyLong_AsLongLong(tid));
+          if (t) Py_INCREF(t);
+          return t;
+        }
+      }
+      if (PyList_Check(v)) {
+        PyObject *out = PyList_New(PyList_Size(v));
+        for (Py_ssize_t i = 0; i < PyList_Size(v); ++i) {
+          PyObject *r = resolve(PyList_GetItem(v, i));
+          if (!r) {
+            Py_DECREF(out);
+            return nullptr;
+          }
+          PyList_SetItem(out, i, r);
+        }
+        return out;
+      }
+      Py_INCREF(v);
+      return v;
+    }
+  } R{m};
+
+  Py_ssize_t nargs = args_list && PyList_Check(args_list) ? PyList_Size(args_list) : 0;
+  PyObject *args = PyTuple_New(nargs);
+  bool ok = true;
+  for (Py_ssize_t i = 0; i < nargs; ++i) {
+    PyObject *r = R.resolve(PyList_GetItem(args_list, i));
+    if (!r) {
+      ok = false;
+      break;
+    }
+    PyTuple_SetItem(args, i, r);
+  }
+  PyObject *kwargs = PyDict_New();
+  if (ok && kwargs_in && PyDict_Check(kwargs_in)) {
+    PyObject *k, *v;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(kwargs_in, &pos, &k, &v)) {
+      PyObject *r = R.resolve(v);
+      if (!r) {
+        ok = false;
+        break;
+      }
+      PyDict_SetItem(kwargs, k, r);
+      Py_DECREF(r);
+    }
+  }
+  int64_t result = -1;
+  if (ok) {
+    PyObject *fn = PyObject_GetAttrString(m->model, method);
+    PyObject *out = fn ? PyObject_Call(fn, args, kwargs) : nullptr;
+    Py_XDECREF(fn);
+    if (out) {
+      if (PyTuple_Check(out) || PyList_Check(out)) {
+        PyObject *seq = PySequence_Fast(out, "builder output");
+        Py_ssize_t nout = PySequence_Fast_GET_SIZE(seq);
+        for (Py_ssize_t i = 0; i < nout; ++i) {
+          PyObject *t = PySequence_Fast_GET_ITEM(seq, i);
+          Py_INCREF(t);
+          int64_t id = push_tensor(m, t);
+          if (i == 0) result = id;
+        }
+        Py_DECREF(seq);
+        Py_DECREF(out);
+      } else {
+        result = push_tensor(m, out);
+      }
+    }
+  }
+  if (result < 0) report_and_clear();
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(parsed);
+  return result;
+}
+
 int32_t ffc_model_compile(ffc_model_t *handle, double learning_rate,
                           const char *loss_type) {
   Model *m = reinterpret_cast<Model *>(handle);
@@ -331,6 +439,66 @@ double ffc_model_fit_step(ffc_model_t *handle, const double *x,
   Py_DECREF(xa);
   Py_DECREF(ya);
   return loss;
+}
+
+int64_t ffc_model_predict(ffc_model_t *handle, const double *x,
+                          const int64_t *x_shape, int32_t x_ndims,
+                          double *out, int64_t out_capacity,
+                          int64_t *out_shape, int32_t *out_ndims) {
+  // Forward pass on one input batch; flattens the first model output
+  // into the caller's float64 buffer. Returns the element count written,
+  // or -1 on error / insufficient capacity.
+  Model *m = reinterpret_cast<Model *>(handle);
+  Gil gil;
+  if (!m->compiled) return -1;
+  PyObject *xa = array_from(x, x_shape, x_ndims, false);
+  if (!xa) {
+    report_and_clear();
+    return -1;
+  }
+  PyObject *executor = PyObject_GetAttrString(m->model, "executor");
+  PyObject *inputs = PyList_New(1);
+  Py_INCREF(xa);
+  PyList_SetItem(inputs, 0, xa);
+  PyObject *outs = executor
+                       ? PyObject_CallMethod(executor, "predict", "O", inputs)
+                       : nullptr;
+  int64_t written = -1;
+  if (outs && PySequence_Check(outs) && PySequence_Size(outs) > 0) {
+    PyObject *first = PySequence_GetItem(outs, 0);
+    PyObject *np = PyImport_ImportModule("numpy");
+    // bulk copy through tobytes() — no per-element Python objects on the
+    // inference hot path (mirror of array_from's frombuffer direction)
+    PyObject *arr = np ? PyObject_CallMethod(np, "ascontiguousarray", "Os", first, "float64") : nullptr;
+    PyObject *bytes = arr ? PyObject_CallMethod(arr, "tobytes", nullptr) : nullptr;
+    char *buf = nullptr;
+    Py_ssize_t blen = 0;
+    if (bytes && PyBytes_AsStringAndSize(bytes, &buf, &blen) == 0) {
+      int64_t n = blen / (Py_ssize_t)sizeof(double);
+      if (n <= out_capacity) {
+        std::memcpy(out, buf, (size_t)blen);
+        written = n;
+        if (out_shape && out_ndims) {
+          PyObject *shp = PyObject_GetAttrString(arr, "shape");
+          int32_t nd = static_cast<int32_t>(PyTuple_Size(shp));
+          for (int32_t i = 0; i < nd && i < *out_ndims; ++i)
+            out_shape[i] = PyLong_AsLongLong(PyTuple_GetItem(shp, i));
+          *out_ndims = nd;
+          Py_DECREF(shp);
+        }
+      }
+    }
+    Py_XDECREF(bytes);
+    Py_XDECREF(arr);
+    Py_XDECREF(np);
+    Py_XDECREF(first);
+  }
+  if (written < 0) report_and_clear();
+  Py_XDECREF(outs);
+  Py_XDECREF(executor);
+  Py_DECREF(inputs);
+  Py_DECREF(xa);
+  return written;
 }
 
 }  // extern "C"

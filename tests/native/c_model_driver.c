@@ -25,7 +25,13 @@ int main(void) {
   int64_t dims[2] = {BATCH, IN_DIM};
   int64_t x = ffc_model_input(m, dims, 2, "x");
   int64_t h = ffc_model_dense(m, x, 64, "relu", "fc1");
-  int64_t h2 = ffc_model_dense(m, h, CLASSES, "none", "fc2");
+  /* generic JSON builder path (full layer-surface parity) */
+  char spec[256];
+  snprintf(spec, sizeof spec,
+           "{\"args\": [{\"__tensor__\": %lld}, %d],"
+           " \"kwargs\": {\"name\": \"fc2\"}}",
+           (long long)h, CLASSES);
+  int64_t h2 = ffc_model_call(m, "dense", spec);
   int64_t sm = ffc_model_softmax(m, h2, "sm");
   if (x < 0 || h < 0 || h2 < 0 || sm < 0) {
     fprintf(stderr, "graph build failed\n");
@@ -62,6 +68,23 @@ int main(void) {
     last = loss;
     printf("step %d loss %.6f\n", step, loss);
   }
+  /* forward pass through the C surface */
+  static double probs[BATCH * CLASSES];
+  int64_t oshape[4];
+  int32_t ondims = 4;
+  int64_t n = ffc_model_predict(m, xb, xshape, 2, probs,
+                                BATCH * CLASSES, oshape, &ondims);
+  if (n != BATCH * CLASSES || ondims != 2 || oshape[1] != CLASSES) {
+    fprintf(stderr, "predict failed: n=%lld ndims=%d\n", (long long)n, ondims);
+    return 1;
+  }
+  double rowsum = 0.0;
+  for (int c = 0; c < CLASSES; ++c) rowsum += probs[c];
+  if (rowsum < 0.99 || rowsum > 1.01) {
+    fprintf(stderr, "softmax row sum %f\n", rowsum);
+    return 1;
+  }
+
   ffc_model_destroy(m);
   if (!(last < first)) {
     fprintf(stderr, "loss did not decrease: %f -> %f\n", first, last);
