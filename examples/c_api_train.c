@@ -38,7 +38,11 @@ int main(void) {
            (long long)h);
   int64_t d = ffc_model_call(m, "dropout", spec);
   int64_t logits = ffc_model_dense(m, d, CLASSES, "none", "fc2");
-  ffc_model_softmax(m, logits, "sm");
+  int64_t sm = ffc_model_softmax(m, logits, "sm");
+  if (x < 0 || h < 0 || d < 0 || logits < 0 || sm < 0) {
+    fprintf(stderr, "graph build failed\n");
+    return 1;
+  }
 
   if (ffc_model_compile(m, 0.05, "sparse_categorical_crossentropy") != 0) return 1;
 

@@ -209,7 +209,10 @@ def megatron_strategy(
             spec = None
             if node.op_type != OpType.WEIGHT and os.ndim > batch_dim and os.shape[batch_dim] % dp == 0:
                 axes: List[Optional[str]] = [None] * os.ndim
-                axes[batch_dim] = DATA_AXIS
+                # build_mesh drops size-1 axes; a spec must not reference
+                # a "data" axis the mesh won't have when dp == 1
+                if dp > 1:
+                    axes[batch_dim] = DATA_AXIS
                 # sequence parallelism: shard seq dim of 3-D activations on
                 # the model axis outside the attention/ff regions
                 if (

@@ -138,6 +138,12 @@ class GraphXfer:
             for node in nodes:
                 if node.guid in used or not pat.matches(node):
                     continue
+                # pattern ops spell out EVERY input of the op they match;
+                # arity must agree exactly or a 2-input concat pattern
+                # swallows a 3-input concat and apply() drops an input
+                # (reference: can_match checks numInputs, substitution.cc)
+                if len(graph.in_edges(node)) != len(pat.inputs):
+                    continue
                 if not wiring_ok(i, node):
                     continue
                 assign[i] = node
@@ -612,7 +618,11 @@ def generate_all_pcg_xfers(
         xfers.append(create_partition_add_combine(d))
         xfers.append(_partition_unary_combine(OpType.RELU, d))
         xfers.append(_partition_unary_combine(OpType.SOFTMAX, d))
+        # per arity: the matcher requires exact input counts (reference
+        # generates per-arity mapping xfers the same way)
         xfers.append(create_partition_concat_combine(d))
+        xfers.append(create_partition_concat_combine(d, num_inputs=3))
+        xfers.append(create_partition_concat_combine(d, num_inputs=4))
         xfers.append(create_combine_inception(d))
         xfers.append(leading_relu_branch_combine(d))
         xfers.append(leading_relu_branch_partition(d))

@@ -274,8 +274,17 @@ int64_t ffc_model_call(ffc_model_t *handle, const char *method,
       if (PyDict_Check(v)) {
         PyObject *tid = PyDict_GetItemString(v, "__tensor__");
         if (tid) {
-          PyObject *t = get_tensor(m, PyLong_AsLongLong(tid));
-          if (t) Py_INCREF(t);
+          int64_t id = PyLong_AsLongLong(tid);
+          PyObject *t = get_tensor(m, id);
+          if (t) {
+            Py_INCREF(t);
+          } else {
+            // every other failure mode prints a traceback; a stale
+            // tensor id must be diagnosable too
+            PyErr_Format(PyExc_IndexError,
+                         "ffc_model_call: invalid tensor id %lld",
+                         (long long)id);
+          }
           return t;
         }
       }

@@ -145,3 +145,51 @@ def test_searched_strategy_matches_single_device(builder):
                 atol=2e-5,
                 err_msg=f"{name}.{wn} diverged under the searched strategy",
             )
+
+
+# ------------------------------------------------------ random small PCGs
+def _random_graph(m, seed):
+    """Seeded random DAG from a small op vocabulary (dense/relu/add/
+    concat/layernorm) with random widths and occasional branches —
+    the 'N random small PCGs' half of the property."""
+    rs = np.random.RandomState(seed)
+    width = int(rs.choice([16, 24, 32]))
+    x = m.create_tensor((16, width), name="x")
+    frontier = [x]
+    for i in range(int(rs.randint(3, 7))):
+        t = frontier[rs.randint(len(frontier))]
+        kind = rs.choice(["dense", "relu", "branch", "ln"])
+        if kind == "dense":
+            t = m.dense(t, int(rs.choice([16, 32, 48])), name=f"d{seed}_{i}")
+            frontier.append(t)
+        elif kind == "relu":
+            frontier.append(m.relu(t, name=f"r{seed}_{i}"))
+        elif kind == "ln":
+            frontier.append(m.layer_norm(t, axes=[1], name=f"ln{seed}_{i}"))
+        else:  # branch + concat: two parallel denses rejoined
+            a = m.dense(t, 16, ActiMode.RELU, name=f"ba{seed}_{i}")
+            b = m.dense(t, 16, ActiMode.RELU, name=f"bb{seed}_{i}")
+            frontier.append(m.concat([a, b], axis=1, name=f"cat{seed}_{i}"))
+    # join every dangling leaf into one sink (all are [16, w] 2-D)
+    leaves = [t for t in frontier if not m.graph.out_edges(t.node.guid)]
+    t = leaves[0] if len(leaves) == 1 else m.concat(leaves, axis=1, name=f"join{seed}")
+    t = m.dense(t, 8, name=f"out{seed}")
+    m.softmax(t, name=f"sm{seed}")
+    return (16, width), "class", 8
+
+
+@pytest.mark.parametrize("seed", [11, 23, 42])
+def test_random_pcg_searched_matches_single_device(seed):
+    builder = lambda m, rs: _random_graph(m, seed)
+    builder.__name__ = f"_random{seed}"
+    m1, in_shape, kind, out = _build(builder, workers=1, budget=0)
+    m8, _, _, _ = _build(builder, workers=8, budget=5)
+    _copy_params(m1, m8)
+    rs = np.random.RandomState(seed)
+    x = jnp.asarray(rs.randn(*in_shape), jnp.float32)
+    y = jnp.asarray(rs.randint(0, out, (in_shape[0],)), jnp.int32)
+    rng = jax.random.key(0)
+    l1 = [float(m1.executor.train_batch([x], y, rng)["loss"]) for _ in range(3)]
+    # rebuild identical data for the second model (rng state consumed)
+    l8 = [float(m8.executor.train_batch([x], y, rng)["loss"]) for _ in range(3)]
+    np.testing.assert_allclose(l1, l8, rtol=2e-4, atol=1e-5)
