@@ -51,6 +51,8 @@ class SearchResult:
     allreduce_saved: float = 0.0
     # (pp, n_microbatches) when the search chose pipeline parallelism
     pipeline: Optional[Tuple[int, int]] = None
+    # (dp, cp) when the search chose sequence/context parallelism
+    context_parallel: Optional[Tuple[int, int]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -322,6 +324,37 @@ def strategy_from_pcg(
 
 
 # ---------------------------------------------------------------------------
+# shared cost primitives for the pipeline / context-parallel proposers
+# ---------------------------------------------------------------------------
+
+
+def _is_compute(node) -> bool:
+    return (
+        node.op_type not in (OpType.INPUT, OpType.WEIGHT, OpType.NOOP)
+        and node.op_type not in PARALLEL_OP_TYPES
+    )
+
+
+def _op_fwd_bwd_time(cost_model: CostModel, specs_map, graph: PCGraph, node, parts: int) -> float:
+    in_specs = [specs_map[e.src][e.src_idx] for e in graph.in_edges(node)]
+    out_specs = specs_map[node.guid]
+    cm = cost_model.op_cost_metrics(node.op_type, node.params, in_specs, out_specs, parts)
+    return cm.forward_time + cm.backward_time
+
+
+def _weight_bytes(specs_map, graph: PCGraph, nodes) -> float:
+    total = 0.0
+    for node in nodes:
+        in_specs = [specs_map[e.src][e.src_idx] for e in graph.in_edges(node)]
+        try:
+            wspecs = get_op_def(node.op_type).weight_specs(node.params, in_specs)
+        except Exception:
+            continue
+        total += sum(w.spec.size_bytes for w in wspecs)
+    return total
+
+
+# ---------------------------------------------------------------------------
 # pipeline-parallel candidates
 # ---------------------------------------------------------------------------
 
@@ -376,27 +409,14 @@ def _propose_pipeline(
     boundary_bytes = specs_map[b_guid][b_idx].size_bytes
 
     def op_time(node, n_parts: int) -> float:
-        in_specs = [specs_map[e.src][e.src_idx] for e in graph.in_edges(node)]
-        out_specs = specs_map[node.guid]
-        cm = cost_model.op_cost_metrics(node.op_type, node.params, in_specs, out_specs, n_parts)
-        return cm.forward_time + cm.backward_time
+        return _op_fwd_bwd_time(cost_model, specs_map, graph, node, n_parts)
 
-    def weight_bytes(nodes) -> float:
-        total = 0.0
-        for node in nodes:
-            in_specs = [specs_map[e.src][e.src_idx] for e in graph.in_edges(node)]
-            try:
-                wspecs = get_op_def(node.op_type).weight_specs(node.params, in_specs)
-            except Exception:
-                continue
-            total += sum(w.spec.size_bytes for w in wspecs)
-        return total
-
-    compute = lambda n: n.op_type not in (OpType.INPUT, OpType.WEIGHT, OpType.NOOP) and n.op_type not in PARALLEL_OP_TYPES
-    outer_nodes = [n for n in pre + post if compute(n)]
-    block_nodes = [n for n in repeats[0] if compute(n)]
-    repeat_wbytes = weight_bytes([n for rep in repeats for n in rep if compute(n)])
-    outer_wbytes = weight_bytes(outer_nodes)
+    outer_nodes = [n for n in pre + post if _is_compute(n)]
+    block_nodes = [n for n in repeats[0] if _is_compute(n)]
+    repeat_wbytes = _weight_bytes(
+        specs_map, graph, [n for rep in repeats for n in rep if _is_compute(n)]
+    )
+    outer_wbytes = _weight_bytes(specs_map, graph, outer_nodes)
 
     best: Optional[_PipelineCandidate] = None
     pp = 2
@@ -426,6 +446,80 @@ def _propose_pipeline(
         if best is None or total < best.cost:
             best = _PipelineCandidate(total, pp, M, mem)
         pp *= 2
+    return best
+
+
+# ---------------------------------------------------------------------------
+# sequence/context-parallel candidates
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _ContextParallelCandidate:
+    cost: float
+    dp: int
+    cp: int
+    memory_per_device: float = 0.0
+
+
+def _propose_context_parallel(
+    graph: PCGraph,
+    num_devices: int,
+    cost_model: CostModel,
+    batch: int,
+) -> Optional[_ContextParallelCandidate]:
+    """Cost (dp, cp) sequence-parallel candidates (NEW capability — the
+    reference has no sequence parallelism, SURVEY §5; this is the search
+    half of the repo's ring-attention executor path). The regime: batch
+    too small to fill the machine with data parallelism alone — the
+    long-context case — so the sequence dim of every activation shards
+    over the "seq" axis and attention rides the ICI ring, K/V blocks
+    rotating cp-1 hops per direction (ops/kernels/ring_attention.py)."""
+    specs_map = infer_all_specs(graph)
+    attn_nodes = [
+        n for n in graph.topo_order() if n.op_type == OpType.MULTIHEAD_ATTENTION
+    ]
+    if not attn_nodes:
+        return None
+    # sequence length from the attention input (convention: [B, S, E])
+    first_in = [specs_map[e.src][e.src_idx] for e in graph.in_edges(attn_nodes[0])]
+    if not first_in or first_in[0].ndim != 3:
+        return None
+    seq_len = first_in[0].shape[1]
+
+    wbytes = _weight_bytes(specs_map, graph, graph.topo_order())
+    # loop-invariant: every accepted candidate uses ALL devices
+    # (parts = dp * cp = num_devices) and replicates all weights — only
+    # the ring term below varies with cp
+    base = sum(
+        _op_fwd_bwd_time(cost_model, specs_map, graph, n, num_devices)
+        for n in graph.topo_order()
+        if _is_compute(n)
+    )
+    base += cost_model.allreduce_time(wbytes, num_devices)
+    # CP replicates weights: per-device footprint is the full 4x set
+    # (param + grad + 2 moments) regardless of cp
+    mem = 4.0 * wbytes
+    best: Optional[_ContextParallelCandidate] = None
+    cp = 2
+    while cp <= min(seq_len, num_devices):
+        if num_devices % cp != 0 or seq_len % cp != 0:
+            cp *= 2
+            continue
+        dp = num_devices // cp
+        if batch % max(1, dp) != 0:
+            cp *= 2
+            continue
+        total = base
+        # ring attention: K and V blocks rotate cp-1 hops, fwd + bwd
+        for node in attn_nodes:
+            ins = [specs_map[e.src][e.src_idx] for e in graph.in_edges(node)]
+            s = ins[0]
+            kv_bytes = 2.0 * s.size_bytes / max(1, num_devices)
+            total += 2.0 * (cp - 1) * cost_model.p2p_time(kv_bytes)
+        if best is None or total < best.cost:
+            best = _ContextParallelCandidate(total, dp, cp, mem)
+        cp *= 2
     return best
 
 
@@ -625,11 +719,38 @@ def unity_optimize(
     if num_devices > 1 and not config.only_data_parallel:
         batch = config.batch_size
         pipe = _propose_pipeline(graph, num_devices, cost_model, batch)
+        # sequence/context parallelism: wins when the batch can't fill
+        # the machine (long-context regime) — cheaper by simulated cost
+        # than both the DP winner and any pipeline candidate
+        capacity = machine.chip.hbm_capacity
+        cpc = _propose_context_parallel(graph, num_devices, cost_model, batch)
+        if (
+            cpc is not None
+            and cpc.cost < result_dp.cost
+            and (pipe is None or cpc.cost < pipe.cost)
+            # CP replicates all weights on every device — its OWN
+            # footprint must fit; memory-pressure regimes go to the
+            # pipeline candidate below (the DP winner may shard weights,
+            # so result_dp fitting says nothing about CP fitting)
+            and cpc.memory_per_device <= capacity
+            and result_dp.memory_per_device <= capacity
+        ):
+            from ..parallel.strategy import context_parallel_strategy
+
+            strategy = context_parallel_strategy(graph, dp=cpc.dp, cp=cpc.cp)
+            return strategy, SearchResult(
+                graph=graph,
+                views={},
+                best_cost=cpc.cost,
+                candidates_explored=stats.candidates_explored,
+                memory_per_device=cpc.memory_per_device,
+                lambda_used=lam,
+                context_parallel=(cpc.dp, cpc.cp),
+            )
         # adopt pipeline when it beats the substitution/DP winner on time,
         # OR when that winner overflows per-device HBM and pipeline fits —
         # the memory-pressure regime pipeline parallelism exists for
         # (reference analog: the λ memory search, graph.cc:2075-2131)
-        capacity = machine.chip.hbm_capacity
         adopt = pipe is not None and (
             pipe.cost < result_dp.cost
             or (

@@ -108,3 +108,47 @@ def test_context_parallel_training_e2e():
     m2 = model.executor.train_batch([x], y, jax.random.key(1))
     assert np.isfinite(float(m1["loss"]))
     assert float(m2["loss"]) < float(m1["loss"])
+
+
+def test_search_proposes_context_parallelism_for_long_sequences():
+    """Round-3: the search proposes sequence/context parallelism (NEW
+    capability — the reference has none, SURVEY §5). Long sequences with
+    a batch too small to fill the machine pick dp x cp; the compiled
+    model trains with ring attention over the "seq" axis. Short
+    sequences stay non-CP."""
+    from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+    from flexflow_tpu.search.unity import unity_optimize
+
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=128, num_heads=4, ff_size=256, seq_length=512
+    )
+    config = FFConfig(batch_size=4, workers_per_node=8, search_budget=3)
+    m = build_transformer(config, cfg)
+    strategy, sr = unity_optimize(m.graph, config)
+    assert sr.context_parallel is not None, "long-context should pick dp x cp"
+    dp, cp = sr.context_parallel
+    assert cp >= 2 and strategy.axis_sizes.get("seq", 1) == cp
+
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR,
+        strategy=strategy,
+    )
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 512, 128), jnp.float32)
+    y = x * 0.5
+    losses = [
+        float(m.executor.train_batch([x], y, jax.random.key(0))["loss"])
+        for _ in range(3)
+    ]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    # short sequences: no CP proposed
+    cfg2 = TransformerConfig(
+        num_layers=2, hidden_size=128, num_heads=4, ff_size=256, seq_length=128
+    )
+    m2 = build_transformer(config, cfg2)
+    _, sr2 = unity_optimize(m2.graph, config)
+    assert sr2.context_parallel is None
