@@ -287,8 +287,17 @@ def strategy_from_pcg(
                 outputs.append(None)
                 continue
             if st.replica > 1:
-                # partial-sum tensor: leave unconstrained, GSPMD resolves at
-                # the downstream Reduction (reference: replica dims)
+                # Partial-sum tensor (row-parallel matmul output before its
+                # Reduction). Deliberately UNconstrained: PartitionSpec has
+                # no partial-sum vocabulary, and pinning any layout here
+                # (e.g. P('data', None)) asserts replicated-equal values
+                # over the model axis — forcing GSPMD to allreduce EARLY
+                # and double-reducing at the downstream Reduction node.
+                # Correctness is pinned instead by the searched-vs-single-
+                # device property suite (tests/test_searched_equivalence.py);
+                # the post-Reduction tensor IS constrained (its state has
+                # replica == 1 again). Reference analog: replica dims exist
+                # only between a parallel op pair, parallel_tensor.h:70.
                 outputs.append(None)
                 continue
             axes: List[Optional[str]] = [None] * os.ndim
@@ -475,12 +484,12 @@ def _propose_context_parallel(
     long-context case — so the sequence dim of every activation shards
     over the "seq" axis and attention rides the ICI ring, K/V blocks
     rotating cp-1 hops per direction (ops/kernels/ring_attention.py)."""
-    specs_map = infer_all_specs(graph)
     attn_nodes = [
         n for n in graph.topo_order() if n.op_type == OpType.MULTIHEAD_ATTENTION
     ]
     if not attn_nodes:
-        return None
+        return None  # cheap bail-out BEFORE the whole-graph spec inference
+    specs_map = infer_all_specs(graph)
     # sequence length from the attention input (convention: [B, S, E])
     first_in = [specs_map[e.src][e.src_idx] for e in graph.in_edges(attn_nodes[0])]
     if not first_in or first_in[0].ndim != 3:
