@@ -44,4 +44,30 @@ __all__ = [
     "SGDOptimizer",
     "AdamOptimizer",
     "Optimizer",
+    # lazy (see __getattr__): round-3 user-facing additions
+    "ElasticTrainer",
+    "ParallelDim",
+    "ParallelTensorView",
+    "initialize_distributed",
 ]
+
+
+def __getattr__(name):
+    # lazy: these pull in orbax / jax.distributed machinery only when used
+    if name == "ElasticTrainer":
+        from .runtime.elastic import ElasticTrainer
+
+        return ElasticTrainer
+    if name in ("ParallelTensorView", "ParallelDim"):
+        from .core import parallel_tensor
+
+        return getattr(parallel_tensor, name)
+    if name == "initialize_distributed":
+        from .parallel.distributed import initialize_distributed
+
+        return initialize_distributed
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + __all__))
