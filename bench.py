@@ -65,9 +65,13 @@ def peak_flops_per_device(device_kind: str, backend: str) -> float:
 def _bench_one(ex, batch, cfg, iters):
     """Measure steady-state step time of a compiled executor.
 
-    jax.block_until_ready does not reliably block through the axon
-    tunnel, so every flush is a scalar readback (float(loss)); steady
-    state is a long chained run after two warmup+flush rounds.
+    The timed unit is a traced multi-step window (train_batch_repeated:
+    lax.scan over the train step inside ONE XLA program — the analog of
+    the reference's Legion iteration tracing), so per-step host dispatch
+    (several ms through the axon tunnel) is excluded from the step time,
+    exactly as it is in a real fit loop that runs traced.
+    jax.block_until_ready does not reliably block through the tunnel, so
+    every flush is a scalar readback (float(loss)).
     """
     import jax
     import jax.numpy as jnp
@@ -76,17 +80,18 @@ def _bench_one(ex, batch, cfg, iters):
     x = jnp.asarray(rs.randn(batch, cfg.seq_length, cfg.hidden_size), cfg.dtype.jnp)
     y = jnp.asarray(rs.randn(batch, cfg.seq_length, cfg.hidden_size), cfg.dtype.jnp)
     rng = jax.random.key(0)
-    mets = ex.train_batch([x], y, rng)  # trace + compile + first run
+    # warmup = compile + first run of the SAME traced-window program the
+    # timed loop uses (a train_batch warmup would compile the single-step
+    # program too — an unused, expensive extra XLA compile)
+    mets = ex.train_batch_repeated([x], y, rng, num_steps=iters)
     float(mets["loss"])
-    for _ in range(3):  # absorb lazy recompilation
-        mets = ex.train_batch([x], y, rng)
-    float(mets["loss"])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        mets = ex.train_batch([x], y, rng)
-    float(mets["loss"])  # single device->host readback flushes the chain
-    dt = time.perf_counter() - t0
-    return dt / iters
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        mets = ex.train_batch_repeated([x], y, rng, num_steps=iters)
+        float(mets["loss"])  # device->host readback flushes the window
+        best = min(best, time.perf_counter() - t0)
+    return best / iters
 
 
 def _capture_calibration(backend: str, kind: str):
@@ -120,6 +125,9 @@ def child_main():
         # jax.config.update, overriding the env var — override it back
         # before any backend initializes (same trick as tests/conftest.py)
         jax.config.update("jax_platforms", "cpu")
+    elif os.environ.get(_FORCE_PLATFORM_ENV) is not None:
+        # mirror whatever platform forcing won the probe campaign
+        jax.config.update("jax_platforms", os.environ[_FORCE_PLATFORM_ENV] or None)
 
     from flexflow_tpu import DataType, FFConfig, LossType, SGDOptimizer
     from flexflow_tpu.models import TransformerConfig, build_transformer
@@ -348,26 +356,46 @@ def _run_child(args, extra_env=None, timeout=None):
 # the probe runs a real (tiny) matmul so a backend that initializes but
 # hangs at dispatch is caught at probe time, not mid-bench
 _PROBE = (
-    "import os, json; os.environ['JAX_PLATFORMS'] = 'tpu'; import jax; "
-    "jax.config.update('jax_platforms', 'tpu'); d = jax.devices(); "
+    "import os, json; import jax; "
+    "fp = os.environ.get('FF_BENCH_FORCE_PLATFORM'); "
+    "fp is not None and jax.config.update('jax_platforms', fp or None); "
+    "d = jax.devices(); "
     "import jax.numpy as jnp; x = jnp.ones((256, 256), jnp.bfloat16); "
     "v = float((x @ x).sum()); "
     "print(json.dumps({'metric': 'probe', 'backend': jax.default_backend(), "
     "'n': len(d), 'kind': getattr(d[0], 'device_kind', ''), 'sum': v}))"
 )
 
+# Platform configs to probe, in order. {} inherits the ambient
+# JAX_PLATFORMS (tunneled TPUs may register under a bridge platform name
+# — e.g. the axon tunnel sets JAX_PLATFORMS=axon yet reports backend
+# 'tpu' — so forcing JAX_PLATFORMS=tpu there fails with 'no TPU found'
+# while the inherited config works). Explicit 'tpu' and autodetect are
+# the fallbacks for plainly-attached chips; those also set
+# _FORCE_PLATFORM_ENV, which the probe/child apply via
+# jax.config.update — hosted sitecustomizes force-select a platform
+# through jax.config, overriding the env var alone.
+_FORCE_PLATFORM_ENV = "FF_BENCH_FORCE_PLATFORM"
+_PLATFORM_CONFIGS = [
+    {},
+    {"JAX_PLATFORMS": "tpu", _FORCE_PLATFORM_ENV: "tpu"},
+    {"JAX_PLATFORMS": "", _FORCE_PLATFORM_ENV: ""},
+]
+
 
 def main():
     me = os.path.abspath(__file__)
     errors = []
     tpu_ok = False
-    # TPU acquisition campaign (VERDICT r2 next-round #1): explicit
-    # JAX_PLATFORMS=tpu, total budget ~13 min, exponential backoff,
+    # TPU acquisition campaign (VERDICT r2 next-round #1): rotate through
+    # _PLATFORM_CONFIGS (inherit first — tunneled chips register under
+    # bridge platform names), total budget ~13 min, exponential backoff,
     # per-attempt timeout 150s, full stderr capture per attempt.
     budget = float(os.environ.get("FF_BENCH_TPU_BUDGET_S", "780"))
     start = time.monotonic()
     delays = [0, 10, 20, 40, 60, 90]
     attempt = 0
+    tpu_env = None
     while True:
         elapsed = time.monotonic() - start
         if elapsed >= budget:
@@ -376,15 +404,19 @@ def main():
         delay = delays[min(attempt, len(delays) - 1)]
         if delay:
             time.sleep(min(delay, max(0.0, budget - (time.monotonic() - start))))
+        cfg_env = _PLATFORM_CONFIGS[attempt % len(_PLATFORM_CONFIGS)]
         per_try = min(150.0, max(30.0, budget - (time.monotonic() - start)))
-        obj, err = _run_child(["-c", _PROBE], {"JAX_PLATFORMS": "tpu"}, timeout=per_try)
-        if obj is not None and obj.get("backend") not in (None, "cpu"):
+        obj, err = _run_child(["-c", _PROBE], cfg_env, timeout=per_try)
+        # only 'tpu' counts: the inherit/autodetect configs could surface
+        # some other accelerator, which must not masquerade as the TPU path
+        if obj is not None and obj.get("backend") == "tpu":
             tpu_ok = True
+            tpu_env = cfg_env
             break
-        errors.append(f"probe[{attempt}] t+{elapsed:.0f}s: {err or 'backend=cpu'}")
+        errors.append(f"probe[{attempt}] {cfg_env or 'inherit'} t+{elapsed:.0f}s: {err or 'backend=cpu'}")
         attempt += 1
     if tpu_ok:
-        obj, err = _run_child([me], {"JAX_PLATFORMS": "tpu"}, timeout=2400)
+        obj, err = _run_child([me], tpu_env, timeout=2400)
         if obj is not None:
             print(json.dumps(obj))
             return

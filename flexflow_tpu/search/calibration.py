@@ -121,19 +121,30 @@ def load_calibration(device_kind: str) -> Optional[Calibration]:
 # measurement
 # ---------------------------------------------------------------------------
 
+# (shape, dtype, inner, backend) -> measured baseline-loop seconds
+_BASELINE_CACHE: Dict[tuple, float] = {}
+
 
 def measure_lowered_op(
     op_type: OpType,
     params,
     input_specs: Sequence[TensorSpec],
     n_parts: int = 1,
-    reps: int = 10,
+    inner: int = 32,
+    reps: int = 3,
 ) -> Optional[float]:
     """Jit one shard of the op's lowering on the default device and time
     it (the reference's inner_measure_operator_cost, operator.h:127).
 
-    The flush is a scalar readback: jax.block_until_ready is unreliable
-    through the tunneled-TPU transport.
+    Per-dispatch overhead on tunneled/remote devices (several ms through
+    the axon relay) dwarfs the microseconds a single op takes, so the op
+    runs ``inner`` times INSIDE one XLA program (lax.fori_loop with a
+    data dependency through the carry so the loop body can't be hoisted),
+    and a structurally-matched baseline loop — same perturb-input and
+    reduce-output passes, no op — is timed the same way and subtracted.
+    Dispatch cost and the dependency-plumbing memory passes cancel,
+    leaving the op's own time. The flush is a scalar readback:
+    jax.block_until_ready is unreliable through the tunneled transport.
     """
     try:
         import jax
@@ -157,21 +168,63 @@ def measure_lowered_op(
             for w in wspecs
         }
         backend = jax.default_backend()
+        if not jnp.issubdtype(args[0].dtype, jnp.floating):
+            inner = 0  # can't thread the carry through integer inputs
 
-        def fn(inputs, weights):
+        def run_op(inputs):
             ctx = LowerCtx(training=False, rng=jax.random.key(0), backend=backend)
             outs = op_def.lower(params, inputs, weights, ctx)
             return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
 
-        jitted = jax.jit(fn)
-        float(jitted(args, weights))  # compile + first run
-        float(jitted(args, weights))
-        t0 = time.perf_counter()
-        acc = None
-        for _ in range(reps):
-            acc = jitted(args, weights)
-        float(acc)
-        return (time.perf_counter() - t0) / reps
+        if inner == 0:  # single-shot fallback (dispatch overhead included)
+            jitted = jax.jit(run_op)
+            float(jitted(args))
+            t0 = time.perf_counter()
+            acc = None
+            for _ in range(max(reps, 1) * 8):
+                acc = jitted(args)
+            float(acc)
+            return (time.perf_counter() - t0) / (max(reps, 1) * 8)
+
+        def perturbed(acc):
+            # cheap data dependency: scales with |inputs[0]|, defeats LICM
+            return [args[0] + (acc * 1e-30).astype(args[0].dtype)] + args[1:]
+
+        def loop_with_op(_):
+            def body(i, acc):
+                return acc + run_op(perturbed(acc))
+
+            return jax.lax.fori_loop(0, inner, body, jnp.float32(0.0))
+
+        def loop_baseline(_):
+            def body(i, acc):
+                x = perturbed(acc)[0]
+                return acc + jnp.sum(x.astype(jnp.float32))
+
+            return jax.lax.fori_loop(0, inner, body, jnp.float32(0.0))
+
+        def timed(fn) -> float:
+            jitted = jax.jit(fn)
+            float(jitted(0))  # compile + first run
+            best = float("inf")
+            for _ in range(max(reps, 1)):
+                t0 = time.perf_counter()
+                float(jitted(0))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t_with = timed(loop_with_op)
+        # the baseline depends only on (shape, dtype, inner, backend) —
+        # memoize it so a suite of ops sharing a first-input signature
+        # pays its compile+timing once
+        base_key = (tuple(args[0].shape), str(args[0].dtype), inner, backend)
+        t_base = _BASELINE_CACHE.get(base_key)
+        if t_base is None:
+            t_base = timed(loop_baseline)
+            _BASELINE_CACHE[base_key] = t_base
+        # floor: never let noisy subtraction return <=0; 5% of the loop
+        # body is a conservative lower bound for the op itself
+        return max(t_with - t_base, 0.05 * t_with) / inner
     except Exception:
         return None
 
