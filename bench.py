@@ -149,7 +149,7 @@ def child_main():
         seq_length=128,
         dtype=DataType.BFLOAT16,
     )
-    batch = 16 * n_dev
+    batch = 32 * n_dev
     iters = 40 if backend != "cpu" else 3
     metric = "bert_base_seq128_train_throughput"
     if backend == "cpu":  # keep the fallback path fast enough to finish;
@@ -179,7 +179,10 @@ def child_main():
 
     model_dp = build(only_dp=True, budget=0)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(model_dp.executor.params))
-    flops_per_token = 6.0 * n_params
+    # 6N (fwd+bwd matmul FLOPs per token) + attention score/value matmuls
+    # 12*L*S*H (2 matmuls x 2S*d_head*heads fwd, x3 for train) — the
+    # PaLM-appendix-style accounting; 6N alone undercounts the work
+    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * cfg.seq_length * cfg.hidden_size
     step_dp = _bench_one(model_dp.executor, batch, cfg, iters)
     graph = model_dp.graph
     del model_dp

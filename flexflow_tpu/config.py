@@ -57,6 +57,13 @@ class FFConfig:
     # (jax.checkpoint per block) — the TPU-native HBM/FLOPs trade the
     # reference never had; pairs with the memory-aware λ search
     remat_blocks: bool = False
+    # iteration-tracing window: fit() scans this many optimizer steps
+    # inside ONE XLA program (the reference amortizes per-iteration
+    # runtime analysis with Legion traces, begin_trace/end_trace
+    # flexflow_cffi.py:2079-2086; here the trace is a lax.scan over
+    # stacked batches, which also removes per-step host dispatch —
+    # dominant over tunneled/remote device transports). 1 = eager.
+    trace_window: int = 1
     # execution flags
     perform_fusion: bool = False  # XLA fuses regardless; kept for CLI parity
     profiling: bool = False
@@ -115,6 +122,7 @@ class FFConfig:
         p.add_argument("--include-costs-dot-graph", action="store_true")
         p.add_argument("--pipeline-stages", type=int, default=1)
         p.add_argument("--remat-blocks", action="store_true")
+        p.add_argument("--trace-window", type=int, default=1)
         p.add_argument("--pipeline-microbatches", type=int, default=0)
         p.add_argument("--topo-file", type=str, default="")
         p.add_argument("--iteration", type=int, default=1)
@@ -155,6 +163,7 @@ class FFConfig:
             include_costs_dot_graph=ns.include_costs_dot_graph,
             pipeline_stages=ns.pipeline_stages,
             remat_blocks=ns.remat_blocks,
+            trace_window=ns.trace_window,
             pipeline_microbatches=ns.pipeline_microbatches,
             topo_file=ns.topo_file,
             iteration=ns.iteration,

@@ -605,32 +605,61 @@ class FFModel:
         epochs: Optional[int] = None,
         batch_size: Optional[int] = None,
         verbose: bool = True,
+        trace_window: Optional[int] = None,
     ) -> PerfMetrics:
-        """Training loop (reference: FFModel.fit flexflow_cffi.py:2044;
-        the begin_trace/end_trace pair is subsumed by jit compile cache)."""
+        """Training loop (reference: FFModel.fit flexflow_cffi.py:2044).
+
+        ``trace_window`` > 1 is the analog of the reference's Legion
+        iteration tracing (begin_trace/end_trace, flexflow_cffi.py:
+        2079-2086): that many steps run as ONE XLA program (lax.scan
+        over stacked batches, executor.train_window), paying host
+        dispatch once per window. Defaults to FFConfig.trace_window.
+        """
         assert self.executor is not None, "call compile() first"
         xs = [x] if isinstance(x, (np.ndarray, jnp.ndarray)) else list(x)
         epochs = epochs or self.config.epochs
         bs = batch_size or self.config.batch_size
+        tw = max(1, trace_window or self.config.trace_window)
         n = xs[0].shape[0]
         steps = n // bs
         rng = jax.random.key(self._seed + 1)
         perf = PerfMetrics()
         if self.config.profiling:  # reference: --profiling per-op timings
             self.profile(x=[xx[:bs] for xx in xs])
+        interval = max(1, self.config.printing_interval)
         t0 = time.time()
         for epoch in range(epochs):
-            for step in range(steps):
-                lo, hi = step * bs, (step + 1) * bs
-                batch_x = [jnp.asarray(xx[lo:hi]) for xx in xs]
-                batch_y = jnp.asarray(y[lo:hi])
+            step = 0
+            while step < steps:
+                # full windows run traced; the tail (k < tw) runs eagerly
+                # on the already-compiled single-step program rather than
+                # paying a whole extra XLA compile for a once-per-epoch
+                # window size
+                k = tw if steps - step >= tw else 1
+                lo = step * bs
                 rng, sub = jax.random.split(rng)
-                mets = self.executor.train_batch(batch_x, batch_y, sub)
-                perf.update({k: float(v) for k, v in mets.items() if k != "loss"})
-                if verbose and step % max(1, self.config.printing_interval) == 0:
-                    loss = float(mets.get("loss", 0.0))
-                    acc = perf.accuracy
-                    print(f"epoch {epoch} step {step}/{steps} loss {loss:.4f} acc {acc:.4f}")
+                if k > 1:
+                    hi = lo + k * bs
+                    wx = [np.asarray(xx[lo:hi]).reshape((k, bs) + xx.shape[1:]) for xx in xs]
+                    wy = np.asarray(y[lo:hi]).reshape((k, bs) + y.shape[1:])
+                    wmets = self.executor.train_window(wx, wy, sub)
+                    host = {kk: np.asarray(v) for kk, v in wmets.items()}
+                    for i in range(k):
+                        perf.update({kk: float(v[i]) for kk, v in host.items() if kk != "loss"})
+                        if verbose and (step + i) % interval == 0:
+                            print(
+                                f"epoch {epoch} step {step + i}/{steps} "
+                                f"loss {float(host.get('loss', np.zeros(k))[i]):.4f} acc {perf.accuracy:.4f}"
+                            )
+                else:
+                    batch_x = [jnp.asarray(xx[lo:lo + bs]) for xx in xs]
+                    batch_y = jnp.asarray(y[lo:lo + bs])
+                    mets = self.executor.train_batch(batch_x, batch_y, sub)
+                    perf.update({kk: float(v) for kk, v in mets.items() if kk != "loss"})
+                    if verbose and step % interval == 0:
+                        loss = float(mets.get("loss", 0.0))
+                        print(f"epoch {epoch} step {step}/{steps} loss {loss:.4f} acc {perf.accuracy:.4f}")
+                step += k
         elapsed = time.time() - t0
         thru = epochs * steps * bs / max(1e-9, elapsed)
         if verbose:

@@ -20,6 +20,18 @@ Works for homogeneous stage stacks (each stage runs the same program
 with its own weights) — the transformer-block case; heterogeneous
 prologue/epilogue (embeddings, heads) run outside the pipelined region
 under the usual dp/tp shardings.
+
+Scope (v1, deliberate): the rotating boundary is exactly ONE activation
+tensor and blocks must be stateless (batchnorm state stays outside the
+stack; MoE aux losses ARE supported via with_aux). This covers the
+standard residual-stream architectures (BERT/GPT/ViT stacks — one
+hidden-state tensor in, one out). Shapes it excludes and why:
+  * blocks consuming a shared external tensor (cross-attention over a
+    fixed encoder output): per-microbatch extras must rotate with the
+    schedule, which needs a tuple carry — planned, not implemented;
+  * multi-stream boundaries (two tensors between blocks): same tuple
+    carry. Models with these shapes train under dp/tp/sp strategies
+    instead (compile() without pipeline_stages).
 """
 from __future__ import annotations
 

@@ -519,6 +519,7 @@ class CompiledExecutor:
             self._train_step_fn = train_step
             self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
             self._multi_step_cache = {}
+            self._window_cache = {}
 
     # ---------------------------------------------------------------- API
     def set_learning_rate(self, lr: float) -> None:
@@ -579,6 +580,48 @@ class CompiledExecutor:
         )
         return mets
 
+    def train_window(
+        self, inputs: Sequence[jax.Array], labels: jax.Array, rng: jax.Array
+    ) -> Dict[str, Any]:
+        """Run one optimizer step per stacked batch inside a single XLA
+        program: ``inputs``/``labels`` carry a leading ``[steps, ...]``
+        axis and lax.scan consumes one slice per step.
+
+        This is the real-data form of the reference's Legion iteration
+        tracing (begin_trace/end_trace around fit,
+        flexflow_cffi.py:2079-2086): host dispatch and runtime analysis
+        are paid once per window instead of once per step. Returns the
+        metrics of every step in the window (leaves shaped [steps]).
+        """
+        if self.optimizer is None:
+            raise RuntimeError("train_window requires a compiled optimizer")
+        w = int(inputs[0].shape[0])
+        jitted = self._window_cache.get(w)
+        if jitted is None:
+            step = self._train_step_fn
+
+            def window(params, opt_state, state, inputs, labels, rng):
+                def body(carry, xs):
+                    p, o, s = carry
+                    ins, lab, r = xs
+                    p, o, s, mets = step(p, o, s, ins, lab, r)
+                    return (p, o, s), mets
+
+                (params, opt_state, state), mets = jax.lax.scan(
+                    body, (params, opt_state, state),
+                    (tuple(inputs), labels, jax.random.split(rng, w)),
+                )
+                return params, opt_state, state, mets
+
+            jitted = jax.jit(window, donate_argnums=(0, 1, 2))
+            self._window_cache[w] = jitted
+        inputs = self._shard_inputs(inputs, leading_axis=True)
+        labels = self.shard_label(labels, leading_axis=True)
+        self.params, self.opt_state, self.state, mets = jitted(
+            self.params, self.opt_state, self.state, tuple(inputs), labels, rng
+        )
+        return mets
+
     def eval_batch(self, inputs: Sequence[jax.Array], label: jax.Array, rng: Optional[jax.Array] = None) -> Dict[str, Any]:
         inputs = self._shard_inputs(inputs)
         if jax.process_count() > 1:
@@ -614,13 +657,17 @@ class CompiledExecutor:
             label = NamedSharding(self.mesh, PartitionSpec(pspec[0] if len(pspec) else None))
         return shardings, label
 
-    def _shard_inputs(self, inputs: Sequence[jax.Array]) -> List[jax.Array]:
+    def _shard_inputs(self, inputs: Sequence[jax.Array], leading_axis: bool = False) -> List[jax.Array]:
+        """``leading_axis``: inputs carry an extra unsharded [steps] axis
+        in front of the batch sharding (train_window's stacked batches)."""
         if self.mesh is None:
             return [jnp.asarray(x) for x in inputs]
         shardings, _ = self.input_shardings()
+        if leading_axis:
+            shardings = [_prepend_axis(s, self.mesh) for s in shardings]
         return [_put_global(jnp.asarray(x), s, full=False) for x, s in zip(inputs, shardings)]
 
-    def shard_label(self, label):
+    def shard_label(self, label, leading_axis: bool = False):
         """Place a label batch on the mesh (multi-host: ``label`` is this
         process's shard of the global batch)."""
         if self.mesh is None:
@@ -628,7 +675,16 @@ class CompiledExecutor:
         _, ls = self.input_shardings()
         if ls is None:
             return jnp.asarray(label)
+        if leading_axis:
+            ls = _prepend_axis(ls, self.mesh)
         return _put_global(jnp.asarray(label), ls, full=False)
+
+
+def _prepend_axis(sharding, mesh):
+    """The same batch sharding with an extra unsharded leading axis."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(None, *sharding.spec))
 
 
 def _put_global(x, sharding, full: bool):

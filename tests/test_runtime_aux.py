@@ -249,3 +249,56 @@ def test_elastic_trainer_exhausts_restarts(tmp_path):
     t = ElasticTrainer(m, str(tmp_path / "ck"), checkpoint_every=2, max_restarts=2)
     with pytest.raises(RuntimeError, match="exhausted"):
         t.run(always_poisoned, num_steps=4)
+
+
+# ---------------------------------------------------------------- tracing
+# Reference: Legion iteration tracing around the fit loop
+# (begin_trace/end_trace, flexflow_cffi.py:2079-2086). TPU-native analog:
+# a lax.scan window over the train step in one XLA program.
+
+
+def _fit_data(n=64, din=8, classes=4):
+    rs = np.random.RandomState(0)
+    X = rs.randn(n, din).astype(np.float32)
+    Y = rs.randint(0, classes, (n,)).astype(np.int32)
+    return X, Y
+
+
+def test_traced_fit_matches_eager_fit():
+    X, Y = _fit_data()
+    eager = build_mlp()
+    eager.fit([X], Y, epochs=2, verbose=False)
+    traced = build_mlp()
+    traced.fit([X], Y, epochs=2, verbose=False, trace_window=4)
+    # param keys embed per-process guids, so compare positionally in
+    # NUMERIC guid order (lexicographic order breaks at digit-width
+    # boundaries, e.g. 9998 vs 10001)
+    def by_guid(items):
+        return sorted(items, key=lambda kv: int(kv[0].rsplit("_", 1)[1]))
+
+    for (_, a), (_, b) in zip(
+        by_guid(eager.executor.params.items()), by_guid(traced.executor.params.items())
+    ):
+        for name in a:
+            np.testing.assert_allclose(
+                np.asarray(a[name]), np.asarray(b[name]), rtol=1e-5, atol=1e-6
+            )
+
+
+def test_traced_fit_partial_window():
+    X, Y = _fit_data(n=48)  # 3 steps of 16: window of 2 + remainder of 1
+    m = build_mlp()
+    perf = m.fit([X], Y, epochs=1, verbose=False, trace_window=2)
+    assert np.isfinite(perf.accuracy)
+
+
+def test_train_batch_repeated_reduces_loss():
+    import jax
+
+    X, Y = _fit_data()
+    m = build_mlp()
+    ex = m.executor
+    x, y = X[:16], Y[:16]
+    l0 = float(ex.train_batch([x], y, jax.random.key(0))["loss"])
+    mets = ex.train_batch_repeated([x], y, jax.random.key(1), num_steps=20)
+    assert float(mets["loss"]) < l0
