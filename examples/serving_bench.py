@@ -1,0 +1,86 @@
+"""Serving performance: dynamic batching vs per-request dispatch.
+
+The reference's serving story is the Triton prototype (triton/src/,
+per-request Legion launches in instance.cc, batching delegated to the
+Triton server above it) with no published numbers. This benchmark
+produces the numbers for OUR serving path: N concurrent clients fire
+single-sample requests at (a) the DynamicBatcher (requests coalesce
+into one padded jitted call) and (b) the unbatched per-request path,
+and report throughput plus p50/p99 latency for both.
+
+Run:  PYTHONPATH=. python examples/serving_bench.py
+(any backend; on TPU the batched/unbatched gap widens with dispatch
+cost — one large MXU batch vs many tiny ones)
+"""
+import json
+import threading
+import time
+
+import numpy as np
+
+from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.serving import DynamicBatcher, InferenceModel
+
+
+def build_model(bs=64, din=64, classes=16, hidden=256):
+    model = FFModel(FFConfig(batch_size=bs))
+    x = model.create_tensor((bs, din))
+    t = model.dense(x, hidden, ActiMode.RELU)
+    t = model.dense(t, hidden, ActiMode.RELU)
+    t = model.dense(t, classes)
+    model.softmax(t)
+    model.compile(optimizer=SGDOptimizer(lr=0.1), loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return model
+
+
+def drive(submit, n_clients=8, requests_per_client=50, din=64):
+    """Fire concurrent single-sample requests; return (reqs/s, p50, p99)."""
+    lat = []
+    lock = threading.Lock()
+
+    def client(seed):
+        rs = np.random.RandomState(seed)
+        mine = []
+        for _ in range(requests_per_client):
+            x = rs.randn(1, din).astype(np.float32)
+            t0 = time.perf_counter()
+            submit(x)
+            mine.append(time.perf_counter() - t0)
+        with lock:
+            lat.extend(mine)
+
+    threads = [threading.Thread(target=client, args=(s,)) for s in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lat.sort()
+    n = len(lat)
+    return n / wall, lat[n // 2] * 1e3, lat[int(n * 0.99)] * 1e3
+
+
+def main():
+    din = 64
+    served = InferenceModel(build_model(din=din), name="mlp", max_batch=64)
+    batcher = DynamicBatcher(served, max_delay_s=0.002)
+    batcher.start()
+    # warmup both paths (compile)
+    x0 = np.zeros((1, din), np.float32)
+    batcher.infer([x0])
+    served.infer([x0])
+    try:
+        b_thru, b_p50, b_p99 = drive(lambda x: batcher.infer([x]), din=din)
+    finally:
+        batcher.stop()
+    u_thru, u_p50, u_p99 = drive(lambda x: served.infer([x]), din=din)
+    print(json.dumps({
+        "batched": {"reqs_per_s": round(b_thru, 1), "p50_ms": round(b_p50, 2), "p99_ms": round(b_p99, 2)},
+        "unbatched": {"reqs_per_s": round(u_thru, 1), "p50_ms": round(u_p50, 2), "p99_ms": round(u_p99, 2)},
+        "batching_speedup": round(b_thru / u_thru, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

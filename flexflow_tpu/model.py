@@ -614,6 +614,10 @@ class FFModel:
         2079-2086): that many steps run as ONE XLA program (lax.scan
         over stacked batches, executor.train_window), paying host
         dispatch once per window. Defaults to FFConfig.trace_window.
+        Note: the windowed path derives per-step rng keys by splitting
+        one per-window key, so models with rng-dependent training ops
+        (dropout) follow a different — equally valid — randomness stream
+        than the eager loop; deterministic models train identically.
         """
         assert self.executor is not None, "call compile() first"
         xs = [x] if isinstance(x, (np.ndarray, jnp.ndarray)) else list(x)
@@ -639,9 +643,13 @@ class FFModel:
                 lo = step * bs
                 rng, sub = jax.random.split(rng)
                 if k > 1:
+                    # slice/reshape in the dataset's own array type: a
+                    # device-resident jnp dataset must not bounce through
+                    # the host here (the multi-process placement path
+                    # materializes numpy itself when it needs to)
                     hi = lo + k * bs
-                    wx = [np.asarray(xx[lo:hi]).reshape((k, bs) + xx.shape[1:]) for xx in xs]
-                    wy = np.asarray(y[lo:hi]).reshape((k, bs) + y.shape[1:])
+                    wx = [xx[lo:hi].reshape((k, bs) + xx.shape[1:]) for xx in xs]
+                    wy = y[lo:hi].reshape((k, bs) + y.shape[1:])
                     wmets = self.executor.train_window(wx, wy, sub)
                     host = {kk: np.asarray(v) for kk, v in wmets.items()}
                     for i in range(k):

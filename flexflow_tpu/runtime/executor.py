@@ -538,83 +538,75 @@ class CompiledExecutor:
         )
         return mets
 
+    def _scan_train_steps(self, w: int, per_step_xs: bool):
+        """Get-or-build the jitted program running ``w`` train steps as
+        one lax.scan (the Legion begin_trace/end_trace analog,
+        flexflow_cffi.py:2079-2086 — per-step host dispatch and runtime
+        analysis are paid once per window).
+
+        per_step_xs=True: inputs/labels carry a leading [w] axis, one
+        slice and one split rng key per step (train_window). False: the
+        same batch every step with a folded key (train_batch_repeated).
+        Returns stacked metrics (leaves [w]).
+        """
+        cache = self._window_cache if per_step_xs else self._multi_step_cache
+        jitted = cache.get(w)
+        if jitted is not None:
+            return jitted
+        step = self._train_step_fn
+
+        def program(params, opt_state, state, inputs, label, rng):
+            if per_step_xs:
+                xs = (tuple(inputs), label, jax.random.split(rng, w))
+
+                def body(carry, x):
+                    ins, lab, r = x
+                    p, o, s, mets = step(*carry, ins, lab, r)
+                    return (p, o, s), mets
+            else:
+                xs = jnp.arange(w)
+
+                def body(carry, i):
+                    p, o, s, mets = step(*carry, inputs, label, jax.random.fold_in(rng, i))
+                    return (p, o, s), mets
+
+            (params, opt_state, state), mets = jax.lax.scan(
+                body, (params, opt_state, state), xs
+            )
+            return params, opt_state, state, mets
+
+        jitted = jax.jit(program, donate_argnums=(0, 1, 2))
+        cache[w] = jitted
+        return jitted
+
     def train_batch_repeated(
         self, inputs: Sequence[jax.Array], label: jax.Array, rng: jax.Array, num_steps: int
     ) -> Dict[str, Any]:
-        """Run ``num_steps`` optimizer steps on one batch inside a single
-        XLA program (lax.scan over the train step).
-
-        This is the iteration-overhead amortization analog of the
-        reference's Legion tracing (begin_trace/end_trace around the fit
-        loop, python/flexflow/core/flexflow_cffi.py:2079-2086): the
-        runtime's per-iteration analysis/dispatch cost is paid once for
-        the whole traced window instead of per step. Here the window is
-        one compiled program, so per-step host dispatch (expensive over
-        tunneled/remote device transports) disappears entirely. Returns
-        the final step's metrics.
-        """
+        """Run ``num_steps`` optimizer steps on ONE batch inside a single
+        XLA program (steady-state step timing without per-step dispatch).
+        Returns the final step's metrics."""
         if self.optimizer is None:
             raise RuntimeError("train_batch_repeated requires a compiled optimizer")
-        jitted = self._multi_step_cache.get(num_steps)
-        if jitted is None:
-            step = self._train_step_fn
-
-            def multi(params, opt_state, state, inputs, label, rng):
-                def body(carry, i):
-                    p, o, s = carry
-                    p, o, s, mets = step(p, o, s, inputs, label, jax.random.fold_in(rng, i))
-                    return (p, o, s), mets
-
-                (params, opt_state, state), mets = jax.lax.scan(
-                    body, (params, opt_state, state), jnp.arange(num_steps)
-                )
-                return params, opt_state, state, jax.tree.map(lambda m: m[-1], mets)
-
-            jitted = jax.jit(multi, donate_argnums=(0, 1, 2))
-            self._multi_step_cache[num_steps] = jitted
+        jitted = self._scan_train_steps(num_steps, per_step_xs=False)
         inputs = self._shard_inputs(inputs)
         if jax.process_count() > 1:
             label = self.shard_label(label)
         self.params, self.opt_state, self.state, mets = jitted(
             self.params, self.opt_state, self.state, tuple(inputs), label, rng
         )
-        return mets
+        return jax.tree.map(lambda m: m[-1], mets)
 
     def train_window(
         self, inputs: Sequence[jax.Array], labels: jax.Array, rng: jax.Array
     ) -> Dict[str, Any]:
         """Run one optimizer step per stacked batch inside a single XLA
         program: ``inputs``/``labels`` carry a leading ``[steps, ...]``
-        axis and lax.scan consumes one slice per step.
-
-        This is the real-data form of the reference's Legion iteration
-        tracing (begin_trace/end_trace around fit,
-        flexflow_cffi.py:2079-2086): host dispatch and runtime analysis
-        are paid once per window instead of once per step. Returns the
-        metrics of every step in the window (leaves shaped [steps]).
-        """
+        axis and lax.scan consumes one slice (and one split rng key) per
+        step. Returns the metrics of every step (leaves shaped [steps])."""
         if self.optimizer is None:
             raise RuntimeError("train_window requires a compiled optimizer")
         w = int(inputs[0].shape[0])
-        jitted = self._window_cache.get(w)
-        if jitted is None:
-            step = self._train_step_fn
-
-            def window(params, opt_state, state, inputs, labels, rng):
-                def body(carry, xs):
-                    p, o, s = carry
-                    ins, lab, r = xs
-                    p, o, s, mets = step(p, o, s, ins, lab, r)
-                    return (p, o, s), mets
-
-                (params, opt_state, state), mets = jax.lax.scan(
-                    body, (params, opt_state, state),
-                    (tuple(inputs), labels, jax.random.split(rng, w)),
-                )
-                return params, opt_state, state, mets
-
-            jitted = jax.jit(window, donate_argnums=(0, 1, 2))
-            self._window_cache[w] = jitted
+        jitted = self._scan_train_steps(w, per_step_xs=True)
         inputs = self._shard_inputs(inputs, leading_axis=True)
         labels = self.shard_label(labels, leading_axis=True)
         self.params, self.opt_state, self.state, mets = jitted(
