@@ -168,3 +168,43 @@ def test_cpu_chip_spec_and_explicit_calibration_key():
     # an implicit measurement suite)
     auto = load_or_calibrate(allow_measure=False)
     assert auto.device_kind == "analytic"
+
+
+def test_committed_v5e_factory_table_loads_and_ranks():
+    """The committed factory table (captured on a real TPU v5 lite chip,
+    BENCH r3) must load, carry sane derates, and drive the strategy
+    predictor to a plausible BERT ranking on an 8-chip v5e machine."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.parallel.strategy import (
+        data_parallel_strategy,
+        megatron_strategy,
+    )
+    from flexflow_tpu.search.calibration import load_calibration
+    from flexflow_tpu.search.simulator import predict_strategy_time
+
+    cal = load_calibration("TPU v5 lite")
+    assert cal is not None, "factory table missing from calibration_data/"
+    assert cal.entries, "factory table has no measured entries"
+    # derates are measured/roofline multipliers: must be positive and not
+    # dispatch-overhead artifacts (the round-2 failure mode was ~100-300x)
+    for cls_name, d in cal.derates.items():
+        assert 0.2 < d < 50.0, (cls_name, d)
+
+    cfg = TransformerConfig(
+        num_layers=4, hidden_size=256, num_heads=4, ff_size=1024, seq_length=128
+    )
+    model = build_transformer(FFConfig(batch_size=64, workers_per_node=8), cfg)
+    g = model.graph
+    machine = MachineSpec(
+        num_nodes=1, devices_per_node=8, chip=chip_spec_for("TPU v5 lite")
+    )
+    t_dp = predict_strategy_time(g, data_parallel_strategy(g, 8), machine, calibration=cal)
+    t_tp = predict_strategy_time(g, megatron_strategy(g, dp=1, tp=4), machine, calibration=cal)
+    t_hy = predict_strategy_time(g, megatron_strategy(g, dp=2, tp=4), machine, calibration=cal)
+    for t in (t_dp, t_tp, t_hy):
+        assert 0 < t < 10.0, (t_dp, t_tp, t_hy)  # sane absolute range (s)
+    # at batch 64 with cheap ICI allreduce, pure dp must beat pure tp=4
+    # for this small model (tp pays 4 activation allreduces per block)
+    assert t_dp < t_tp, (t_dp, t_tp)
