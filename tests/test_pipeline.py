@@ -366,3 +366,20 @@ def test_pipelined_moe_aux_loss_collected():
         losses.append(float(m_bal.executor.train_batch([x], y, rng)["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_traced_window_over_pipelined_step():
+    """trace_window composes with the pipelined executor: the scan-of-
+    steps wraps the scan-of-ticks (GPipe) + shard_map without retracing
+    per step, and losses keep decreasing."""
+    m, _ = _small_transformer(pipeline_stages=2)
+    rs = np.random.RandomState(2)
+    w, b = 3, 16  # window of 3 steps
+    x = jnp.asarray(rs.randn(w, b, 8, 32), jnp.float32)
+    y = 0.5 * x
+    l0 = float(m.executor.train_batch([x[0]], y[0], jax.random.key(0))["loss"])
+    mets = m.executor.train_window([x], y, jax.random.key(1))
+    losses = np.asarray(mets["loss"])
+    assert losses.shape == (w,)
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < l0, (l0, losses)
