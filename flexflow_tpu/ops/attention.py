@@ -102,6 +102,12 @@ class MultiHeadAttentionOp(OpDef):
         else:
             ctx_out = attention_core(qh, kh, vh, causal=params.causal, backend=ctx.backend)
         out = jnp.einsum("bshd,hde->bse", ctx_out, weights["wo"])
+        # manual tensor parallelism (inside shard_map — GPipe stages):
+        # head-sharded wq/wk/wv make ctx_out carry H/tp local heads and
+        # wo sharded on H is row-parallel — reduce the partial output
+        # projections over the tp axis before the (replicated) bias
+        if ctx.weight_sharded_dim("wo") == 0:
+            out = jax.lax.psum(out, ctx.tp_axis)
         if params.use_bias:
             out = out + weights["bo"]
         if params.dropout > 0.0 and ctx.training:

@@ -66,11 +66,31 @@ class LowerCtx:
     # auxiliary losses appended by ops (e.g. MoE load-balancing, aggregate.cc
     # lambda_bal); summed into the total loss by the executor
     aux_losses: List = dataclasses.field(default_factory=list)
+    # manual tensor parallelism (inside shard_map, where GSPMD can't see):
+    # the mesh axis the current node's weights are sharded on, plus the
+    # per-weight SpecTuples from the strategy. Megatron-style ops consult
+    # weight_sharded_dim() to decide whether their local matmul contracts
+    # a sharded dim (row parallel -> psum over tp_axis).
+    tp_axis: Optional[str] = None
+    weight_specs: Optional[Dict] = None
 
     def node_rng(self) -> jax.Array:
         if self.rng is None:
             raise ValueError("op requires an RNG but none was provided")
         return jax.random.fold_in(self.rng, self.node_guid)
+
+    def weight_sharded_dim(self, wname: str) -> Optional[int]:
+        """Index of the dim of weight ``wname`` sharded on tp_axis, or
+        None (replicated / no manual tp active)."""
+        if self.tp_axis is None or not self.weight_specs:
+            return None
+        spec = self.weight_specs.get(wname)
+        if not spec:
+            return None
+        for i, axes in enumerate(spec):
+            if axes and self.tp_axis in axes:
+                return i
+        return None
 
 
 class OpDef:

@@ -54,6 +54,12 @@ class LinearOp(OpDef):
         (x,) = inputs
         y = jnp.dot(x, weights["kernel"], preferred_element_type=jnp.float32)
         y = y.astype(params.dtype.jnp)
+        # manual tensor parallelism (inside shard_map — GPipe stages):
+        # a kernel sharded on its INPUT dim is Megatron row-parallel;
+        # the local matmul contracted a sharded dim, so the partial
+        # outputs reduce over the tp axis before the (replicated) bias
+        if ctx.weight_sharded_dim("kernel") == 0:
+            y = jax.lax.psum(y, ctx.tp_axis)
         if params.use_bias:
             y = y + weights["bias"]
         return [apply_activation(params.activation, y)]

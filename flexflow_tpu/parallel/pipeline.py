@@ -21,6 +21,11 @@ with its own weights) — the transformer-block case; heterogeneous
 prologue/epilogue (embeddings, heads) run outside the pipelined region
 under the usual dp/tp shardings.
 
+Tensor parallelism composes INSIDE stages (dp x pp x tp, 3-D
+parallelism): pipeline_strategy(tp=...) shards stage weights on "model"
+per the Megatron layout and ops psum row-parallel partials themselves
+(LowerCtx.weight_sharded_dim) — GSPMD cannot see through shard_map.
+
 Scope (v1, deliberate): the rotating boundary is exactly ONE activation
 tensor and blocks must be stateless (batchnorm state stays outside the
 stack; MoE aux losses ARE supported via with_aux). This covers the
@@ -62,6 +67,7 @@ def gpipe(
     mesh: Mesh,
     axis: str = PIPE_AXIS,
     with_aux: bool = False,
+    param_specs: Any = None,
 ) -> Callable[[Any, jax.Array], jax.Array]:
     """Build a pipelined apply: (stacked_params, x) -> y.
 
@@ -162,7 +168,13 @@ def gpipe(
                 aux = jax.lax.pmean(aux, _DA)
             return y_out, aux
 
-        specs_params = jax.tree.map(lambda _: PartitionSpec(axis), stacked_params)
+        # param_specs carries tp-sharded stacked specs (dp x pp x tp);
+        # default: stage axis only
+        specs_params = (
+            param_specs
+            if param_specs is not None
+            else jax.tree.map(lambda _: PartitionSpec(axis), stacked_params)
+        )
         # combine with data parallelism when the mesh has a "data" axis:
         # the microbatch dim rides it (dp x pp, reference-style hybrid)
         from .mesh import DATA_AXIS

@@ -329,14 +329,22 @@ def pipeline_strategy(
     graph: PCGraph,
     pp: int,
     dp: int = 1,
+    tp: int = 1,
     n_microbatches: int = 0,
     batch_dim: int = 0,
 ) -> ParallelStrategy:
-    """dp x pp hybrid: the graph's repeated block stack is split into
-    ``pp`` GPipe stages (stage costs balanced via balanced_stages over
-    the analytic cost model — the search half the reference's graph
+    """dp x pp (x tp) hybrid: the graph's repeated block stack is split
+    into ``pp`` GPipe stages (stage costs balanced via balanced_stages
+    over the analytic cost model — the search half the reference's graph
     splits performed, graph.cc:206-231), activations ride the "data"
     axis, stage params ride "pipe".
+
+    tp > 1 composes Megatron tensor parallelism INSIDE each stage (3-D
+    parallelism, a capability the reference never had): block weights
+    additionally shard on "model" per megatron_strategy's layout, and
+    the stage program reduces row-parallel partials with an explicit
+    psum over "model" (ops consult LowerCtx.weight_sharded_dim — GSPMD
+    cannot see inside the schedule's shard_map).
 
     Requires the number of repeated blocks to be divisible by pp (stages
     must be isomorphic so the executor can stack their params [S, r, ...]
@@ -372,8 +380,13 @@ def pipeline_strategy(
     else:
         pipeline = None
 
-    st = data_parallel_strategy(graph, dp, batch_dim=batch_dim)
+    if tp > 1:
+        st = megatron_strategy(graph, dp, tp, sp=False, batch_dim=batch_dim)
+    else:
+        st = data_parallel_strategy(graph, dp, batch_dim=batch_dim)
     st.axis_sizes = {DATA_AXIS: dp, PIPE_AXIS: pp}
+    if tp > 1:
+        st.axis_sizes[MODEL_AXIS] = tp
     st.pipeline = pipeline
     if dp <= 1:
         # build_mesh drops size-1 axes: no "data" axis exists, so no

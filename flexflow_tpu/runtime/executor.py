@@ -174,11 +174,13 @@ class CompiledExecutor:
 
     def _stack_pipeline_params(self, params, state):
         """Restructure repeat-node params into stacked leaves [S, r, ...]
-        with the stage axis sharded over "pipe" (the executor-side half of
-        parallel/pipeline.py shard_stage_params)."""
+        with the stage axis sharded over "pipe" (+ any tp axes from the
+        strategy); records the specs in self._pipe_param_specs so the
+        gpipe in_specs use the very same layout."""
         import numpy as np
 
         plan = self._pipeline_plan
+        self._pipe_param_specs: Dict[str, Dict[str, Any]] = {}
         for rep in plan.repeats:
             for node in rep:
                 if _node_key(node) in state and state[_node_key(node)]:
@@ -194,30 +196,39 @@ class CompiledExecutor:
             if not names:
                 continue
             stacked[tkey] = {}
+            self._pipe_param_specs[tkey] = {}
             for wname in names:
                 rows = [
                     np.asarray(params[_node_key(rep[t])][wname])
                     for rep in plan.repeats
                 ]
                 arr = jnp.asarray(np.stack(rows).reshape((S, r) + rows[0].shape))
+                spec = self._stacked_weight_spec(tnode.guid, wname, arr.ndim)
+                self._pipe_param_specs[tkey][wname] = spec
                 if self.mesh is not None:
-                    from jax.sharding import NamedSharding, PartitionSpec
+                    from jax.sharding import NamedSharding
 
-                    from ..parallel.mesh import PIPE_AXIS
-
-                    arr = jax.device_put(
-                        arr,
-                        NamedSharding(
-                            self.mesh,
-                            PartitionSpec(PIPE_AXIS, *([None] * (arr.ndim - 1))),
-                        ),
-                    )
+                    arr = jax.device_put(arr, NamedSharding(self.mesh, spec))
                 stacked[tkey][wname] = arr
         for rep in plan.repeats:
             for node in rep:
                 params.pop(_node_key(node), None)
         params[_PIPE_KEY] = stacked
         return params
+
+    def _stacked_weight_spec(self, guid: int, wname: str, ndim: int):
+        """PartitionSpec for a stacked pipeline weight [S, r, *w.shape]:
+        stage axis on "pipe", plus whatever tp axes the strategy assigned
+        to the underlying weight dims (dp x pp x tp composition)."""
+        from jax.sharding import PartitionSpec
+
+        from ..parallel.mesh import PIPE_AXIS
+        from ..parallel.strategy import to_partition_spec
+
+        wspec = self.strategy.weight_spec(guid, wname) if self.strategy else None
+        tail = list(to_partition_spec(wspec)) if wspec else []
+        tail += [None] * (ndim - 2 - len(tail))
+        return PartitionSpec(PIPE_AXIS, None, *tail)
 
     def _place_weight(self, guid: int, name: str, arr: jax.Array) -> jax.Array:
         if self.mesh is None:
@@ -330,6 +341,29 @@ class CompiledExecutor:
             for node in template
         )
 
+        # manual tensor parallelism inside the stage program (dp x pp x tp):
+        # GSPMD cannot see through shard_map, so ops get the strategy's
+        # weight SpecTuples and psum row-parallel partials themselves
+        from ..parallel.mesh import MODEL_AXIS
+
+        tp_axis = (
+            MODEL_AXIS
+            if (
+                self.strategy is not None
+                and self.strategy.axis_sizes.get(MODEL_AXIS, 1) > 1
+                and MODEL_AXIS in self.mesh.axis_names
+            )
+            else None
+        )
+        tpl_wspecs = {
+            node.guid: (
+                self.strategy.node_shardings[node.guid].weights
+                if self.strategy and node.guid in self.strategy.node_shardings
+                else None
+            )
+            for node in template
+        }
+
         def stage_fn(stage_params, act):
             # stage_params leaves [r, ...]: scan the stage's blocks.
             # RNG folds the GLOBAL block index (stage*r + ridx): folding
@@ -349,11 +383,13 @@ class CompiledExecutor:
                     backend=self.backend,
                     mesh=None,  # inside shard_map: manual, no GSPMD constraints
                     seq_length=self.seq_length,
+                    tp_axis=tp_axis,
                 )
                 for node in template:
                     op_def = get_op_def(node.op_type)
                     ins = [local[(e.src, e.src_idx)] for e in self.graph.in_edges(node)]
                     ctx.node_guid = node.guid
+                    ctx.weight_specs = tpl_wspecs[node.guid]
                     outs = op_def.lower(node.params, ins, rep_params.get(_node_key(node), {}), ctx)
                     for i, o in enumerate(outs):
                         local[(node.guid, i)] = o
@@ -380,11 +416,15 @@ class CompiledExecutor:
                 return act, aux_sum
             return act
 
+        # specs recorded at stacking time — the device_put sharding and
+        # the shard_map in_specs are structurally the same objects
+        param_specs = self._pipe_param_specs
         pipelined = gpipe(
             stage_fn,
             n_microbatches=plan.n_microbatches,
             mesh=self.mesh,
             with_aux=with_aux,
+            param_specs=param_specs,
         )
         if with_aux:
             y, pipe_aux = pipelined(params[_PIPE_KEY], x)

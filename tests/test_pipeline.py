@@ -383,3 +383,47 @@ def test_traced_window_over_pipelined_step():
     assert losses.shape == (w,)
     assert np.all(np.isfinite(losses))
     assert losses[-1] < l0, (l0, losses)
+
+
+def test_3d_parallelism_dp_pp_tp_matches_single_device():
+    """dp2 x pp2 x tp2 on the 8-device mesh (NEW capability; neither the
+    reference nor round-2 had tp inside pipeline stages): block weights
+    shard on "model" per Megatron layout, the stage program psums
+    row-parallel partials (LowerCtx.weight_sharded_dim), and numerics
+    match single-device execution."""
+    from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+    from flexflow_tpu.parallel.strategy import pipeline_strategy
+    from flexflow_tpu.runtime.executor import _PIPE_KEY
+
+    cfg = TransformerConfig(num_layers=2, hidden_size=32, num_heads=4, ff_size=64, seq_length=8)
+
+    def build(n_dev, strategy_fn=None):
+        m = build_transformer(FFConfig(batch_size=16, workers_per_node=n_dev), cfg)
+        st = strategy_fn(m.graph) if strategy_fn else None
+        m.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=LossType.MEAN_SQUARED_ERROR, strategy=st)
+        return m
+
+    m3d = build(8, lambda g: pipeline_strategy(g, pp=2, dp=2, tp=2))
+    assert dict(zip(m3d.mesh.axis_names, m3d.mesh.devices.shape)) == {
+        "data": 2, "pipe": 2, "model": 2,
+    }
+    # tp sharding engaged: some stacked leaf carries the "model" axis
+    specs = [
+        str(leaf.sharding.spec)
+        for wd in m3d.executor.params[_PIPE_KEY].values()
+        for leaf in wd.values()
+    ]
+    assert any("model" in s for s in specs), specs
+    m1 = build(1)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(16, 8, 32), jnp.float32)
+    y = 0.5 * x
+    l3 = float(m3d.executor.eval_batch([x], y)["loss"])
+    l1 = float(m1.executor.eval_batch([x], y)["loss"])
+    np.testing.assert_allclose(l3, l1, rtol=1e-4)
+    losses = [
+        float(m3d.executor.train_batch([x], y, jax.random.key(i))["loss"])
+        for i in range(4)
+    ]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
