@@ -52,6 +52,93 @@ def to_partition_spec(spec: Optional[SpecTuple]):
     return PartitionSpec(*args)
 
 
+def megatron_weight_dims(node) -> Dict[str, int]:
+    """The Megatron tp layout for one node: weight name -> sharded dim.
+    Name heuristics follow models/transformer.py naming; unmatched nodes
+    return {} (replicated). Single source of truth for megatron_strategy,
+    pipeline_strategy's in-stage tp, and the search's (pp, tp) proposer."""
+    name = node.name or ""
+    if node.op_type == OpType.LINEAR:
+        if "ff1" in name or "lm_head" in name or name.endswith("_gate"):
+            return {"kernel": 1, "bias": 0}  # column parallel
+        if "ff2" in name or "out_proj" in name:
+            return {"kernel": 0}  # row parallel
+        return {}
+    if node.op_type == OpType.MULTIHEAD_ATTENTION:
+        return {"wq": 1, "wk": 1, "wv": 1, "bq": 0, "bk": 0, "bv": 0, "wo": 0}
+    if node.op_type == OpType.EMBEDDING:
+        return {"embedding": 0}
+    return {}
+
+
+# ops through which a tp-sharded activation may safely flow inside a
+# manual (shard_map) stage program: purely elementwise — anything that
+# normalizes/reduces over the sharded feature dim would silently compute
+# per-shard results
+_TP_TRANSPARENT_OPS = frozenset(
+    {
+        OpType.RELU, OpType.SIGMOID, OpType.TANH, OpType.ELU, OpType.GELU,
+        OpType.IDENTITY, OpType.EXP, OpType.SIN, OpType.COS, OpType.RSQRT,
+        OpType.POW, OpType.SCALAR_ADD, OpType.SCALAR_SUB, OpType.SCALAR_MUL,
+        OpType.SCALAR_TRUE_DIV, OpType.DROPOUT,
+    }
+)
+
+
+def tp_shardable_nodes(graph: PCGraph, block_nodes) -> set:
+    """Guids of block nodes whose weights may carry Megatron tp sharding
+    under a MANUAL (shard_map) stage program, where GSPMD is not there
+    to reshard mid-stage.
+
+    MHA is always self-consistent (head-sharded internally, psum after
+    wo). Linears shard only as complete column->row pairs whose sharded
+    intermediate flows exclusively through elementwise ops and drains
+    into a row-parallel linear within the block — a column output that
+    escapes the block or hits a normalizing op would silently compute
+    per-shard results. Embeddings never shard in-stage (their row layout
+    needs a psum the manual lowering doesn't do)."""
+    guids = {n.guid for n in block_nodes}
+    by_guid = {n.guid: n for n in block_nodes}
+    ok = {n.guid for n in block_nodes if n.op_type == OpType.MULTIHEAD_ATTENTION}
+    cols = [
+        n for n in block_nodes
+        if n.op_type == OpType.LINEAR and megatron_weight_dims(n).get("kernel") == 1
+    ]
+    rows = {
+        n.guid for n in block_nodes
+        if n.op_type == OpType.LINEAR and megatron_weight_dims(n).get("kernel") == 0
+    }
+    if not cols or not rows:
+        return ok  # half a pattern cannot re-materialize activations
+    reached_rows = set()
+    for col in cols:
+        frontier = [col.guid]
+        seen = set()
+        consistent = True
+        while frontier and consistent:
+            g = frontier.pop()
+            for e in graph.out_edges(by_guid[g]):
+                if e.dst in seen:
+                    continue
+                seen.add(e.dst)
+                if e.dst not in guids:
+                    consistent = False  # sharded value escapes the block
+                    break
+                dst = by_guid[e.dst]
+                if dst.guid in rows:
+                    reached_rows.add(dst.guid)
+                    continue
+                if dst.op_type in _TP_TRANSPARENT_OPS:
+                    frontier.append(dst.guid)
+                else:
+                    consistent = False
+                    break
+        if consistent:
+            ok.add(col.guid)
+            ok |= reached_rows
+    return ok
+
+
 def shard_weight_entry(weights, by_name, wname: str, dim: int, axis_name: str, axis_size: int):
     """Shard weight ``wname``'s dim ``dim`` on ``axis_name`` if it exists
     and divides evenly; otherwise leave it replicated (graceful degradation
@@ -187,23 +274,8 @@ def megatron_strategy(
         by_name = {w.name: w for w in wspecs}
         weights: Dict[str, Optional[SpecTuple]] = {w.name: None for w in wspecs}
 
-        def shard_weight(wname: str, dim: int):
+        for wname, dim in megatron_weight_dims(node).items():
             shard_weight_entry(weights, by_name, wname, dim, MODEL_AXIS, tp)
-
-        name = node.name or ""
-        if node.op_type == OpType.LINEAR and wspecs:
-            if "ff1" in name or "lm_head" in name or name.endswith("_gate"):
-                shard_weight("kernel", 1)  # column parallel
-                shard_weight("bias", 0)
-            elif "ff2" in name or "out_proj" in name:
-                shard_weight("kernel", 0)  # row parallel
-        elif node.op_type == OpType.MULTIHEAD_ATTENTION:
-            # shard heads: wq/wk/wv [E,H,D] on H; wo [H,D,E] on H
-            for wn in ("wq", "wk", "wv", "bq", "bk", "bv"):
-                shard_weight(wn, 1 if wn[0] == "w" else 0)
-            shard_weight("wo", 0)
-        elif node.op_type == OpType.EMBEDDING:
-            shard_weight("embedding", 0)
         shardings: List[Optional[SpecTuple]] = []
         for i, os in enumerate(out_specs):
             spec = None
@@ -398,11 +470,21 @@ def pipeline_strategy(
     if pipeline is not None:
         # activations inside the pipelined region live under shard_map;
         # sharding constraints there are the schedule's business, not GSPMD's
+        if tp > 1:
+            # in-stage tp is MANUAL: GSPMD cannot reshard mid-stage, so
+            # only provably-consistent nodes keep their Megatron sharding
+            # (complete column->row pairs, self-consistent MHA)
+            shardable = set()
+            for rep in repeats:
+                shardable |= tp_shardable_nodes(graph, rep)
         for guid in pipeline.stage_of:
             if guid in st.node_shardings:
+                weights = st.node_shardings[guid].weights
+                if tp > 1 and guid not in shardable:
+                    weights = {w: None for w in weights}
                 st.node_shardings[guid] = OpSharding(
                     outputs=[None] * len(st.node_shardings[guid].outputs),
-                    weights=st.node_shardings[guid].weights,
+                    weights=weights,
                 )
     return st
 

@@ -51,6 +51,9 @@ class SearchResult:
     allreduce_saved: float = 0.0
     # (pp, n_microbatches) when the search chose pipeline parallelism
     pipeline: Optional[Tuple[int, int]] = None
+    # in-stage tensor parallelism of that pipeline (dp x pp x tp); the
+    # effective dp is num_devices // (pp * pipeline_tp)
+    pipeline_tp: int = 1
     # (dp, cp) when the search chose sequence/context parallelism
     context_parallel: Optional[Tuple[int, int]] = None
 
@@ -374,6 +377,7 @@ class _PipelineCandidate:
     pp: int
     n_microbatches: int
     memory_per_device: float = 0.0
+    tp: int = 1  # tensor parallelism inside each stage (3-D dp x pp x tp)
 
 
 def _propose_pipeline(
@@ -381,6 +385,7 @@ def _propose_pipeline(
     num_devices: int,
     cost_model: CostModel,
     batch: int,
+    capacity: Optional[float] = None,
 ) -> Optional[_PipelineCandidate]:
     """Cost the (pp, microbatch) candidates the GPipe executor can run
     (VERDICT r2 missing #3: the search must propose pipeline parallelism,
@@ -427,35 +432,99 @@ def _propose_pipeline(
     )
     outer_wbytes = _weight_bytes(specs_map, graph, outer_nodes)
 
+    # exactly which block weights CAN shard tp-ways, per the same rules
+    # pipeline_strategy enforces (complete column->row pairs +
+    # self-consistent MHA, tp_shardable_nodes) — anything else stays
+    # replicated and must be costed/membered at full size
+    from ..parallel.strategy import megatron_weight_dims, tp_shardable_nodes
+
+    shardable = tp_shardable_nodes(graph, repeats[0])
+    shard_w = []  # (node, [(dim_size, bytes)]) for shardable weights
+    block_sharded_bytes = 0.0
+    for n in repeats[0]:
+        if n.guid not in shardable:
+            continue
+        wdims = megatron_weight_dims(n)
+        if not wdims:
+            continue
+        in_specs = [specs_map[e.src][e.src_idx] for e in graph.in_edges(n)]
+        try:
+            wspecs = {w.name: w.spec for w in get_op_def(n.op_type).weight_specs(n.params, in_specs)}
+        except Exception:
+            continue
+        sizes = [
+            (wspecs[wn].shape[dim], wspecs[wn].size_bytes)
+            for wn, dim in wdims.items()
+            if wn in wspecs
+        ]
+        shard_w.append((n, sizes))
+        block_sharded_bytes += sum(b for _, b in sizes)
+    sharded_total = block_sharded_bytes * R
+    repl_total = max(0.0, repeat_wbytes - sharded_total)
+    tp_nodes = {n.guid for n, _ in shard_w}
+
+    def tp_divides(t: int) -> bool:
+        return bool(shard_w) and all(
+            sz % t == 0 for _, sizes in shard_w for sz, _ in sizes
+        )
+
     best: Optional[_PipelineCandidate] = None
+    best_fit: Optional[_PipelineCandidate] = None
     pp = 2
     while pp <= min(R, num_devices):
         if num_devices % pp != 0 or R % pp != 0:
             pp *= 2
             continue
-        dp_pp = num_devices // pp
-        if batch % max(1, dp_pp) != 0:
-            pp *= 2
-            continue
-        M = default_microbatches(batch, pp, dp_pp)
-        mb_parts = dp_pp * M  # microbatch shard = batch / (M * dp)
-        block_t = sum(op_time(n, mb_parts) for n in block_nodes)
-        stage_t = block_t * (R // pp)
-        ticks = M + pp - 1
-        p2p = cost_model.p2p_time(boundary_bytes / max(1, mb_parts))
-        outer_t = sum(op_time(n, max(1, dp_pp)) for n in outer_nodes)
-        sync_t = cost_model.allreduce_time(repeat_wbytes / pp, dp_pp)
-        sync_t += cost_model.allreduce_time(outer_wbytes, num_devices)
-        total = ticks * (stage_t + p2p) + outer_t + sync_t
-        # per-device memory: stage weights (4x for param+grad+2 moments)
-        # plus live GPipe activations (every in-flight microbatch keeps
-        # its boundary activation per block of the stage)
-        mem = 4.0 * (repeat_wbytes / pp + outer_wbytes)
-        mem += boundary_bytes * (R // pp) / max(1, dp_pp)
-        if best is None or total < best.cost:
-            best = _PipelineCandidate(total, pp, M, mem)
+        tp = 1
+        while pp * tp <= num_devices:
+            if num_devices % (pp * tp) != 0 or (tp > 1 and not tp_divides(tp)):
+                tp *= 2
+                continue
+            dp_eff = num_devices // (pp * tp)
+            if batch % max(1, dp_eff) != 0:
+                tp *= 2
+                continue
+            M = default_microbatches(batch, pp, dp_eff)
+            mb_parts = dp_eff * M  # microbatch shard = batch / (M * dp)
+            block_t = sum(
+                op_time(n, mb_parts * (tp if n.guid in tp_nodes else 1))
+                for n in block_nodes
+            )
+            stage_t = block_t * (R // pp)
+            ticks = M + pp - 1
+            p2p = cost_model.p2p_time(boundary_bytes / max(1, mb_parts))
+            tp_coll = 0.0
+            if tp > 1:
+                # Megatron: 2 activation allreduces per block per
+                # direction (after wo and ff2, and their transposes)
+                tp_coll = 4.0 * (R // pp) * cost_model.allreduce_time(
+                    boundary_bytes / max(1, mb_parts), tp
+                )
+            outer_t = sum(op_time(n, max(1, dp_eff)) for n in outer_nodes)
+            # only the provably-shardable weights divide by tp; the rest
+            # replicate across the model axis at full size
+            per_dev_w = sharded_total / (pp * tp) + repl_total / pp
+            sync_t = cost_model.allreduce_time(per_dev_w, dp_eff)
+            sync_t += cost_model.allreduce_time(outer_wbytes, num_devices)
+            total = ticks * (stage_t + tp_coll + p2p) + outer_t + sync_t
+            # per-device memory: stage weights (4x for param+grad+2
+            # moments) plus live GPipe activations (every in-flight
+            # microbatch keeps its boundary activation per block)
+            mem = 4.0 * (per_dev_w + outer_wbytes)
+            mem += boundary_bytes * (R // pp) / max(1, dp_eff)
+            cand = _PipelineCandidate(total, pp, M, mem, tp)
+            if best is None or total < best.cost:
+                best = cand
+            if capacity is not None and mem <= capacity and (
+                best_fit is None or total < best_fit.cost
+            ):
+                best_fit = cand
+            tp *= 2
         pp *= 2
-    return best
+    # under a known HBM capacity prefer the cheapest candidate that FITS
+    # (deeper pp or pp x tp shards weights further; the fastest candidate
+    # may not fit in the memory-pressure regime pipeline exists for)
+    return best_fit if capacity is not None and best_fit is not None else best
 
 
 # ---------------------------------------------------------------------------
@@ -727,7 +796,10 @@ def unity_optimize(
     # GPipe stage stacking needs the unmodified isomorphic block structure
     if num_devices > 1 and not config.only_data_parallel:
         batch = config.batch_size
-        pipe = _propose_pipeline(graph, num_devices, cost_model, batch)
+        pipe = _propose_pipeline(
+            graph, num_devices, cost_model, batch,
+            capacity=machine.chip.hbm_capacity,
+        )
         # sequence/context parallelism: wins when the batch can't fill
         # the machine (long-context regime) — cheaper by simulated cost
         # than both the DP winner and any pipeline candidate
@@ -774,7 +846,8 @@ def unity_optimize(
                 strategy = pipeline_strategy(
                     graph,
                     pp=pipe.pp,
-                    dp=num_devices // pipe.pp,
+                    dp=num_devices // (pipe.pp * pipe.tp),
+                    tp=pipe.tp,
                     n_microbatches=pipe.n_microbatches,
                 )
             except ValueError:
@@ -788,6 +861,7 @@ def unity_optimize(
                     memory_per_device=pipe.memory_per_device,
                     lambda_used=lam,
                     pipeline=(pipe.pp, pipe.n_microbatches),
+                    pipeline_tp=pipe.tp,
                 )
 
     views = result_dp.views

@@ -427,3 +427,61 @@ def test_3d_parallelism_dp_pp_tp_matches_single_device():
         for i in range(4)
     ]
     assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+
+def test_search_pipeline_proposes_tp_under_extreme_memory_pressure():
+    """With only 2 repeated blocks (pp capped at 2), shrinking capacity
+    must push the proposer into pp x tp (3-D) candidates: stage weights
+    shard a further tp ways."""
+    from flexflow_tpu import FFConfig
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.search.calibration import chip_spec_for
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.unity import _propose_pipeline
+
+    cfg = TransformerConfig(num_layers=2, hidden_size=256, num_heads=4, ff_size=1024, seq_length=32)
+    m = build_transformer(FFConfig(batch_size=64, workers_per_node=8), cfg)
+    machine = MachineSpec(num_nodes=1, devices_per_node=8, chip=chip_spec_for("TPU v5 lite"))
+    cm = CostModel(machine)
+    c0 = _propose_pipeline(m.graph, 8, cm, 64)
+    assert c0 is not None and c0.pp == 2
+    found = None
+    for frac in (0.9, 0.8, 0.7, 0.6, 0.5, 0.4, 0.3):
+        cap = c0.memory_per_device * frac
+        c = _propose_pipeline(m.graph, 8, cm, 64, capacity=cap)
+        if c is not None and c.memory_per_device <= cap and c.tp > 1:
+            found = c
+            break
+    assert found is not None, "no pp x tp candidate adopted under shrinking capacity"
+    assert found.pp * found.tp <= 8 and found.tp in (2, 4)
+
+
+def test_pipeline_tp_degrades_for_inconsistent_blocks():
+    """A block whose only Megatron-named linear is row-parallel ('ff2'
+    with no 'ff1' producer) cannot shard under manual tp — the strategy
+    must strip in-stage sharding (not crash with a local shape mismatch)
+    and still train correctly."""
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.parallel.strategy import pipeline_strategy
+    from flexflow_tpu.runtime.executor import _PIPE_KEY
+
+    m = FFModel(FFConfig(batch_size=16, workers_per_node=8))
+    x = m.create_tensor((16, 8, 32), name="x")
+    t = x
+    for i in range(2):
+        h = m.layer_norm(t, name=f"l{i}_ln")
+        h = m.dense(h, 32, ActiMode.RELU, name=f"l{i}_ff2")  # row name, no column pair
+        t = m.add(t, h, name=f"l{i}_res")
+    st = pipeline_strategy(m.graph, pp=2, dp=2, tp=2)
+    m.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=LossType.MEAN_SQUARED_ERROR, strategy=st)
+    specs = [
+        str(leaf.sharding.spec)
+        for wd in m.executor.params[_PIPE_KEY].values()
+        for leaf in wd.values()
+    ]
+    assert not any("model" in s for s in specs), specs  # stripped, not crashed
+    rs = np.random.RandomState(3)
+    xb = jnp.asarray(rs.randn(16, 8, 32), jnp.float32)
+    loss = float(m.executor.train_batch([xb], 0.5 * xb, jax.random.key(0))["loss"])
+    assert np.isfinite(loss)
