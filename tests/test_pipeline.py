@@ -485,3 +485,43 @@ def test_pipeline_tp_degrades_for_inconsistent_blocks():
     xb = jnp.asarray(rs.randn(16, 8, 32), jnp.float32)
     loss = float(m.executor.train_batch([xb], 0.5 * xb, jax.random.key(0))["loss"])
     assert np.isfinite(loss)
+
+
+def test_search_adopts_3d_pipeline_and_trains():
+    """End-to-end: under HBM so tight that even per-stage replicated
+    weights overflow, unity_optimize adopts a pp x tp candidate and the
+    compiled 3-D model trains on the 8-device mesh."""
+    import dataclasses
+
+    from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+    from flexflow_tpu.parallel.machine import MachineSpec, TPUChipSpec
+    from flexflow_tpu.parallel.mesh import MODEL_AXIS
+    from flexflow_tpu.search.unity import unity_optimize
+
+    cfg = TransformerConfig(
+        num_layers=4, hidden_size=512, num_heads=2, ff_size=2048, seq_length=8
+    )
+    config = FFConfig(batch_size=8, workers_per_node=8, search_budget=3)
+    model = build_transformer(config, cfg)
+    # ~50MB weights: pp=4 alone leaves ~50MB/stage*4 (param+grad+moments)
+    # per device; 40MB HBM forces the extra tp split
+    chip = dataclasses.replace(TPUChipSpec(), hbm_capacity=40e6)
+    machine = MachineSpec(num_nodes=1, devices_per_node=8, chip=chip)
+    strategy, sr = unity_optimize(model.graph, config, machine=machine)
+    assert sr.pipeline is not None, "expected a pipeline adoption"
+    assert sr.pipeline_tp > 1, f"expected in-stage tp, got {sr}"
+    assert strategy.axis_sizes.get(MODEL_AXIS, 1) == sr.pipeline_tp
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR,
+        strategy=strategy,
+    )
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(8, 8, 512), jnp.float32)
+    y = jnp.asarray(rs.randn(8, 8, 512), jnp.float32)
+    losses = [
+        float(model.executor.train_batch([x], y, jax.random.key(i))["loss"])
+        for i in range(3)
+    ]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
