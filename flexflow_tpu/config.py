@@ -64,6 +64,10 @@ class FFConfig:
     # stacked batches, which also removes per-step host dispatch —
     # dominant over tunneled/remote device transports). 1 = eager.
     trace_window: int = 1
+    # ZeRO-1 optimizer-state sharding over the data axis (beyond-parity:
+    # the reference replicates optimizer state everywhere; PS/NCCL only
+    # choose the gradient-sync transport, optimizer.cc:200,261)
+    zero_optimizer: bool = False
     # execution flags
     perform_fusion: bool = False  # XLA fuses regardless; kept for CLI parity
     profiling: bool = False
@@ -123,6 +127,7 @@ class FFConfig:
         p.add_argument("--pipeline-stages", type=int, default=1)
         p.add_argument("--remat-blocks", action="store_true")
         p.add_argument("--trace-window", type=int, default=1)
+        p.add_argument("--zero-optimizer", action="store_true")
         p.add_argument("--pipeline-microbatches", type=int, default=0)
         p.add_argument("--topo-file", type=str, default="")
         p.add_argument("--iteration", type=int, default=1)
@@ -164,6 +169,7 @@ class FFConfig:
             pipeline_stages=ns.pipeline_stages,
             remat_blocks=ns.remat_blocks,
             trace_window=ns.trace_window,
+            zero_optimizer=ns.zero_optimizer,
             pipeline_microbatches=ns.pipeline_microbatches,
             topo_file=ns.topo_file,
             iteration=ns.iteration,

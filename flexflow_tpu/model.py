@@ -585,6 +585,7 @@ class FFModel:
             backend=jax.default_backend(),
             comp_mode=comp_mode,
             remat_blocks=self.config.remat_blocks,
+            zero_optimizer=self.config.zero_optimizer,
         )
         self.executor.initialize(jax.random.key(self._seed))
         return self

@@ -117,6 +117,13 @@ class CompiledExecutor:
     # backward pass (jax.checkpoint per block) instead of storing its
     # activations — HBM/FLOPs trade (FFConfig.remat_blocks)
     remat_blocks: bool = False
+    # ZeRO-1: shard optimizer moments over the data axis (beyond-parity;
+    # the reference replicates optimizer state on every device —
+    # ParameterSyncType only picks HOW gradients sync, optimizer.cc:261).
+    # GSPMD keeps the moments distributed between steps and gathers only
+    # inside the update, cutting per-device optimizer memory ~1/dp.
+    zero_optimizer: bool = False
+    _zero_specs: Any = None
 
     params: Any = None
     opt_state: Any = None
@@ -168,9 +175,67 @@ class CompiledExecutor:
         self.params = params
         self.state = state
         if self.optimizer is not None:
-            self.opt_state = self.optimizer.init_state(params)
+            self._zero_specs = self._zero1_spec_tree()
+            if self._zero_specs is None:
+                self.opt_state = self.optimizer.init_state(params)
+            else:
+                # allocate the moments DIRECTLY into their data-axis
+                # shards (jit + out_shardings): replicate-then-reshard
+                # would spike init-time HBM by the full moment size —
+                # the very memory ZeRO exists to save
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                proto = jax.eval_shape(self.optimizer.init_state, params)
+                repl = NamedSharding(self.mesh, PartitionSpec())
+                shardings = {
+                    k: (
+                        jax.tree.map(lambda s: NamedSharding(self.mesh, s), self._zero_specs)
+                        if k in ("m", "v") and sub is not None
+                        else jax.tree.map(lambda _: repl, sub)
+                    )
+                    for k, sub in proto.items()
+                }
+                self.opt_state = jax.jit(
+                    self.optimizer.init_state, out_shardings=shardings
+                )(params)
         self._build_steps()
         return self
+
+    def _map_moments(self, opt_state, fn):
+        """Apply ``fn(leaf, zero_spec)`` over the optimizer moment trees
+        ("m"/"v"), leaving scalars and absent moments untouched."""
+        for k in ("m", "v"):
+            if opt_state.get(k) is not None:
+                opt_state[k] = jax.tree.map(fn, opt_state[k], self._zero_specs)
+        return opt_state
+
+    def _zero1_spec_tree(self):
+        """Per-param-leaf PartitionSpec for ZeRO-1 moment sharding: the
+        param's own sharding plus the first unsharded, evenly-divisible
+        dim moved onto "data". None when ZeRO is off or there is no
+        data-parallel axis to shard over."""
+        from ..parallel.mesh import DATA_AXIS
+
+        if (
+            not self.zero_optimizer
+            or self.mesh is None
+            or DATA_AXIS not in self.mesh.axis_names
+            or self.mesh.shape[DATA_AXIS] < 2
+        ):
+            return None
+        from jax.sharding import PartitionSpec
+
+        dp = self.mesh.shape[DATA_AXIS]
+
+        def leaf_spec(p):
+            base = list(p.sharding.spec) + [None] * (p.ndim - len(p.sharding.spec))
+            for i in range(p.ndim):
+                if base[i] is None and p.shape[i] % dp == 0:
+                    base[i] = DATA_AXIS
+                    break
+            return PartitionSpec(*base)
+
+        return jax.tree.map(leaf_spec, self.params)
 
     def _stack_pipeline_params(self, params, state):
         """Restructure repeat-node params into stacked leaves [S, r, ...]
@@ -543,6 +608,18 @@ class CompiledExecutor:
 
             grads, (mets, new_state) = jax.grad(objective, has_aux=True)(params)
             new_params, new_opt_state = self.optimizer.apply(params, grads, opt_state)
+            if self._zero_specs is not None:
+                # ZeRO-1: pin the updated moments back onto their
+                # data-axis shards so GSPMD keeps them distributed
+                # between steps (donated buffers preserve the layout)
+                from jax.sharding import NamedSharding
+
+                new_opt_state = self._map_moments(
+                    new_opt_state,
+                    lambda x, s: jax.lax.with_sharding_constraint(
+                        x, NamedSharding(self.mesh, s)
+                    ),
+                )
             return new_params, new_opt_state, new_state, mets
 
         def eval_step(params, state, inputs, label, rng):

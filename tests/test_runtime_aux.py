@@ -302,3 +302,51 @@ def test_train_batch_repeated_reduces_loss():
     l0 = float(ex.train_batch([x], y, jax.random.key(0))["loss"])
     mets = ex.train_batch_repeated([x], y, jax.random.key(1), num_steps=20)
     assert float(mets["loss"]) < l0
+
+
+# ---------------------------------------------------------------- ZeRO-1
+# Beyond-parity: the reference replicates optimizer state on every
+# device (PS/NCCL only choose the gradient-sync transport,
+# optimizer.cc:200,261); FFConfig(zero_optimizer=True) shards Adam/SGD
+# moments over the data axis.
+
+
+def test_zero1_shards_moments_and_matches_numerics():
+    import jax
+
+    from flexflow_tpu import ActiMode, AdamOptimizer, FFConfig, FFModel, LossType
+
+    def build(zero):
+        m = FFModel(FFConfig(batch_size=32, workers_per_node=8, zero_optimizer=zero))
+        x = m.create_tensor((32, 16))
+        t = m.dense(x, 64, ActiMode.RELU, name="fc1")
+        t = m.dense(t, 4, name="fc2")
+        m.softmax(t)
+        m.compile(optimizer=AdamOptimizer(alpha=0.01), loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+        return m
+
+    mz = build(True)
+    dp = mz.mesh.shape["data"]
+    assert dp == 8
+    # every divisible moment leaf is stored at 1/dp per device
+    sharded = 0
+    for tree in (mz.executor.opt_state["m"], mz.executor.opt_state["v"]):
+        for leaf in jax.tree.leaves(tree):
+            if any(d % dp == 0 for d in leaf.shape):
+                assert "data" in str(leaf.sharding.spec), leaf.sharding
+                shard_shape = leaf.addressable_shards[0].data.shape
+                assert int(np.prod(shard_shape)) == leaf.size // dp
+                sharded += 1
+    assert sharded >= 2
+    # ZeRO is a layout choice, not a math change: losses match exactly
+    mr = build(False)
+    rs = np.random.RandomState(0)
+    X = rs.randn(32, 16).astype(np.float32)
+    Y = rs.randint(0, 4, (32,)).astype(np.int32)
+    for i in range(3):
+        lz = float(mz.executor.train_batch([X], Y, jax.random.key(i))["loss"])
+        lr_ = float(mr.executor.train_batch([X], Y, jax.random.key(i))["loss"])
+        np.testing.assert_allclose(lz, lr_, rtol=1e-5)
+    # moments stay sharded after steps (donation + in-step constraint)
+    leaf = jax.tree.leaves(mz.executor.opt_state["m"])[0]
+    assert "data" in str(leaf.sharding.spec)
