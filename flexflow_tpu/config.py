@@ -68,6 +68,9 @@ class FFConfig:
     # the reference replicates optimizer state everywhere; PS/NCCL only
     # choose the gradient-sync transport, optimizer.cc:200,261)
     zero_optimizer: bool = False
+    # gradient accumulation: microbatches per optimizer update (scan of
+    # grads; one microbatch's activations live at a time). 1 = off.
+    grad_accum_steps: int = 1
     # execution flags
     perform_fusion: bool = False  # XLA fuses regardless; kept for CLI parity
     profiling: bool = False
@@ -128,6 +131,7 @@ class FFConfig:
         p.add_argument("--remat-blocks", action="store_true")
         p.add_argument("--trace-window", type=int, default=1)
         p.add_argument("--zero-optimizer", action="store_true")
+        p.add_argument("--grad-accum-steps", type=int, default=1)
         p.add_argument("--pipeline-microbatches", type=int, default=0)
         p.add_argument("--topo-file", type=str, default="")
         p.add_argument("--iteration", type=int, default=1)
@@ -170,6 +174,7 @@ class FFConfig:
             remat_blocks=ns.remat_blocks,
             trace_window=ns.trace_window,
             zero_optimizer=ns.zero_optimizer,
+            grad_accum_steps=ns.grad_accum_steps,
             pipeline_microbatches=ns.pipeline_microbatches,
             topo_file=ns.topo_file,
             iteration=ns.iteration,

@@ -586,6 +586,7 @@ class FFModel:
             comp_mode=comp_mode,
             remat_blocks=self.config.remat_blocks,
             zero_optimizer=self.config.zero_optimizer,
+            grad_accum_steps=self.config.grad_accum_steps,
         )
         self.executor.initialize(jax.random.key(self._seed))
         return self

@@ -124,6 +124,12 @@ class CompiledExecutor:
     # inside the update, cutting per-device optimizer memory ~1/dp.
     zero_optimizer: bool = False
     _zero_specs: Any = None
+    # gradient accumulation: each train step splits the batch into this
+    # many grad microbatches, averages their gradients via a lax.scan
+    # (one microbatch's activations live at a time) and applies ONE
+    # optimizer update — large effective batches without the activation
+    # memory (beyond-parity; no reference analog)
+    grad_accum_steps: int = 1
 
     params: Any = None
     opt_state: Any = None
@@ -595,18 +601,65 @@ class CompiledExecutor:
             outs, _, _ = self._forward_impl(params, state, inputs, rng, training=False)
             return outs
 
+        accum = int(self.grad_accum_steps)
+        if accum < 1:
+            raise ValueError(f"grad_accum_steps must be >= 1, got {accum}")
+
         def train_step(params, opt_state, state, inputs, label, rng):
-            def objective(p):
-                outs, new_state, aux = self._forward_impl(p, state, inputs, rng, training=True)
+            def objective(p, st, ins, lab, r):
+                outs, new_state, aux = self._forward_impl(p, st, ins, r, training=True)
                 final = outs[-1]
-                loss = loss_fn(final, label)
+                loss = loss_fn(final, lab)
                 for a in aux:
                     loss = loss + a
-                mets = metrics_mod.compute_metrics(metric_types, final, label)
+                mets = metrics_mod.compute_metrics(metric_types, final, lab)
                 mets["loss"] = loss
                 return loss, (mets, new_state)
 
-            grads, (mets, new_state) = jax.grad(objective, has_aux=True)(params)
+            if accum == 1:
+                grads, (mets, new_state) = jax.grad(objective, has_aux=True)(
+                    params, state, inputs, label, rng
+                )
+            else:
+                # gradient accumulation: scan grad microbatches so only
+                # one microbatch's activations are live; mean-of-means
+                # equals the full-batch gradient for mean losses
+                b = inputs[0].shape[0]
+                if b % accum:
+                    raise ValueError(
+                        f"batch {b} not divisible by grad_accum_steps={accum}"
+                    )
+                mb = b // accum
+
+                def strided(x):
+                    # microbatch i = rows {i, i+accum, ...}: a contiguous
+                    # split would concentrate each microbatch on a subset
+                    # of the dp devices and force per-step resharding
+                    return x.reshape((mb, accum) + x.shape[1:]).swapaxes(0, 1)
+
+                mb_inputs = tuple(strided(x) for x in inputs)
+                mb_label = strided(label)
+
+                def body(carry, xs):
+                    gsum, st = carry
+                    ins, lab, r = xs
+                    g, (mets, st2) = jax.grad(objective, has_aux=True)(
+                        params, st, ins, lab, r
+                    )
+                    return (jax.tree.map(jnp.add, gsum, g), st2), mets
+
+                (gsum, new_state), mets_all = jax.lax.scan(
+                    body,
+                    (jax.tree.map(jnp.zeros_like, params), state),
+                    (mb_inputs, mb_label, jax.random.split(rng, accum)),
+                )
+                grads = jax.tree.map(lambda g: g / accum, gsum)
+                # "loss" is a per-batch mean; every other metric key is a
+                # per-batch SUM (count/correct/*_loss, metrics.py:48-69)
+                mets = {
+                    k: (jnp.mean(v) if k == "loss" else jnp.sum(v))
+                    for k, v in mets_all.items()
+                }
             new_params, new_opt_state = self.optimizer.apply(params, grads, opt_state)
             if self._zero_specs is not None:
                 # ZeRO-1: pin the updated moments back onto their

@@ -350,3 +350,72 @@ def test_zero1_shards_moments_and_matches_numerics():
     # moments stay sharded after steps (donation + in-step constraint)
     leaf = jax.tree.leaves(mz.executor.opt_state["m"])[0]
     assert "data" in str(leaf.sharding.spec)
+
+
+def test_grad_accumulation_matches_full_batch():
+    """FFConfig(grad_accum_steps=k): k grad microbatches per update,
+    averaged — identical training to the full-batch step for mean losses
+    (beyond-parity; no reference analog)."""
+    import jax
+
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, SGDOptimizer
+
+    def build(accum):
+        m = FFModel(FFConfig(batch_size=32, grad_accum_steps=accum))
+        x = m.create_tensor((32, 16))
+        t = m.dense(x, 32, ActiMode.RELU, name="fc1")
+        t = m.dense(t, 4, name="fc2")
+        m.softmax(t)
+        m.compile(optimizer=SGDOptimizer(lr=0.1), loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+        return m
+
+    ma, mf = build(4), build(1)
+    rs = np.random.RandomState(0)
+    X = rs.randn(32, 16).astype(np.float32)
+    Y = rs.randint(0, 4, (32,)).astype(np.int32)
+    for i in range(3):
+        la = float(ma.executor.train_batch([X], Y, jax.random.key(i))["loss"])
+        lf = float(mf.executor.train_batch([X], Y, jax.random.key(i))["loss"])
+        np.testing.assert_allclose(la, lf, rtol=1e-5)
+
+    def by_guid(items):
+        return sorted(items, key=lambda kv: int(kv[0].rsplit("_", 1)[1]))
+
+    for (_, a), (_, b) in zip(by_guid(ma.executor.params.items()), by_guid(mf.executor.params.items())):
+        for name in a:
+            np.testing.assert_allclose(np.asarray(a[name]), np.asarray(b[name]), rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accumulation_metric_sums_and_batchnorm_state():
+    """Sum-semantics metrics (count/correct) must SUM over microbatches,
+    and batchnorm state must thread through the accumulation scan (k
+    sequential EMA updates, not just the last microbatch's)."""
+    import jax
+
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+
+    def build(accum):
+        m = FFModel(FFConfig(batch_size=32, grad_accum_steps=accum))
+        x = m.create_tensor((32, 16))
+        t = m.dense(x, 32, ActiMode.RELU, name="fc1")
+        t = m.batch_norm(t, name="bn")
+        t = m.dense(t, 4, name="fc2")
+        m.softmax(t)
+        m.compile(
+            optimizer=SGDOptimizer(lr=0.1),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[MetricsType.ACCURACY],
+        )
+        return m
+
+    m4 = build(4)
+    rs = np.random.RandomState(1)
+    X = rs.randn(32, 16).astype(np.float32)
+    Y = rs.randint(0, 4, (32,)).astype(np.int32)
+    bn_key = next(k for k in m4.executor.state if k.startswith("batch_norm"))
+    mean0 = np.asarray(m4.executor.state[bn_key]["running_mean"]).copy()
+    mets = m4.executor.train_batch([X], Y, jax.random.key(0))
+    assert int(mets["count"]) == 32  # summed, not averaged to 8
+    assert 0 <= int(mets["correct"]) <= 32
+    mean1 = np.asarray(m4.executor.state[bn_key]["running_mean"])
+    assert not np.allclose(mean0, mean1), "bn state did not update through the scan"
