@@ -66,7 +66,18 @@ def main():
         losses.append(float(mets["loss"]))
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
-    print(f"MULTIHOST_OK pid={pid} losses={losses}", flush=True)
+
+    # traced window across hosts: stacked [steps, local_batch, ...] data
+    # flows through the leading_axis multi-host placement
+    # (make_array_from_process_local_data with the window sharding)
+    w = 3
+    wx = np.stack([xl] * w)
+    wy = np.stack([yl] * w)
+    wmets = m.executor.train_window([wx], wy, jax.random.key(1))
+    wlosses = np.asarray(wmets["loss"])
+    assert wlosses.shape == (w,), wlosses.shape
+    assert np.all(np.isfinite(wlosses)) and wlosses[-1] < wlosses[0], wlosses
+    print(f"MULTIHOST_OK pid={pid} losses={losses} window={wlosses.tolist()}", flush=True)
 
 
 if __name__ == "__main__":
