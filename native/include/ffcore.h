@@ -157,6 +157,11 @@ typedef struct ffc_model ffc_model_t;
 
 ffc_model_t *ffc_model_create(int32_t batch_size, int32_t workers_per_node,
                               int32_t num_nodes, int32_t search_budget);
+/* Full-config variant: config_json holds any FFConfig field by name
+ * (e.g. {"batch_size":64,"pipeline_stages":2,"zero_optimizer":true,
+ * "grad_accum_steps":4,"trace_window":8}) — every present and future
+ * flag is reachable from C without new entry points. */
+ffc_model_t *ffc_model_create_json(const char *config_json);
 void ffc_model_destroy(ffc_model_t *model);
 
 /* Tensor handles are dense int64 ids (-1 on error). */

@@ -164,6 +164,50 @@ ffc_model_t *ffc_model_create(int32_t batch_size, int32_t workers_per_node,
   return reinterpret_cast<ffc_model_t *>(m);
 }
 
+ffc_model_t *ffc_model_create_json(const char *config_json) {
+  // Full-config create: any FFConfig field by name. The dataclass is the
+  // single schema; new flags (zero_optimizer, grad_accum_steps,
+  // trace_window, pipeline_stages, ...) need no new C glue.
+  if (!ensure_python()) return nullptr;
+  Gil gil;
+  PyObject *cfg_cls = import_attr("flexflow_tpu.config", "FFConfig");
+  PyObject *model_cls = import_attr("flexflow_tpu.model", "FFModel");
+  PyObject *jsonmod = PyImport_ImportModule("json");
+  if (!cfg_cls || !model_cls || !jsonmod) {
+    report_and_clear();
+    Py_XDECREF(cfg_cls);
+    Py_XDECREF(model_cls);
+    Py_XDECREF(jsonmod);
+    return nullptr;
+  }
+  PyObject *kwargs = PyObject_CallMethod(jsonmod, "loads", "s",
+                                         config_json ? config_json : "{}");
+  Py_DECREF(jsonmod);
+  PyObject *model = nullptr;
+  if (kwargs && PyDict_Check(kwargs)) {
+    PyObject *empty = PyTuple_New(0);
+    PyObject *cfg = PyObject_Call(cfg_cls, empty, kwargs);
+    if (cfg) {
+      model = PyObject_CallFunctionObjArgs(model_cls, cfg, nullptr);
+    }
+    Py_XDECREF(cfg);
+    Py_DECREF(empty);
+  } else if (kwargs) {
+    PyErr_SetString(PyExc_TypeError, "config_json must be a JSON object");
+  }
+  Py_XDECREF(kwargs);
+  Py_DECREF(cfg_cls);
+  Py_DECREF(model_cls);
+  if (!model) {
+    report_and_clear();
+    return nullptr;
+  }
+  Model *m = new Model();
+  m->model = model;
+  m->tensors = PyList_New(0);
+  return reinterpret_cast<ffc_model_t *>(m);
+}
+
 void ffc_model_destroy(ffc_model_t *handle) {
   if (!handle) return;
   Model *m = reinterpret_cast<Model *>(handle);

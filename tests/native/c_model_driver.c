@@ -17,9 +17,13 @@
 #define CLASSES 8
 
 int main(void) {
-  ffc_model_t *m = ffc_model_create(BATCH, 1, 1, 0);
+  /* JSON create: any FFConfig field by name (grad_accum_steps proves a
+   * flag with no dedicated C glue flows through) */
+  ffc_model_t *m = ffc_model_create_json(
+      "{\"batch_size\": 16, \"workers_per_node\": 1, \"num_nodes\": 1,"
+      " \"search_budget\": 0, \"grad_accum_steps\": 2}");
   if (!m) {
-    fprintf(stderr, "ffc_model_create failed\n");
+    fprintf(stderr, "ffc_model_create_json failed\n");
     return 1;
   }
   int64_t dims[2] = {BATCH, IN_DIM};
