@@ -678,16 +678,35 @@ class FFModel:
         self.last_throughput = thru
         return perf
 
-    def evaluate(self, x, y, batch_size: Optional[int] = None) -> PerfMetrics:
+    def evaluate(
+        self, x, y, batch_size: Optional[int] = None, trace_window: Optional[int] = None
+    ) -> PerfMetrics:
         assert self.executor is not None
         xs = [x] if isinstance(x, (np.ndarray, jnp.ndarray)) else list(x)
         bs = batch_size or self.config.batch_size
+        tw = max(1, trace_window or self.config.trace_window)
         n = xs[0].shape[0]
+        steps = n // bs
         perf = PerfMetrics()
-        for step in range(n // bs):
-            lo, hi = step * bs, (step + 1) * bs
-            mets = self.executor.eval_batch([jnp.asarray(xx[lo:hi]) for xx in xs], jnp.asarray(y[lo:hi]))
-            perf.update({k: float(v) for k, v in mets.items() if k != "loss"})
+        step = 0
+        while step < steps:
+            k = tw if steps - step >= tw else 1
+            lo = step * bs
+            if k > 1:
+                hi = lo + k * bs
+                wmets = self.executor.eval_window(
+                    [xx[lo:hi].reshape((k, bs) + xx.shape[1:]) for xx in xs],
+                    y[lo:hi].reshape((k, bs) + y.shape[1:]),
+                )
+                host = {kk: np.asarray(v) for kk, v in wmets.items()}
+                for i in range(k):
+                    perf.update({kk: float(v[i]) for kk, v in host.items() if kk != "loss"})
+            else:
+                mets = self.executor.eval_batch(
+                    [jnp.asarray(xx[lo:lo + bs]) for xx in xs], jnp.asarray(y[lo:lo + bs])
+                )
+                perf.update({kk: float(v) for kk, v in mets.items() if kk != "loss"})
+            step += k
         return perf
 
     def predict(self, x) -> jax.Array:

@@ -685,6 +685,8 @@ class CompiledExecutor:
 
         self._forward = jax.jit(forward)
         self._eval_step = jax.jit(eval_step)
+        self._eval_step_fn = eval_step
+        self._eval_window_cache = {}
         if self.optimizer is not None:
             self._train_step_fn = train_step
             self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
@@ -783,6 +785,35 @@ class CompiledExecutor:
             self.params, self.opt_state, self.state, tuple(inputs), labels, rng
         )
         return mets
+
+    def eval_window(
+        self, inputs: Sequence[jax.Array], labels: jax.Array, rng: Optional[jax.Array] = None
+    ) -> Dict[str, Any]:
+        """Evaluate one batch per leading-axis slice inside a single XLA
+        program (the eval half of the iteration-tracing story). Returns
+        per-step metrics (leaves shaped [steps])."""
+        w = int(inputs[0].shape[0])
+        jitted = self._eval_window_cache.get(w)
+        if jitted is None:
+            step = self._eval_step_fn
+
+            def window(params, state, inputs, labels, rng):
+                def body(carry, xs):
+                    ins, lab, r = xs
+                    return carry, step(params, state, ins, lab, r)
+
+                _, mets = jax.lax.scan(
+                    body, 0, (tuple(inputs), labels, jax.random.split(rng, w))
+                )
+                return mets
+
+            jitted = jax.jit(window)
+            self._eval_window_cache[w] = jitted
+        if rng is None:
+            rng = jax.random.key(0)
+        inputs = self._shard_inputs(inputs, leading_axis=True)
+        labels = self.shard_label(labels, leading_axis=True)
+        return jitted(self.params, self.state, tuple(inputs), labels, rng)
 
     def eval_batch(self, inputs: Sequence[jax.Array], label: jax.Array, rng: Optional[jax.Array] = None) -> Dict[str, Any]:
         inputs = self._shard_inputs(inputs)

@@ -419,3 +419,12 @@ def test_grad_accumulation_metric_sums_and_batchnorm_state():
     assert 0 <= int(mets["correct"]) <= 32
     mean1 = np.asarray(m4.executor.state[bn_key]["running_mean"])
     assert not np.allclose(mean0, mean1), "bn state did not update through the scan"
+
+
+def test_traced_evaluate_matches_eager_evaluate():
+    X, Y = _fit_data(n=96)
+    m = build_mlp()
+    m.fit([X], Y, epochs=1, verbose=False)
+    eager = m.evaluate([X], Y)
+    traced = m.evaluate([X], Y, trace_window=4)
+    assert abs(eager.accuracy - traced.accuracy) < 1e-9
