@@ -600,6 +600,37 @@ class FFModel:
         return Tensor(self, n, 0, specs[n.guid][0])
 
     # ----------------------------------------------------------------- fit
+    @staticmethod
+    def _as_batches(x, y):
+        """Normalize dataset inputs: keep real (np/jnp) arrays as-is —
+        device-resident data must not bounce through the host — and
+        materialize anything else (lists, tuples) as numpy so the
+        windowed slicing/reshape paths work on every accepted input."""
+
+        def arr(a):
+            return a if isinstance(a, (np.ndarray, jnp.ndarray)) else np.asarray(a)
+
+        xs = [x] if isinstance(x, (np.ndarray, jnp.ndarray)) else list(x)
+        return [arr(xx) for xx in xs], arr(y)
+
+    @staticmethod
+    def _iter_windows(xs, y, bs: int, steps: int, tw: int):
+        """Yield (step, k, window_xs, window_y): full tw-step windows as
+        stacked [k, bs, ...] arrays, tail steps (k == 1) as plain
+        batches for the already-compiled eager program."""
+        step = 0
+        while step < steps:
+            k = tw if steps - step >= tw else 1
+            lo = step * bs
+            if k > 1:
+                hi = lo + k * bs
+                yield step, k, [
+                    xx[lo:hi].reshape((k, bs) + xx.shape[1:]) for xx in xs
+                ], y[lo:hi].reshape((k, bs) + y.shape[1:])
+            else:
+                yield step, 1, [jnp.asarray(xx[lo:lo + bs]) for xx in xs], jnp.asarray(y[lo:lo + bs])
+            step += k
+
     def fit(
         self,
         x: Union[np.ndarray, Sequence[np.ndarray]],
@@ -622,7 +653,7 @@ class FFModel:
         than the eager loop; deterministic models train identically.
         """
         assert self.executor is not None, "call compile() first"
-        xs = [x] if isinstance(x, (np.ndarray, jnp.ndarray)) else list(x)
+        xs, y = self._as_batches(x, y)
         epochs = epochs or self.config.epochs
         bs = batch_size or self.config.batch_size
         tw = max(1, trace_window or self.config.trace_window)
@@ -635,24 +666,13 @@ class FFModel:
         interval = max(1, self.config.printing_interval)
         t0 = time.time()
         for epoch in range(epochs):
-            step = 0
-            while step < steps:
-                # full windows run traced; the tail (k < tw) runs eagerly
-                # on the already-compiled single-step program rather than
-                # paying a whole extra XLA compile for a once-per-epoch
-                # window size
-                k = tw if steps - step >= tw else 1
-                lo = step * bs
+            # full windows run traced; tail steps (k == 1) run eagerly on
+            # the already-compiled single-step program rather than paying
+            # a whole extra XLA compile for a once-per-epoch window size
+            for step, k, batch_x, batch_y in self._iter_windows(xs, y, bs, steps, tw):
                 rng, sub = jax.random.split(rng)
                 if k > 1:
-                    # slice/reshape in the dataset's own array type: a
-                    # device-resident jnp dataset must not bounce through
-                    # the host here (the multi-process placement path
-                    # materializes numpy itself when it needs to)
-                    hi = lo + k * bs
-                    wx = [xx[lo:hi].reshape((k, bs) + xx.shape[1:]) for xx in xs]
-                    wy = y[lo:hi].reshape((k, bs) + y.shape[1:])
-                    wmets = self.executor.train_window(wx, wy, sub)
+                    wmets = self.executor.train_window(batch_x, batch_y, sub)
                     host = {kk: np.asarray(v) for kk, v in wmets.items()}
                     for i in range(k):
                         perf.update({kk: float(v[i]) for kk, v in host.items() if kk != "loss"})
@@ -662,14 +682,11 @@ class FFModel:
                                 f"loss {float(host.get('loss', np.zeros(k))[i]):.4f} acc {perf.accuracy:.4f}"
                             )
                 else:
-                    batch_x = [jnp.asarray(xx[lo:lo + bs]) for xx in xs]
-                    batch_y = jnp.asarray(y[lo:lo + bs])
                     mets = self.executor.train_batch(batch_x, batch_y, sub)
                     perf.update({kk: float(v) for kk, v in mets.items() if kk != "loss"})
                     if verbose and step % interval == 0:
                         loss = float(mets.get("loss", 0.0))
                         print(f"epoch {epoch} step {step}/{steps} loss {loss:.4f} acc {perf.accuracy:.4f}")
-                step += k
         elapsed = time.time() - t0
         thru = epochs * steps * bs / max(1e-9, elapsed)
         if verbose:
@@ -682,31 +699,20 @@ class FFModel:
         self, x, y, batch_size: Optional[int] = None, trace_window: Optional[int] = None
     ) -> PerfMetrics:
         assert self.executor is not None
-        xs = [x] if isinstance(x, (np.ndarray, jnp.ndarray)) else list(x)
+        xs, y = self._as_batches(x, y)
         bs = batch_size or self.config.batch_size
         tw = max(1, trace_window or self.config.trace_window)
-        n = xs[0].shape[0]
-        steps = n // bs
+        steps = xs[0].shape[0] // bs
         perf = PerfMetrics()
-        step = 0
-        while step < steps:
-            k = tw if steps - step >= tw else 1
-            lo = step * bs
+        for _, k, batch_x, batch_y in self._iter_windows(xs, y, bs, steps, tw):
             if k > 1:
-                hi = lo + k * bs
-                wmets = self.executor.eval_window(
-                    [xx[lo:hi].reshape((k, bs) + xx.shape[1:]) for xx in xs],
-                    y[lo:hi].reshape((k, bs) + y.shape[1:]),
-                )
+                wmets = self.executor.eval_window(batch_x, batch_y)
                 host = {kk: np.asarray(v) for kk, v in wmets.items()}
                 for i in range(k):
                     perf.update({kk: float(v[i]) for kk, v in host.items() if kk != "loss"})
             else:
-                mets = self.executor.eval_batch(
-                    [jnp.asarray(xx[lo:lo + bs]) for xx in xs], jnp.asarray(y[lo:lo + bs])
-                )
+                mets = self.executor.eval_batch(batch_x, batch_y)
                 perf.update({kk: float(v) for kk, v in mets.items() if kk != "loss"})
-            step += k
         return perf
 
     def predict(self, x) -> jax.Array:
