@@ -151,3 +151,21 @@ def test_parallel_tensor_view_dp_tp():
     key = f"{h.node.op_type.value}_{h.node.guid}"
     spec = m.executor.params[key]["kernel"].sharding.spec
     assert "model" in tuple(spec)
+
+
+def test_from_args_round3_flags():
+    """CLI parity for the round-3 execution flags."""
+    from flexflow_tpu.config import FFConfig
+
+    cfg = FFConfig.from_args([
+        "-b", "64", "--trace-window", "8", "--zero-optimizer",
+        "--grad-accum-steps", "4", "--pipeline-stages", "2",
+    ])
+    assert cfg.batch_size == 64
+    assert cfg.trace_window == 8
+    assert cfg.zero_optimizer is True
+    assert cfg.grad_accum_steps == 4
+    assert cfg.pipeline_stages == 2
+    base = FFConfig.from_args([])
+    assert base.trace_window == 1 and base.grad_accum_steps == 1
+    assert base.zero_optimizer is False
