@@ -110,8 +110,10 @@ def tp_shardable_nodes(graph: PCGraph, block_nodes) -> set:
     }
     if not cols or not rows:
         return ok  # half a pattern cannot re-materialize activations
-    reached_rows = set()
     for col in cols:
+        # per-column: rows reached by an inconsistent column must not be
+        # sharded on the strength of a *different* consistent column
+        reached_rows = set()
         frontier = [col.guid]
         seen = set()
         consistent = True

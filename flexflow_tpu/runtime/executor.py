@@ -654,12 +654,21 @@ class CompiledExecutor:
                     (mb_inputs, mb_label, jax.random.split(rng, accum)),
                 )
                 grads = jax.tree.map(lambda g: g / accum, gsum)
-                # "loss" is a per-batch mean; every other metric key is a
-                # per-batch SUM (count/correct/*_loss, metrics.py:48-69)
-                mets = {
-                    k: (jnp.mean(v) if k == "loss" else jnp.sum(v))
-                    for k, v in mets_all.items()
-                }
+
+                # "loss" is a per-batch mean; rmse is nonlinear (sqrt of a
+                # mean, metrics.py:69) so summing per-microbatch values
+                # would change its semantics — invert to per-microbatch
+                # MSE, average (microbatches are equal-sized), re-apply;
+                # every other metric key is a per-batch SUM
+                # (count/correct/*_loss, metrics.py:48-69)
+                def merge(k, v):
+                    if k == "loss":
+                        return jnp.mean(v)
+                    if k == "rmse_loss":
+                        return jnp.sqrt(jnp.mean(jnp.square(v / mb))) * b
+                    return jnp.sum(v)
+
+                mets = {k: merge(k, v) for k, v in mets_all.items()}
             new_params, new_opt_state = self.optimizer.apply(params, grads, opt_state)
             if self._zero_specs is not None:
                 # ZeRO-1: pin the updated moments back onto their

@@ -171,45 +171,51 @@ def measure_lowered_op(
         if not jnp.issubdtype(args[0].dtype, jnp.floating):
             inner = 0  # can't thread the carry through integer inputs
 
-        def run_op(inputs):
+        # inputs AND weights are runtime jit arguments — closing over
+        # them would bake them into the XLA program as literals, letting
+        # the compiler constant-fold/pre-transform weights and bias the
+        # measured cost vs real execution where weights are buffers
+        def run_op(inputs, wts):
             ctx = LowerCtx(training=False, rng=jax.random.key(0), backend=backend)
-            outs = op_def.lower(params, inputs, weights, ctx)
+            outs = op_def.lower(params, inputs, wts, ctx)
             return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
 
         if inner == 0:  # single-shot fallback (dispatch overhead included)
             jitted = jax.jit(run_op)
-            float(jitted(args))
+            float(jitted(args, weights))
             t0 = time.perf_counter()
             acc = None
             for _ in range(max(reps, 1) * 8):
-                acc = jitted(args)
+                acc = jitted(args, weights)
             float(acc)
             return (time.perf_counter() - t0) / (max(reps, 1) * 8)
 
-        def perturbed(acc):
+        def perturbed(inputs, acc):
             # cheap data dependency: scales with |inputs[0]|, defeats LICM
-            return [args[0] + (acc * 1e-30).astype(args[0].dtype)] + args[1:]
+            return [inputs[0] + (acc * 1e-30).astype(inputs[0].dtype)] + inputs[1:]
 
-        def loop_with_op(_):
+        def loop_with_op(inputs, wts):
             def body(i, acc):
-                return acc + run_op(perturbed(acc))
+                return acc + run_op(perturbed(inputs, acc), wts)
 
             return jax.lax.fori_loop(0, inner, body, jnp.float32(0.0))
 
-        def loop_baseline(_):
+        def loop_baseline(inputs, wts):
+            del wts  # same call signature as loop_with_op; unused by design
+
             def body(i, acc):
-                x = perturbed(acc)[0]
+                x = perturbed(inputs, acc)[0]
                 return acc + jnp.sum(x.astype(jnp.float32))
 
             return jax.lax.fori_loop(0, inner, body, jnp.float32(0.0))
 
         def timed(fn) -> float:
             jitted = jax.jit(fn)
-            float(jitted(0))  # compile + first run
+            float(jitted(args, weights))  # compile + first run
             best = float("inf")
             for _ in range(max(reps, 1)):
                 t0 = time.perf_counter()
-                float(jitted(0))
+                float(jitted(args, weights))
                 best = min(best, time.perf_counter() - t0)
             return best
 

@@ -112,3 +112,33 @@ def test_strategy_serde_roundtrip():
 
 def test_pspec_helper():
     assert pspec("data", None, "model") == (("data",), (), ("model",))
+
+
+def test_tp_shardable_rejects_rows_of_inconsistent_columns():
+    # A column linear whose sharded output ALSO feeds a non-elementwise op
+    # (softmax) is inconsistent and must stay replicated — and so must the
+    # row linear it reaches, even when a different, consistent column->row
+    # pair exists in the same block. Regression: reached_rows used to
+    # accumulate across columns, so the consistent pair leaked the bad
+    # row into the shardable set and the stage shard_map contracted
+    # E(full) against E/tp at trace time.
+    from flexflow_tpu import FFModel
+    from flexflow_tpu.parallel.strategy import tp_shardable_nodes
+
+    model = FFModel(FFConfig(batch_size=4))
+    x = model.create_tensor([4, 32], name="x")
+    bad_mid = model.relu(model.dense(x, 64, name="bad_ff1"), inplace=False)
+    bad_out = model.dense(bad_mid, 32, name="bad_ff2")
+    leak = model.softmax(bad_mid)  # sharded value hits a normalizing op
+    good_mid = model.relu(model.dense(x, 64, name="good_ff1"), inplace=False)
+    good_out = model.dense(good_mid, 32, name="good_ff2")
+    del leak  # node exists in the PCG; that's all the scenario needs
+    _ = model.add(bad_out, good_out)
+
+    nodes = list(model.graph.nodes.values())
+    by_name = {n.name: n.guid for n in nodes if n.name}
+    shardable = tp_shardable_nodes(model.graph, nodes)
+    assert by_name["good_ff1"] in shardable
+    assert by_name["good_ff2"] in shardable
+    assert by_name["bad_ff1"] not in shardable
+    assert by_name["bad_ff2"] not in shardable

@@ -421,6 +421,35 @@ def test_grad_accumulation_metric_sums_and_batchnorm_state():
     assert not np.allclose(mean0, mean1), "bn state did not update through the scan"
 
 
+def test_grad_accumulation_rmse_matches_full_batch():
+    """rmse_loss is sqrt-of-a-mean (nonlinear): the accumulation merge
+    must reconstruct the full-batch RMSE from per-microbatch values, not
+    sum them (regression for the sum-semantics assumption)."""
+    import jax
+
+    from flexflow_tpu import ActiMode, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+
+    def build(accum):
+        m = FFModel(FFConfig(batch_size=32, grad_accum_steps=accum))
+        x = m.create_tensor((32, 16))
+        t = m.dense(x, 32, ActiMode.RELU, name="fc1")
+        m.dense(t, 4, name="fc2")
+        m.compile(
+            optimizer=SGDOptimizer(lr=0.1),
+            loss_type=LossType.MEAN_SQUARED_ERROR,
+            metrics=[MetricsType.ROOT_MEAN_SQUARED_ERROR],
+        )
+        return m
+
+    ma, mf = build(4), build(1)
+    rs = np.random.RandomState(2)
+    X = rs.randn(32, 16).astype(np.float32)
+    Y = rs.randn(32, 4).astype(np.float32)
+    ra = float(ma.executor.train_batch([X], Y, jax.random.key(0))["rmse_loss"])
+    rf = float(mf.executor.train_batch([X], Y, jax.random.key(0))["rmse_loss"])
+    np.testing.assert_allclose(ra, rf, rtol=1e-5)
+
+
 def test_traced_evaluate_matches_eager_evaluate():
     X, Y = _fit_data(n=96)
     m = build_mlp()
