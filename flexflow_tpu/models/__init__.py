@@ -7,6 +7,7 @@ from .transformer import (
     BERT_LARGE,
     TransformerConfig,
     build_transformer,
+    build_transformer_seq2seq,
 )
 from .vision import build_alexnet, build_inception_v3, build_resnet50, build_resnext50
 
@@ -15,6 +16,7 @@ __all__ = [
     "BERT_LARGE",
     "TransformerConfig",
     "build_transformer",
+    "build_transformer_seq2seq",
     "build_alexnet",
     "build_resnet50",
     "build_resnext50",

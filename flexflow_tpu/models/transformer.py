@@ -62,6 +62,70 @@ def attention_encoder_layer(
     return model.add(t, h, name=f"l{idx}_res2")
 
 
+def attention_decoder_layer(
+    model: FFModel, t: Tensor, enc: Tensor, cfg: TransformerConfig, idx: int
+) -> Tensor:
+    """One decoder block: causal self-attention, cross-attention over the
+    (fixed) encoder output, FFN — pre-LN residuals throughout. The shared
+    ``enc`` tensor is what exercises the pipeline's tuple-carry boundary
+    (parallel/pipeline.py boundary_structure shared values)."""
+    h = model.layer_norm(t, name=f"d{idx}_ln1")
+    attn = model.multihead_attention(
+        h, h, h, cfg.hidden_size, cfg.num_heads,
+        dropout=cfg.dropout, causal=True, name=f"d{idx}_self_attn",
+    )
+    t = model.add(t, attn, name=f"d{idx}_res1")
+    h = model.layer_norm(t, name=f"d{idx}_ln2")
+    cross = model.multihead_attention(
+        h, enc, enc, cfg.hidden_size, cfg.num_heads,
+        dropout=cfg.dropout, name=f"d{idx}_cross_attn",
+    )
+    t = model.add(t, cross, name=f"d{idx}_res2")
+    h = model.layer_norm(t, name=f"d{idx}_ln3")
+    h = model.dense(h, cfg.ff_size, ActiMode.GELU, name=f"d{idx}_ff1")
+    if cfg.dropout > 0:
+        h = model.dropout(h, cfg.dropout, name=f"d{idx}_drop")
+    h = model.dense(h, cfg.hidden_size, name=f"d{idx}_ff2")
+    return model.add(t, h, name=f"d{idx}_res3")
+
+
+def build_transformer_seq2seq(
+    config: FFConfig,
+    cfg: TransformerConfig = BERT_BASE,
+    num_decoder_layers: Optional[int] = None,
+    src_seq_length: Optional[int] = None,
+) -> FFModel:
+    """Encoder-decoder transformer (the original machine-translation
+    shape): encoder stack over the source, decoder stack with causal
+    self-attention + cross-attention over the final encoder output.
+
+    The decoder stack is the pipelinable region — its blocks are
+    structurally isomorphic and each reads the shared encoder output, the
+    boundary shape the reference's inter-op placement could express only
+    as whole-op device splits (graph.cc:206-231) and that this
+    framework's GPipe schedule rotates as a tuple carry."""
+    model = FFModel(config)
+    b, s, e = config.batch_size, cfg.seq_length, cfg.hidden_size
+    s_src = src_seq_length or s
+    n_dec = num_decoder_layers if num_decoder_layers is not None else cfg.num_layers
+    src = model.create_tensor((b, s_src, e), cfg.dtype, name="src_embeddings")
+    tgt = model.create_tensor((b, s, e), cfg.dtype, name="tgt_embeddings")
+    t = src
+    for i in range(cfg.num_layers):
+        t = attention_encoder_layer(model, t, cfg, i)
+    enc = model.layer_norm(t, name="enc_final_ln")
+    t = tgt
+    for i in range(n_dec):
+        t = attention_decoder_layer(model, t, enc, cfg, i)
+    t = model.layer_norm(t, name="dec_final_ln")
+    if cfg.vocab_size > 0:
+        t = model.dense(t, cfg.vocab_size, name="lm_head")
+        model.softmax(t)
+    else:
+        model.dense(t, e, name="out_proj")
+    return model
+
+
 def build_transformer(
     config: FFConfig, cfg: TransformerConfig = BERT_BASE
 ) -> FFModel:

@@ -90,14 +90,30 @@ class MultiHeadAttentionOp(OpDef):
             and "seq" in mesh.axis_names
             and mesh.shape["seq"] > 1
             and qh.shape[1] % mesh.shape["seq"] == 0
+            # cross-attention: K/V carry their OWN sequence length (the
+            # encoder side), which must also divide or the kernel's
+            # in_specs reject it at trace time — fall back to dense
+            and kh.shape[1] % mesh.shape["seq"] == 0
         )
         if seq_cp:
             # context parallelism: sequence dim sharded on the "seq" axis,
-            # K/V ride the ICI ring (new capability; reference has none)
+            # K/V ride the ICI ring (new capability; reference has none).
+            # cp x tp: Megatron-sharded projections keep their heads on
+            # "model" through the kernel instead of re-gathering
             from .kernels.ring_attention import ring_attention_sharded
 
+            head_axis = (
+                "model"
+                if (
+                    "model" in mesh.axis_names
+                    and mesh.shape["model"] > 1
+                    and qh.shape[2] % mesh.shape["model"] == 0
+                )
+                else None
+            )
             ctx_out = ring_attention_sharded(
-                qh, kh, vh, mesh, seq_axis="seq", causal=params.causal
+                qh, kh, vh, mesh, seq_axis="seq", causal=params.causal,
+                head_axis=head_axis,
             )
         else:
             ctx_out = attention_core(qh, kh, vh, causal=params.causal, backend=ctx.backend)

@@ -121,10 +121,16 @@ def ring_attention_sharded(
     batch_axis: Optional[str] = "data",
     causal: bool = False,
     scale: Optional[float] = None,
+    head_axis: Optional[str] = None,
 ) -> jax.Array:
-    """shard_map wrapper: [B, S, H, D] globally, S sharded on ``seq_axis``."""
+    """shard_map wrapper: [B, S, H, D] globally, S sharded on ``seq_axis``.
+
+    ``head_axis``: keep the head dim sharded through the kernel (cp x tp
+    composition — head-sharded projections from Megatron weights would
+    otherwise be all-gathered at this boundary)."""
     ba = batch_axis if batch_axis in mesh.axis_names else None
-    spec = P(ba, seq_axis, None, None)
+    ha = head_axis if head_axis in mesh.axis_names else None
+    spec = P(ba, seq_axis, ha, None)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal, scale=scale),
         mesh=mesh,

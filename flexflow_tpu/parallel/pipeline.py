@@ -26,22 +26,20 @@ parallelism): pipeline_strategy(tp=...) shards stage weights on "model"
 per the Megatron layout and ops psum row-parallel partials themselves
 (LowerCtx.weight_sharded_dim) — GSPMD cannot see through shard_map.
 
-Scope (v1, deliberate): the rotating boundary is exactly ONE activation
-tensor and blocks must be stateless (batchnorm state stays outside the
-stack; MoE aux losses ARE supported via with_aux). This covers the
-standard residual-stream architectures (BERT/GPT/ViT stacks — one
-hidden-state tensor in, one out). Shapes it excludes and why:
-  * blocks consuming a shared external tensor (cross-attention over a
-    fixed encoder output): per-microbatch extras must rotate with the
-    schedule, which needs a tuple carry — planned, not implemented;
-  * multi-stream boundaries (two tensors between blocks): same tuple
-    carry. Models with these shapes train under dp/tp/sp strategies
-    instead (compile() without pipeline_stages).
+The rotating boundary is a PYTREE carry: one or more activation streams
+flowing block to block (two-stream boundaries), plus per-microbatch
+"shared" tensors that every block reads but passes through unchanged (a
+fixed encoder output feeding cross-attention) — each microbatch's shared
+context rotates along with its activations so it is present at whatever
+stage currently holds that microbatch. boundary_structure() classifies a
+PCG's repeat boundary into rotating streams and shared values. Blocks
+must still be stateless (batchnorm state stays outside the stack; MoE
+aux losses ARE supported via with_aux).
 """
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -69,12 +67,22 @@ def gpipe(
     with_aux: bool = False,
     param_specs: Any = None,
 ) -> Callable[[Any, jax.Array], jax.Array]:
-    """Build a pipelined apply: (stacked_params, x) -> y.
+    """Build a pipelined apply: (stacked_params, x[, shared]) -> y.
 
-    stage_fn(params_for_one_stage, activation) -> activation, with the
-    same activation shape in and out (a residual-block stack).
+    stage_fn(params_for_one_stage, carry) -> carry, where carry is any
+    pytree of arrays with the same structure and shapes in and out (a
+    single hidden-state array for residual-block stacks; a tuple for
+    two-stream boundaries).
     stacked_params: pytree whose leaves have a leading stage axis [S, ...]
-    sharded over ``axis``. x: [B, ...] with B divisible by n_microbatches.
+    sharded over ``axis``. x: pytree of [B, ...] leaves with B divisible
+    by n_microbatches.
+
+    ``shared``: optional pytree of per-microbatch tensors every block
+    READS but never writes (a fixed encoder output for cross-attention).
+    They rotate along the pipe with their microbatch — so the stage
+    currently holding microbatch m sees m's shared context — but are
+    never banked or psum-broadcast at the exit, and stage_fn receives
+    them as a third argument: stage_fn(params, carry, shared) -> carry.
 
     with_aux=True: stage_fn returns (activation, aux_scalar) and the
     pipelined apply returns (y, aux) where aux sums each stage's scalar
@@ -93,69 +101,107 @@ def gpipe(
 
     n_stages = mesh.shape[axis]
 
-    def pipelined(stacked_params, x):
-        b = x.shape[0]
+    def pipelined(stacked_params, x, shared=None):
+        has_shared = shared is not None and len(jax.tree.leaves(shared)) > 0
+        if not has_shared:
+            shared = ()
+        leaves = jax.tree.leaves(x) + jax.tree.leaves(shared)
+        b = leaves[0].shape[0]
+        assert all(l.shape[0] == b for l in leaves), [l.shape for l in leaves]
         assert b % n_microbatches == 0, (b, n_microbatches)
         mb = b // n_microbatches
-        # [M, mb, ...] microbatch schedule
-        xs = x.reshape((n_microbatches, mb) + x.shape[1:])
 
-        def per_device(params, xs_local):
+        def to_mb(tree):
+            # [M, mb, ...] microbatch schedule, per leaf
+            return jax.tree.map(
+                lambda a: a.reshape((n_microbatches, mb) + a.shape[1:]), tree
+            )
+
+        xs, ss = to_mb(x), to_mb(shared)
+
+        def per_device(params, xs_local, ss_local):
             # params: this stage's slice, leading axis of size 1
             params = jax.tree.map(lambda p: p[0], params)
             stage = jax.lax.axis_index(axis)
             ticks = n_microbatches + n_stages - 1
-            # local microbatch shape (the batch dim may be data-sharded)
-            act0 = jnp.zeros(xs_local.shape[1:], x.dtype)
-            outs0 = jnp.zeros_like(xs_local)
+            # local microbatch shapes (the batch dim may be data-sharded)
+            zeros_mb = lambda t: jax.tree.map(lambda a: jnp.zeros(a.shape[1:], a.dtype), t)
+            act0, shr0 = zeros_mb(xs_local), zeros_mb(ss_local)
+            # only the ROTATING streams get an output bank: shared
+            # tensors are read-only context the caller already holds —
+            # banking them would buy an [M, mb, ...] buffer + an
+            # all-stage psum per shared leaf for values we then discard
+            outs0 = jax.tree.map(jnp.zeros_like, xs_local)
             aux0 = jnp.zeros((), jnp.float32)
             if hasattr(jax.lax, "pcast"):
                 # newer shard_map tracks varying manual axes: the carries
                 # must enter the scan with the variance they will have
                 # after a tick — {pipe} ∪ {data if batch-sharded}.
                 # outs0 = zeros_like(xs_local) already varies like the
-                # input (data); act0 is fresh zeros (invarying).
+                # input (data); act0/shr0 are fresh zeros (invarying).
                 from .mesh import DATA_AXIS as _DA
 
                 data_v = (_DA,) if (_DA in mesh.axis_names and mesh.shape[_DA] > 1) else ()
-                act0 = jax.lax.pcast(act0, (axis,) + data_v, to="varying")
-                outs0 = jax.lax.pcast(outs0, (axis,), to="varying")
+                vary = lambda t: jax.tree.map(
+                    lambda a: jax.lax.pcast(a, (axis,) + data_v, to="varying"), t
+                )
+                act0, shr0 = vary(act0), vary(shr0)
+                outs0 = jax.tree.map(
+                    lambda a: jax.lax.pcast(a, (axis,), to="varying"), outs0
+                )
                 aux0 = jax.lax.pcast(aux0, (axis,) + data_v, to="varying")
 
             def tick(carry, t):
-                act, outs, aux_acc = carry
+                act, shr, outs, aux_acc = carry
                 # stage 0 injects microbatch t; others use the arriving act
                 inject = jnp.where(t < n_microbatches, t, 0)
-                fresh = jax.lax.dynamic_index_in_dim(xs_local, inject, keepdims=False)
-                inp = jnp.where(stage == 0, fresh, act)
+                fresh_of = lambda tree: jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(a, inject, keepdims=False),
+                    tree,
+                )
+                pick = lambda fresh, arriving: jax.tree.map(
+                    lambda f, a: jnp.where(stage == 0, f, a), fresh, arriving
+                )
+                inp = pick(fresh_of(xs_local), act)
+                sinp = pick(fresh_of(ss_local), shr)
+                args = (params, inp, sinp) if has_shared else (params, inp)
                 if with_aux:
-                    out, aux_t = stage_fn(params, inp)
+                    out, aux_t = stage_fn(*args)
                     # this stage holds microbatch t - stage; real ones only
                     mb = t - stage
                     live = jnp.logical_and(mb >= 0, mb < n_microbatches)
                     aux_acc = aux_acc + jnp.where(live, aux_t.astype(jnp.float32), 0.0)
                 else:
-                    out = stage_fn(params, inp)
+                    out = stage_fn(*args)
                 # last stage banks microbatch t - (S-1)
                 done_idx = t - (n_stages - 1)
                 is_last = stage == n_stages - 1
                 valid = jnp.logical_and(is_last, done_idx >= 0)
-                updated = jax.lax.dynamic_update_index_in_dim(
-                    outs, out.astype(outs.dtype), jnp.maximum(done_idx, 0), 0
-                )
-                outs = jnp.where(valid, updated, outs)
-                # rotate activations one hop down the pipe
-                perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-                act = jax.lax.ppermute(out, axis, perm)
-                return (act, outs, aux_acc), None
 
-            (act, outs, aux_acc), _ = jax.lax.scan(
-                tick, (act0, outs0, aux0), jnp.arange(ticks)
+                def bank(bank_arr, o):
+                    updated = jax.lax.dynamic_update_index_in_dim(
+                        bank_arr, o.astype(bank_arr.dtype), jnp.maximum(done_idx, 0), 0
+                    )
+                    return jnp.where(valid, updated, bank_arr)
+
+                outs = jax.tree.map(bank, outs, out)
+                # rotate the carry (and each microbatch's shared context)
+                # one hop down the pipe
+                perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+                rot = lambda t_: jax.tree.map(
+                    lambda o: jax.lax.ppermute(o, axis, perm), t_
+                )
+                return (rot(out), rot(sinp), outs, aux_acc), None
+
+            (act, shr, outs, aux_acc), _ = jax.lax.scan(
+                tick, (act0, shr0, outs0, aux0), jnp.arange(ticks)
             )
             # outs is populated only on the last stage; psum broadcasts it
             # (every other stage holds zeros)
-            mask = (stage == n_stages - 1).astype(outs.dtype)
-            y_out = jax.lax.psum(outs * mask, axis)
+            mask = stage == n_stages - 1
+            y_out = jax.tree.map(
+                lambda o: jax.lax.psum(o * mask.astype(o.dtype), axis), outs
+            )
             if not with_aux:
                 return y_out
             # sum stages (each stage = distinct blocks), average over
@@ -180,18 +226,20 @@ def gpipe(
         from .mesh import DATA_AXIS
 
         data = DATA_AXIS if DATA_AXIS in mesh.axis_names and mesh.shape[DATA_AXIS] > 1 else None
-        xs_spec = PartitionSpec(None, data)
+        mb_spec = lambda t: jax.tree.map(lambda _: PartitionSpec(None, data), t)
+        xs_spec, ss_spec = mb_spec(xs), mb_spec(ss)
         out_specs = (xs_spec, PartitionSpec()) if with_aux else xs_spec
         result = shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(specs_params, xs_spec),
+            in_specs=(specs_params, xs_spec, ss_spec),
             out_specs=out_specs,
-        )(stacked_params, xs)
+        )(stacked_params, xs, ss)
+        unmb = lambda t: jax.tree.map(lambda a: a.reshape((b,) + a.shape[2:]), t)
         if with_aux:
             y, aux = result
-            return y.reshape((b,) + y.shape[2:]), aux
-        return result.reshape((b,) + result.shape[2:])
+            return unmb(y), aux
+        return unmb(result)
 
     return pipelined
 
@@ -202,25 +250,45 @@ def gpipe(
 
 
 def _node_signatures(graph, order):
-    """Structural signature per topo position: (op_type, params, in-edge
-    shape) where each in-edge is (dst_idx, relative offset to the
-    producer's topo position, src_idx). Offsets make the signature
-    position-independent, so a repeated block stack yields a literal
-    periodic sequence."""
-    pos = {n.guid: i for i, n in enumerate(order)}
+    """Cheap per-position prefilter signature: (op_type, params, in-edge
+    (dst_idx, src_idx) shape). Edge wiring is checked exactly by
+    _blocks_equal — the signature alone would either break on shared
+    externals (a fixed encoder output read by every block sits at a
+    different relative offset from each) or over-match."""
     sigs = []
-    for i, n in enumerate(order):
-        edges = tuple(
-            sorted((e.dst_idx, i - pos[e.src], e.src_idx) for e in graph.in_edges(n))
-        )
+    for n in order:
+        edges = tuple(sorted((e.dst_idx, e.src_idx) for e in graph.in_edges(n)))
         sigs.append((n.op_type, n.params, edges))
     return sigs
+
+
+def _blocks_equal(graph, order, pos, a1, a2, p):
+    """Are order[a1:a1+p] and order[a2:a2+p] isomorphic blocks? Each
+    in-edge pair must be INTERNAL with the same relative producer offset,
+    or EXTERNAL in both blocks (producer before the block start) — the
+    entry value of block 0 may sit far away in topo order (the tgt input
+    behind a whole encoder) while later blocks read their predecessor.
+    Which external wiring shapes are actually pipelinable is validated
+    downstream by boundary_structure's rotating/shared contract."""
+    for off in range(p):
+        x, y = order[a1 + off], order[a2 + off]
+        ex = sorted(graph.in_edges(x), key=lambda e: (e.dst_idx, e.src_idx))
+        ey = sorted(graph.in_edges(y), key=lambda e: (e.dst_idx, e.src_idx))
+        for e1, e2 in zip(ex, ey):
+            int1 = pos[e1.src] >= a1
+            int2 = pos[e2.src] >= a2
+            if int1 != int2:
+                return False
+            if int1 and (a1 + off) - pos[e1.src] != (a2 + off) - pos[e2.src]:
+                return False
+    return True
 
 
 def detect_repeats(graph):
     """Split the PCG into (pre, repeats, post) where ``repeats`` is the
     maximal run of structurally-isomorphic contiguous blocks (a
-    transformer's encoder stack). Block isomorphism is what lets the
+    transformer's encoder stack, or a decoder stack whose blocks all read
+    one shared encoder output). Block isomorphism is what lets the
     executor stack per-block params [S, r, ...] and run them as ONE SPMD
     stage program under the GPipe schedule.
 
@@ -228,6 +296,7 @@ def detect_repeats(graph):
     repeats == [] when no periodic region of >= 2 blocks exists.
     """
     order = list(graph.topo_order())
+    pos = {n.guid: i for i, n in enumerate(order)}
     sigs = _node_signatures(graph, order)
     n = len(order)
     # maximize covered nodes; tie-break earliest start, then SMALLEST
@@ -239,9 +308,15 @@ def detect_repeats(graph):
             break
         for p in range(1, (n - a) // 2 + 1):
             if sigs[a : a + p] != sigs[a + p : a + 2 * p]:
+                continue  # prefilter
+            if not _blocks_equal(graph, order, pos, a, a + p, p):
                 continue
             k = 2
-            while a + (k + 1) * p <= n and sigs[a + k * p : a + (k + 1) * p] == sigs[a : a + p]:
+            while (
+                a + (k + 1) * p <= n
+                and sigs[a + k * p : a + (k + 1) * p] == sigs[a : a + p]
+                and _blocks_equal(graph, order, pos, a, a + k * p, p)
+            ):
                 k += 1
             cand = (k * p, -a, -p, a, p, k)
             if best is None or cand > best:
@@ -254,50 +329,151 @@ def detect_repeats(graph):
 
 
 def boundary_values(graph, repeats):
-    """((in_src_guid, in_src_idx), (out_src_guid, out_src_idx)) for the
-    pipelined region: the single value entering repeat 0 and the single
-    value leaving the last repeat. Raises if any repeat boundary carries
-    more than one tensor (GPipe rotates exactly one activation)."""
-    for j, rep in enumerate(repeats):
-        guids = {n.guid for n in rep}
-        ext_in = {
-            (e.src, e.src_idx)
-            for node in rep
-            for e in graph.in_edges(node)
-            if e.src not in guids
-        }
-        if len(ext_in) != 1:
+    """Single-stream view of the boundary: ((in_guid, in_idx),
+    (out_guid, out_idx)). Thin wrapper over boundary_structure — raises
+    ValueError when the region needs the full tuple carry (several
+    rotating streams or shared values), so single-stream callers keep
+    their historical contract without a second validator to maintain."""
+    rotating_in, shared, streams = boundary_structure(graph, repeats)
+    if shared or len(rotating_in) != 1:
+        raise ValueError(
+            f"pipeline boundary carries {len(rotating_in)} rotating streams "
+            f"+ {len(shared)} shared values (single-stream caller needs "
+            "exactly 1 + 0); use boundary_structure for the tuple carry"
+        )
+    p, i = streams[0]
+    return rotating_in[0], (repeats[-1][p].guid, i)
+
+
+def boundary_structure(graph, repeats):
+    """Classify the pipelined region's boundary for a TUPLE carry.
+
+    Every external input slot of a repeat — identified structurally as
+    (consumer's template position, dst_idx) — must be one of:
+      * SHARED: every repeat reads the SAME (guid, idx) produced outside
+        the region (a fixed encoder output feeding cross-attention);
+      * ROTATING: repeat j reads what repeat j-1 produced at a fixed
+        template-local position (the activation streams; one for
+        residual stacks, several for two-stream boundaries).
+
+    Returns (rotating_in, shared, out_streams):
+      rotating_in: [(src_guid, src_idx)] values entering repeat 0 from
+        the pre-region, one per distinct rotating stream, canonical order;
+      shared: [(src_guid, src_idx)] produced outside the region;
+      out_streams: [(template_pos, out_idx)] aligned with rotating_in —
+        where each stream leaves a block, template-locally.
+    Raises ValueError for boundary shapes outside this contract (e.g. a
+    skip connection reaching across two blocks).
+    """
+    region_guids = {n.guid for rep in repeats for n in rep}
+    # per-repeat guid sets and guid->position maps, hoisted once — the
+    # slot/stream/escape checks below all index into them
+    rep_guids = [{n.guid for n in rep} for rep in repeats]
+    rep_pos = [{n.guid: i for i, n in enumerate(rep)} for rep in repeats]
+
+    def slots(j):
+        guids, pos, out = rep_guids[j], rep_pos[j], {}
+        for node in repeats[j]:
+            for e in graph.in_edges(node):
+                if e.src not in guids:
+                    out[(pos[node.guid], e.dst_idx)] = (e.src, e.src_idx)
+        return out
+
+    per_rep = [slots(j) for j in range(len(repeats))]
+    slot_keys = sorted(per_rep[0])
+    for j, s in enumerate(per_rep[1:], 1):
+        if sorted(s) != slot_keys:
             raise ValueError(
-                f"pipeline stage boundary at repeat {j} carries {len(ext_in)} values "
-                f"(need exactly 1): {sorted(ext_in)}"
+                f"repeat {j} external-input slots {sorted(s)} differ from "
+                f"template slots {slot_keys}"
             )
-        if j == 0:
-            boundary_in = next(iter(ext_in))
-    last = repeats[-1]
-    last_guids = {n.guid for n in last}
-    ext_out = {
-        (e.src, e.src_idx)
-        for node in last
-        for e in graph.out_edges(node)
-        if e.dst not in last_guids
-    }
-    if len(ext_out) > 1:
-        raise ValueError(f"pipelined region exposes {len(ext_out)} outputs (need 1)")
-    if not ext_out:
-        # the last repeat is the graph sink: its final value is the output
-        sink_edges = {
-            (e.src, e.src_idx)
-            for node in repeats[-2]
-            for e in graph.out_edges(node)
-            if e.dst in last_guids
-        }
-        # structurally the same position one block later
-        src_guid, src_idx = next(iter(sink_edges))
-        pos = {n.guid: i for i, n in enumerate(repeats[-2])}
-        out = (last[pos[src_guid]].guid, src_idx)
-    else:
-        out = next(iter(ext_out))
-    return boundary_in, out
+
+    shared: List[Tuple[int, int]] = []
+    # stream key (template_pos, out_idx) -> entry value for repeat 0
+    stream_entry: Dict[Tuple[int, int], Tuple[int, int]] = {}
+    stream_order: List[Tuple[int, int]] = []
+    for key in slot_keys:
+        vals = [s[key] for s in per_rep]
+        if all(v == vals[0] for v in vals) and vals[0][0] not in region_guids:
+            if vals[0] not in shared:
+                shared.append(vals[0])
+            continue
+        # rotating: repeat j's producer must sit in repeat j-1 at one
+        # fixed template position
+        stream = None
+        for j in range(1, len(repeats)):
+            src, idx = per_rep[j][key]
+            prev_pos = rep_pos[j - 1]
+            if src not in prev_pos:
+                raise ValueError(
+                    f"slot {key}: repeat {j} reads {(src, idx)} which is neither "
+                    "shared nor produced by the previous repeat"
+                )
+            this = (prev_pos[src], idx)
+            if stream is None:
+                stream = this
+            elif stream != this:
+                raise ValueError(
+                    f"slot {key}: producer position varies across repeats "
+                    f"({stream} vs {this})"
+                )
+        entry = per_rep[0][key]
+        if entry[0] in region_guids:
+            raise ValueError(f"slot {key}: repeat 0 reads from inside the region")
+        if stream in stream_entry:
+            if stream_entry[stream] != entry:
+                raise ValueError(
+                    f"stream {stream}: inconsistent entry values "
+                    f"({stream_entry[stream]} vs {entry})"
+                )
+        else:
+            stream_entry[stream] = entry
+            stream_order.append(stream)
+
+    if not stream_order:
+        raise ValueError("pipelined region has no rotating stream")
+    rotating_in = [stream_entry[s] for s in stream_order]
+    # the executor seeds the template's inputs by entry (guid, idx): the
+    # keys must be pairwise distinct or two carry positions would collide
+    # on one key and blocks would silently read the wrong tensor (e.g. a
+    # decoder whose initial hidden state IS the shared encoder output)
+    all_keys = rotating_in + shared
+    if len(set(all_keys)) != len(all_keys):
+        raise ValueError(
+            f"boundary entry values collide (rotating {rotating_in}, "
+            f"shared {shared}): inexpressible as a tuple carry"
+        )
+
+    # region outputs: whatever escapes the LAST repeat must be a rotating
+    # stream position (those are banked by the schedule); the sink case
+    # (no escapes) exposes all streams. A MIDDLE repeat's value escaping
+    # the region (deep supervision off an intermediate block) is
+    # unrecoverable — the schedule banks only the final carry — and must
+    # fail HERE with ValueError so the search falls back to dp/tp, not
+    # later with a KeyError in the executor.
+    last_pos = rep_pos[-1]
+    streams = set(stream_order)
+    for j, rep in enumerate(repeats):
+        is_last = j == len(repeats) - 1
+        ok_dsts = rep_guids[j] if is_last else rep_guids[j] | rep_guids[j + 1]
+        for node in rep:
+            for e in graph.out_edges(node):
+                if e.dst in ok_dsts:
+                    continue
+                if is_last:
+                    if (last_pos[node.guid], e.src_idx) not in streams:
+                        raise ValueError(
+                            f"last repeat exposes {(node.guid, e.src_idx)} at "
+                            f"position {(last_pos[node.guid], e.src_idx)}, "
+                            "which is not a rotating stream"
+                        )
+                else:
+                    raise ValueError(
+                        f"repeat {j} value {(node.guid, e.src_idx)} escapes the "
+                        "pipelined region mid-stack (only the final carry is "
+                        "banked)"
+                    )
+    return rotating_in, shared, stream_order
 
 
 def balanced_stages(costs, n_stages: int):

@@ -307,6 +307,7 @@ def context_parallel_strategy(
     graph: PCGraph,
     dp: int,
     cp: int,
+    tp: int = 1,
     batch_dim: int = 0,
     seq_dim: int = 1,
 ) -> ParallelStrategy:
@@ -315,9 +316,17 @@ def context_parallel_strategy(
     shard their sequence dim on the "seq" mesh axis; attention nodes ride
     the ICI ring via ring attention (ops/kernels/ring_attention.py),
     which the attention lowering selects automatically when the mesh has
-    a "seq" axis. Weights are replicated (combine with tensor parallelism
-    via the unity search for hybrid strategies)."""
-    st = ParallelStrategy(axis_sizes={DATA_AXIS: dp, SEQ_AXIS: cp})
+    a "seq" axis.
+
+    tp > 1 composes Megatron tensor parallelism (cp x tp): block weights
+    additionally shard on "model" per megatron_strategy's layout while
+    the sequence rides "seq" — this is all GSPMD territory (unlike the
+    pipeline's manual stages), so resharding between the two regimes is
+    always legal and the compiler inserts the collectives."""
+    axes_sizes = {DATA_AXIS: dp, SEQ_AXIS: cp}
+    if tp > 1:
+        axes_sizes[MODEL_AXIS] = tp
+    st = ParallelStrategy(axis_sizes=axes_sizes)
     from ..ops.base import get_op_def
     from .propagation import infer_all_specs
 
@@ -330,6 +339,11 @@ def context_parallel_strategy(
             wspecs = op_def.weight_specs(node.params, in_specs)
         except Exception:
             wspecs = []
+        by_name = {w.name: w for w in wspecs}
+        weights: Dict[str, Optional[SpecTuple]] = {w.name: None for w in wspecs}
+        if tp > 1:
+            for wname, dim in megatron_weight_dims(node).items():
+                shard_weight_entry(weights, by_name, wname, dim, MODEL_AXIS, tp)
         shardings: List[Optional[SpecTuple]] = []
         for os in out_specs:
             if node.op_type == OpType.WEIGHT or os.ndim <= batch_dim:
@@ -341,9 +355,7 @@ def context_parallel_strategy(
             if cp > 1 and os.ndim > seq_dim and os.shape[seq_dim] % cp == 0:
                 axes[seq_dim] = SEQ_AXIS
             shardings.append(pspec(*axes) if any(a for a in axes) else None)
-        st.node_shardings[node.guid] = OpSharding(
-            outputs=shardings, weights={w.name: None for w in wspecs}
-        )
+        st.node_shardings[node.guid] = OpSharding(outputs=shardings, weights=weights)
     return st
 
 

@@ -47,15 +47,18 @@ class _PipelinePlan:
     post: List[Node]
     n_stages: int
     n_microbatches: int
-    boundary_in: Tuple[int, int]  # (guid, idx) of the value entering repeat 0
-    out_src: Tuple[int, int]  # (guid, idx) of the last repeat's exit value
-    out_pos: Tuple[int, int]  # same value template-locally: (position, idx)
+    # tuple carry (parallel/pipeline.py boundary_structure): the values
+    # entering repeat 0 per rotating stream, the per-microbatch shared
+    # values every block reads, and each stream's template-local exit
+    rotating_in: List[Tuple[int, int]]  # [(guid, idx)]
+    shared: List[Tuple[int, int]]  # [(guid, idx)], produced in pre
+    out_streams: List[Tuple[int, int]]  # [(template_pos, out_idx)]
 
 
 def _build_pipeline_plan(graph: PCGraph, strategy) -> Optional[_PipelinePlan]:
     if strategy is None or strategy.pipeline is None or strategy.pipeline.n_stages <= 1:
         return None
-    from ..parallel.pipeline import boundary_values, detect_repeats
+    from ..parallel.pipeline import boundary_structure, detect_repeats
 
     pa = strategy.pipeline
     pre, repeats, post = detect_repeats(graph)
@@ -80,18 +83,29 @@ def _build_pipeline_plan(graph: PCGraph, strategy) -> Optional[_PipelinePlan]:
                     f"block {j} node {node} assigned stage "
                     f"{pa.stage_of.get(node.guid)}, need contiguous stage {want}"
                 )
-    boundary_in, out_src = boundary_values(graph, repeats)
-    last = repeats[-1]
-    pos = next(i for i, n in enumerate(last) if n.guid == out_src[0])
+    rotating_in, shared, out_streams = boundary_structure(graph, repeats)
+    # every carry entry is microbatched along dim 0 by the schedule: a
+    # batch-less shared tensor (e.g. an (S, E) bias broadcast into every
+    # block) would be silently row-sliced per microbatch — reject with
+    # the same ValueError contract as the structural checks
+    from ..parallel.propagation import infer_all_specs
+
+    specs = infer_all_specs(graph)
+    lead = {(g, i): (specs[g][i].shape[:1] or (1,))[0] for g, i in rotating_in + shared}
+    if len(set(lead.values())) > 1:
+        raise ValueError(
+            f"pipeline carry entries disagree on the leading (batch) dim: {lead} "
+            "— batch-less shared tensors cannot ride the microbatch schedule"
+        )
     return _PipelinePlan(
         pre=pre,
         repeats=repeats,
         post=post,
         n_stages=pa.n_stages,
         n_microbatches=pa.n_microbatches,
-        boundary_in=boundary_in,
-        out_src=out_src,
-        out_pos=(pos, out_src[1]),
+        rotating_in=rotating_in,
+        shared=shared,
+        out_streams=out_streams,
     )
 
 
@@ -388,19 +402,13 @@ class CompiledExecutor:
             [n for n in plan.pre if n.op_type != OpType.INPUT],
             values, params, state, rng, training,
         )
-        x = values[plan.boundary_in]
+        # tuple carry: rotating streams (banked at the exit) and
+        # per-microbatch shared values (read-only context the schedule
+        # rotates but never banks) — all produced by the pre region
+        x = tuple(values[v] for v in plan.rotating_in)
+        x_shared = tuple(values[v] for v in plan.shared)
 
         template = plan.repeats[0]
-        tpl_guids = {n.guid for n in template}
-        tpl_in = {
-            (e.src, e.src_idx)
-            for node in template
-            for e in self.graph.in_edges(node)
-            if e.src not in tpl_guids
-        }
-        (in_src,) = tpl_in
-        # the template's outgoing value, expressed template-locally
-        out_pos = plan.out_pos
 
         r = len(plan.repeats) // plan.n_stages
         # blocks that can emit aux losses (MoE load balance) engage the
@@ -435,7 +443,7 @@ class CompiledExecutor:
             for node in template
         }
 
-        def stage_fn(stage_params, act):
+        def stage_fn(stage_params, act, shr=()):
             # stage_params leaves [r, ...]: scan the stage's blocks.
             # RNG folds the GLOBAL block index (stage*r + ridx): folding
             # only ridx would give corresponding blocks of every stage
@@ -447,7 +455,10 @@ class CompiledExecutor:
             def body(carry, rep):
                 rep_params, ridx = rep
                 act_in, aux_in = carry
-                local = {in_src: act_in}
+                # seed the template's external inputs: rotating streams by
+                # their repeat-0 entry keys, shared values by their own
+                local = {k: act_in[i] for i, k in enumerate(plan.rotating_in)}
+                local.update({k: shr[i] for i, k in enumerate(plan.shared)})
                 ctx = LowerCtx(
                     training=training,
                     rng=jax.random.fold_in(rng, stage_idx * r + ridx),
@@ -467,7 +478,12 @@ class CompiledExecutor:
                 aux_out = aux_in
                 for a in ctx.aux_losses:
                     aux_out = aux_out + a.astype(jnp.float32)
-                return (local[(template[out_pos[0]].guid, out_pos[1])], aux_out), None
+                # next block's carry: each stream's exit value (shared
+                # values are closed over, not threaded)
+                act_out = tuple(
+                    local[(template[p].guid, i)] for p, i in plan.out_streams
+                )
+                return (act_out, aux_out), None
 
             aux0 = jnp.zeros((), jnp.float32)
             if hasattr(jax.lax, "pcast"):
@@ -498,11 +514,15 @@ class CompiledExecutor:
             param_specs=param_specs,
         )
         if with_aux:
-            y, pipe_aux = pipelined(params[_PIPE_KEY], x)
+            y, pipe_aux = pipelined(params[_PIPE_KEY], x, x_shared)
         else:
-            y = pipelined(params[_PIPE_KEY], x)
+            y = pipelined(params[_PIPE_KEY], x, x_shared)
             pipe_aux = None
-        values[plan.out_src] = y
+        # bank each rotating stream at its LAST-repeat producer so the
+        # post region can consume any of them
+        last = plan.repeats[-1]
+        for i, (p, idx) in enumerate(plan.out_streams):
+            values[(last[p].guid, idx)] = y[i]
         post_ctx = self._interpret_nodes(plan.post, values, params, state, rng, training)
         aux = pre_ctx.aux_losses + post_ctx.aux_losses
         if pipe_aux is not None:

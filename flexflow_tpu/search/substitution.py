@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..core.graph import Edge, Node, PCGraph
 from ..core.types import ActiMode, OpType
+from ..ops.io_ops import NoOpParams
 from ..ops.parallel_ops import (
     AllReduceParams,
     CombineParams,
@@ -72,7 +73,14 @@ class OpX:
         if node.op_type != self.op_type:
             return False
         for k, v in self.constraints.items():
-            if getattr(node.params, k, None) != v:
+            got = getattr(node.params, k, None)
+            # a frozenset constraint means "any of these values" — used
+            # for dim constraints whose positive/negative encodings are
+            # equivalent for the rule's declared tensor rank
+            if isinstance(v, frozenset):
+                if got not in v:
+                    return False
+            elif got != v:
                 return False
         if self.match_fn is not None and not self.match_fn(node):
             return False
@@ -90,6 +98,11 @@ class GraphXfer:
     # (src_op_idx, src_ts_idx) -> (dst_op_idx, dst_ts_idx): which dst tensor
     # replaces each src output consumed outside the pattern
     mapped_outputs: Dict[Tuple[int, int], Tuple[int, int]] = dataclasses.field(default_factory=dict)
+    # canonical structural signature of the CONVERTED form (JSON-loaded
+    # rules only) — duplicate pruning must compare what the matcher will
+    # actually run, not the raw export (which still carries the weight
+    # inputs conversion drops)
+    signature: Optional[str] = None
 
     # ------------------------------------------------------------ matching
     def find_matches(self, graph: PCGraph, limit: int = 64) -> List[List[Node]]:
@@ -215,6 +228,8 @@ class GraphXfer:
         for di, d in enumerate(self.dst_ops):
             for pos, tx in enumerate(d.inputs):
                 if tx.op_idx < 0:
+                    if tx.ts_idx not in ext_bindings:
+                        return None  # dst consumes an external never bound by src
                     src_guid, src_idx = ext_bindings[tx.ts_idx]
                 else:
                     src_guid, src_idx = new_nodes[tx.op_idx].guid, tx.ts_idx
@@ -673,65 +688,272 @@ _PARALLEL_PARAM_MAKERS = {
     OpType.REDUCTION: lambda dim, deg: ReductionParams(degree=deg),
 }
 
+# mirror of the reference's get_num_inputs (substitution.cc:1416-1454):
+# the TASO export lists weight tensors as op inputs (a Linear srcOp has
+# [activation, weight]); the reference truncates each op to its graph
+# arity, dropping weight inputs — PCG edges carry data only.
+_RULE_NUM_INPUTS = {
+    OpType.EW_ADD: 2,
+    OpType.EW_MUL: 2,
+    OpType.BATCH_MATMUL: 2,
+    OpType.LINEAR: 1,
+    OpType.CONV2D: 1,
+    OpType.POOL2D: 1,
+    OpType.RELU: 1,
+    OpType.SIGMOID: 1,
+    OpType.TANH: 1,
+    OpType.IDENTITY: 1,
+    OpType.SPLIT: 1,
+    OpType.RESHAPE: 1,
+    OpType.TRANSPOSE: 1,
+    OpType.SOFTMAX: 1,
+    OpType.BATCHNORM: 1,
+    OpType.DROPOUT: 1,
+    OpType.EMBEDDING: 1,
+    OpType.NOOP: 1,
+    OpType.REPARTITION: 1,
+    OpType.COMBINE: 1,
+    OpType.REPLICATE: 1,
+    OpType.REDUCTION: 1,
+    OpType.MULTIHEAD_ATTENTION: 3,
+}
 
-def load_substitution_json(path: str) -> List[GraphXfer]:
+# op types whose PCG nodes own NO weights: a dst op of one of these may
+# be instantiated FRESH (new guid) when another dst op already reused the
+# matched src node's guid — weighted types must stay unique per rule or
+# the copy would re-initialize its own weights (changing semantics)
+_WEIGHTLESS_RULE_OPS = frozenset(
+    {
+        OpType.EW_ADD, OpType.EW_MUL, OpType.RELU, OpType.SIGMOID,
+        OpType.TANH, OpType.IDENTITY, OpType.CONCAT, OpType.SPLIT,
+        OpType.RESHAPE, OpType.TRANSPOSE, OpType.SOFTMAX, OpType.DROPOUT,
+        OpType.BATCH_MATMUL, OpType.NOOP, OpType.POOL2D,
+    }
+)
+
+# TASO's ActiMode enum (ops.h): the exported rules carry these raw ints.
+# (The reference compares them against its OWN ActiMode enum, whose
+# values start at 10 — ffconst.h:5 — so its PM_ACTI constraints can
+# never hold; here they're mapped so activation-constrained rules work.)
+_TASO_ACTI = {0: ActiMode.NONE, 1: ActiMode.SIGMOID, 2: ActiMode.RELU, 3: ActiMode.TANH}
+
+
+def load_substitution_json(path: str, degrees: Sequence[int] = (2,)) -> List[GraphXfer]:
     """Load a reference-format rule collection (--substitution-json,
-    config.h:146; serde substitution_loader.cc create_xfers).
+    config.h:146; serde substitution_loader.cc; conversion semantics of
+    create_xfers, substitution.cc:1659-1786).
 
-    Rules whose op types have no analog here are skipped, mirroring the
-    reference's partial support for TASO exports.
+    Reference parity choices:
+      * weight inputs are dropped per-op (get_num_inputs mirror above);
+      * distinct external tensors keyed by (opId, tsId) stay distinct
+        (the reference allocates one TensorX per distinct pair);
+      * rules are exported with PM_PARALLEL_DEGREE == 2 and instantiated
+        once per requested runtime degree (create_xfers' parallel_degree);
+      * single-op -> single-op rules are skipped;
+      * structural duplicates (same types + constraints + wiring) are
+        pruned, as in create_xfers' redundant-xfer check.
+    Rules whose op types have no analog here, or whose dest compute ops
+    cannot inherit params from a unique same-typed src op, are skipped —
+    mirroring the reference's partial support for TASO exports (its own
+    find_opx_with_type asserts a unique source op).
     """
     with open(path) as f:
         data = json.load(f)
     rules = data["rule"] if isinstance(data, dict) else data
     out: List[GraphXfer] = []
-    for rule in rules:
-        xfer = _rule_to_xfer(rule)
-        if xfer is not None:
+    seen_sigs = set()
+    for degree in degrees:
+        for rule in rules:
+            xfer = _rule_to_xfer(rule, degree)
+            if xfer is None:
+                continue
+            # dedup on the CONVERTED form (reference: create_xfers'
+            # check_opxes_have_same_type_and_constraints pruning,
+            # substitution.cc:1615) — distinct exports whose dropped
+            # weight inputs were the only difference collapse here
+            if xfer.signature in seen_sigs:
+                continue
+            seen_sigs.add(xfer.signature)
             out.append(xfer)
     return out
 
 
-def _rule_to_xfer(rule: dict) -> Optional[GraphXfer]:
-    def parse_ops(op_list, is_dst: bool) -> Optional[List[OpX]]:
+def _rule_to_xfer(rule: dict, degree: int = 2) -> Optional[GraphXfer]:
+    # externals are shared between src and dst sides, keyed by the rule's
+    # (opId, tsId) — reference create_xfer's get_input_tensor memo
+    ext_keys: Dict[Tuple[int, int], int] = {}
+
+    def ext(op_id: int, ts_id: int) -> TensorX:
+        key = (op_id, ts_id)
+        if key not in ext_keys:
+            ext_keys[key] = len(ext_keys)
+        return TensorX(-1, ext_keys[key])
+
+    src_types: List[OpType] = [
+        _JSON_OP_MAP.get(op["type"]) for op in rule.get("srcOp", [])
+    ]
+    if any(t is None for t in src_types):
+        return None
+
+    # tensor rank the rule was exported for (PM_NUMDIM; the TASO DNN
+    # collection is rank-3 throughout — rules that omit it default to 3).
+    # Needed to equate positive and negative dim encodings below.
+    nd_vals = [
+        p["value"]
+        for side in ("srcOp", "dstOp")
+        for op in rule.get(side, [])
+        for p in op.get("para", [])
+        if p["key"] == "PM_NUMDIM"
+    ]
+    numdim = nd_vals[0] if nd_vals else 3
+
+    # src parallel ops that carry a dim, as (src index, raw innermost-
+    # first dim): dst parallel ops mirroring the same declared dim reuse
+    # the MATCHED node's actual dim encoding at apply time — rank-correct
+    # for any graph, where the -(k+1) fallback assumes rank == numdim
+    src_par_dims: List[Tuple[int, int]] = [
+        (i, next(p["value"] for p in op.get("para", []) if p["key"] == "PM_PARALLEL_DIM"))
+        for i, op in enumerate(rule.get("srcOp", []))
+        if src_types[i] in (OpType.REPARTITION, OpType.COMBINE)
+        and any(p["key"] == "PM_PARALLEL_DIM" for p in op.get("para", []))
+    ]
+
+    def parse_ops(op_list, is_dst: bool, sig_ops: List) -> Optional[List[OpX]]:
         ops: List[OpX] = []
+        reused_src: set = set()
         for op in op_list:
             ot = _JSON_OP_MAP.get(op["type"])
             if ot is None:
                 return None
-            inputs = tuple(
-                TensorX(t["opId"], t["tsId"]) if t["opId"] >= 0 else TensorX(-1, t["tsId"])
-                for t in op.get("input", [])
-            )
             para = {p["key"]: p["value"] for p in op.get("para", [])}
-            dim = para.get("PM_PARALLEL_DIM", 0)
-            deg = para.get("PM_PARALLEL_DEGREE", 1)
+            arity = _RULE_NUM_INPUTS.get(ot, len(op.get("input", [])))
+            if ot == OpType.CONCAT:
+                arity = para.get("PM_NUM_INPUTS", len(op.get("input", [])))
+            raw_inputs = op.get("input", [])[:arity]
+            inputs = tuple(
+                TensorX(t["opId"], t["tsId"]) if t["opId"] >= 0 else ext(t["opId"], t["tsId"])
+                for t in raw_inputs
+            )
+            # reference ParallelTensor dims are innermost-first (dims[0]
+            # = feature); this PCG indexes outermost-first, so rule dim k
+            # maps to negative dim -(k+1) — uniform across tensor ranks
+            dim = -(para.get("PM_PARALLEL_DIM", 0) + 1)
+            acti = _TASO_ACTI.get(para["PM_ACTI"]) if "PM_ACTI" in para else None
+            if "PM_ACTI" in para and acti is None:
+                return None  # unknown activation encoding
             make = None
             if is_dst:
                 maker = _PARALLEL_PARAM_MAKERS.get(ot)
                 if maker is not None:
-                    make = (lambda mk, d_, g_: (lambda m: mk(d_, g_)))(maker, dim, deg)
+                    raw_k = para.get("PM_PARALLEL_DIM", 0)
+
+                    def make(m, _mk=maker, _neg=dim, _deg=degree, _k=raw_k):
+                        for i, k2 in src_par_dims:
+                            node_dim = getattr(m[i].params, "dim", None)
+                            if k2 == _k and node_dim is not None:
+                                return _mk(node_dim, _deg)
+                        return _mk(_neg, _deg)
+
+                    ops.append(OpX(ot, inputs, make_params=make))
+                    sig_ops.append((ot.name, "par", raw_k, degree, inputs))
+                    continue
+                elif ot == OpType.NOOP:
+                    # pass-through alias op (reference create_noop,
+                    # substitution.cc:1063) — needs no source counterpart
+                    ops.append(OpX(ot, inputs, make_params=lambda m: NoOpParams()))
+                    sig_ops.append((ot.name, "noop", inputs))
+                    continue
                 else:
-                    # dest compute op: reuse params from the first matched
-                    # src op of the same type
-                    make = (lambda ot_: (
-                        lambda m: next((n.params for n in m if n.op_type == ot_), None)
-                    ))(ot)
-            constraints = {}
-            if not is_dst and ot in _PARALLEL_PARAM_MAKERS:
-                if "PM_PARALLEL_DEGREE" in para:
-                    constraints["degree"] = deg
+                    # dest compute op inherits params (and guid/weights,
+                    # via reuse_src below) from the unique same-typed src
+                    # op; the reference's find_opx_with_type asserts this
+                    # uniqueness for its matchOpX reuse
+                    same = [i for i, t in enumerate(src_types) if t == ot]
+                    if len(same) != 1:
+                        return None
+                    idx = same[0]
+                    # only ONE dst op may reuse the matched node's guid —
+                    # a second same-typed dst (distributivity rules:
+                    # mul(add(a,b),c) -> add(mul,mul)) must be a FRESH
+                    # node or apply() silently merges the two into one
+                    # guid (duplicate in-edges per slot). Fresh copies of
+                    # WEIGHTED ops would re-initialize weights, so those
+                    # rules are skipped.
+                    reuse = idx if idx not in reused_src else None
+                    if reuse is None and ot not in _WEIGHTLESS_RULE_OPS:
+                        return None
+                    if reuse is not None:
+                        reused_src.add(idx)
+
+                    def make(m, _i=idx, _acti=acti):
+                        p = m[_i].params
+                        if _acti is not None and getattr(p, "activation", None) not in (None, _acti):
+                            p = dataclasses.replace(p, activation=_acti)
+                        return p
+
+                    ops.append(OpX(ot, inputs, make_params=make, reuse_src=reuse))
+                    sig_ops.append((ot.name, "compute", idx, reuse, str(acti), inputs))
+                    continue
+            constraints: Dict[str, Any] = {}
+            if not is_dst:
+                if ot in _PARALLEL_PARAM_MAKERS:
+                    if "PM_PARALLEL_DEGREE" in para:
+                        # exported rules always say 2; constrain to the
+                        # runtime degree this instantiation targets
+                        constraints["degree"] = degree
+                    if "PM_PARALLEL_DIM" in para and ot in (OpType.REPARTITION, OpType.COMBINE):
+                        # graph nodes use either encoding (builtin xfers
+                        # write dim=-1 for feature, dim=0 for batch):
+                        # accept both forms, equivalent at the rule's rank
+                        forms = {dim}
+                        if dim + numdim >= 0:
+                            forms.add(dim + numdim)
+                        constraints["dim"] = frozenset(forms)
+                elif acti is not None:
+                    constraints["activation"] = acti
+
+                def axis_forms(k: int) -> frozenset:
+                    # same innermost-first convention (and the same
+                    # positive/negative dual encoding) as PM_PARALLEL_DIM
+                    neg = -(k + 1)
+                    forms = {neg}
+                    if neg + numdim >= 0:
+                        forms.add(neg + numdim)
+                    return frozenset(forms)
+
+                if ot == OpType.CONCAT and "PM_AXIS" in para:
+                    constraints["axis"] = axis_forms(para["PM_AXIS"])
+                if ot == OpType.SOFTMAX and "PM_SOFTMAX_DIM" in para:
+                    constraints["axis"] = axis_forms(para["PM_SOFTMAX_DIM"])
             ops.append(OpX(ot, inputs, constraints=constraints, make_params=make))
+            sig_ops.append(
+                (
+                    ot.name,
+                    "src" if not is_dst else "dst",
+                    tuple(
+                        sorted(
+                            (k, tuple(sorted(v)) if isinstance(v, frozenset) else str(v))
+                            for k, v in constraints.items()
+                        )
+                    ),
+                    inputs,
+                )
+            )
         return ops
 
-    src = parse_ops(rule.get("srcOp", []), is_dst=False)
-    dst = parse_ops(rule.get("dstOp", []), is_dst=True)
+    sig_src: List = []
+    sig_dst: List = []
+    src = parse_ops(rule.get("srcOp", []), is_dst=False, sig_ops=sig_src)
+    dst = parse_ops(rule.get("dstOp", []), is_dst=True, sig_ops=sig_dst)
     if not src or not dst:
         return None
+    if len(src) == 1 and len(dst) == 1:
+        return None  # reference create_xfers skips 1->1 rules
     mapped = {}
     for mo in rule.get("mappedOutput", []):
         mapped[(mo["srcOpId"], mo["srcTsId"])] = (mo["dstOpId"], mo["dstTsId"])
-    return GraphXfer(rule.get("name", "json_rule"), src, dst, mapped)
+    signature = repr((degree, sig_src, sig_dst, sorted(mapped.items())))
+    return GraphXfer(rule.get("name", "json_rule"), src, dst, mapped, signature=signature)
 
 
 # ---------------------------------------------------------------------------

@@ -77,7 +77,47 @@ def main():
     wlosses = np.asarray(wmets["loss"])
     assert wlosses.shape == (w,), wlosses.shape
     assert np.all(np.isfinite(wlosses)) and wlosses[-1] < wlosses[0], wlosses
-    print(f"MULTIHOST_OK pid={pid} losses={losses} window={wlosses.tolist()}", flush=True)
+
+    # ---- cross-host PIPELINE hop (VERDICT r3 ask #9): pp=2 x tp=4 puts
+    # the "pipe" axis on the process (DCN) boundary — data is absent so
+    # _DCN_PREFERENCE picks pipe — and every GPipe tick's ppermute
+    # crosses hosts; tp rides the 4 intra-host devices.
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+    from flexflow_tpu.parallel.strategy import pipeline_strategy
+
+    tcfg = TransformerConfig(
+        num_layers=4, hidden_size=32, num_heads=4, ff_size=64, seq_length=8
+    )
+    pconfig = FFConfig(batch_size=8, num_nodes=nproc, workers_per_node=4)
+    pm = build_transformer(pconfig, tcfg)
+    pm.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.MEAN_SQUARED_ERROR,
+        strategy=pipeline_strategy(pm.graph, pp=2, dp=1, tp=4),
+    )
+    pmesh = dict(zip(pm.mesh.axis_names, pm.mesh.devices.shape))
+    assert pmesh == {"pipe": 2, "model": 4}, pmesh
+    # pipe must SPAN the two processes: each stage's devices live on one host
+    pipe_axis = list(pm.mesh.axis_names).index("pipe")
+    stage_procs = [
+        {d.process_index for d in np.moveaxis(pm.mesh.devices, pipe_axis, 0)[s].flat}
+        for s in range(2)
+    ]
+    assert stage_procs[0] != stage_procs[1], f"pipe does not cross hosts: {stage_procs}"
+    px = rs.randn(8, 8, 32).astype(np.float32)
+    py = rs.randn(8, 8, 32).astype(np.float32)
+    plosses = [
+        float(pm.executor.train_batch([px], py, jax.random.key(i))["loss"])
+        for i in range(3)
+    ]
+    assert all(np.isfinite(plosses)), plosses
+    assert plosses[-1] < plosses[0], plosses
+
+    print(
+        f"MULTIHOST_OK pid={pid} losses={losses} window={wlosses.tolist()} "
+        f"pipeline={plosses}",
+        flush=True,
+    )
 
 
 if __name__ == "__main__":

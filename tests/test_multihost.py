@@ -58,6 +58,9 @@ def test_two_process_dp_tp_trains():
     for rc, out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\nstdout:{out[-1500:]}\nstderr:{err[-1500:]}"
         assert "MULTIHOST_OK" in out, out[-500:]
+        # the worker's third phase proves a GPipe stage boundary that
+        # SPANS the two processes (ppermute over DCN): its losses train
+        assert "pipeline=" in out, out[-500:]
 
 
 def test_multihost_mesh_requires_divisible_axis():
