@@ -363,6 +363,35 @@ def child_main():
     print(json.dumps(result))
 
 
+def _emit_result(obj, ok: bool = True):
+    """Emit the final bench result durably (VERDICT r3 weak #4 / ask #7):
+    stdout carries EXACTLY one JSON line (diagnostics all go to stderr,
+    flushed first so a merged stream can't interleave after the JSON),
+    and the same object is written to BENCH_RESULT.json so a dead tunnel
+    or a driver parse quirk never erases a round's evidence. A FAILED run
+    (ok=False) writes BENCH_FAILED.json instead — overwriting the last
+    good result with a zero-value failure record would erase exactly the
+    evidence this helper exists to preserve."""
+    name = "BENCH_RESULT.json" if ok else "BENCH_FAILED.json"
+    try:
+        path = Path(__file__).parent / name
+        if ok and obj.get("extra", {}).get("backend") == "cpu" and path.exists():
+            try:
+                prev = json.loads(path.read_text())
+                if prev.get("extra", {}).get("backend") == "tpu":
+                    # a CPU fallback must not clobber real on-chip
+                    # evidence from an earlier run
+                    name = "BENCH_RESULT_CPU.json"
+                    path = Path(__file__).parent / name
+            except (json.JSONDecodeError, OSError):
+                pass
+        path.write_text(json.dumps(obj, indent=1) + "\n")
+    except OSError as e:
+        print(f"could not write {name}: {e!r}", file=sys.stderr)
+    sys.stderr.flush()
+    print(json.dumps(obj), flush=True)
+
+
 def _run_child(args, extra_env=None, timeout=None):
     env = dict(os.environ)
     env[_CHILD_ENV] = "1"
@@ -461,7 +490,7 @@ def main():
     if tpu_ok:
         obj, err = _run_child([me], tpu_env, timeout=2400)
         if obj is not None:
-            print(json.dumps(obj))
+            _emit_result(obj)
             return
         errors.append(f"bench: {err}")
     # TPU never came up (or bench died on it): CPU fallback on an
@@ -477,16 +506,16 @@ def main():
         if errors:
             obj.setdefault("extra", {})["fallback"] = "cpu_after_tpu_failure"
             obj["extra"]["tpu_errors"] = [e[-400:] for e in errors]
-        print(json.dumps(obj))
+        _emit_result(obj)
         return
     errors.append(f"cpu: {err}")
-    print(json.dumps({
+    _emit_result({
         "metric": "train_throughput_bench_failed",
         "value": 0.0,
         "unit": "samples/s",
         "vs_baseline": 0.0,
         "extra": {"error": (errors[-1] or "unknown")[-500:], "attempts": len(errors)},
-    }))
+    }, ok=False)
 
 
 if __name__ == "__main__":
