@@ -199,15 +199,20 @@ def child_main():
     # virtual-device scaling below or the two factors cancel
     cal_machine = MachineSpec(num_nodes=1, devices_per_node=n_dev, chip=chip)
     calibration = load_or_calibrate(cal_machine, allow_measure=True, device_kind=kind)
+    contention = None
     if backend == "cpu" and n_dev > 1:
         # N virtual CPU devices share ONE physical machine (thread pool):
         # per-device peak is 1/N of what the single-device calibration
-        # suite measures, times a measured contention factor (scheduling
-        # + cache thrash beyond the core split: with 1/N alone the r3
-        # fallback predicted 0.22x of the measured dp step)
+        # suite measures, times CPU_FITTED_CONTENTION — fitted jointly
+        # with the cpu preset's collective constants against quiet
+        # dp/tp/hybrid measurements and LABELED as fitted-to-host-class
+        # in the emitted JSON (it will not transfer exactly across very
+        # different core counts)
         import dataclasses as _dc
 
-        contention = 4.0
+        from flexflow_tpu.search.calibration import CPU_FITTED_CONTENTION
+
+        contention = CPU_FITTED_CONTENTION
         chip = _dc.replace(
             chip,
             bf16_flops=chip.bf16_flops / (n_dev * contention),
@@ -357,6 +362,9 @@ def child_main():
             "sim_best_strategy_agreement": best_agreement,
             "calibration_table": calibration_path,
             "calibration_kind": calibration.device_kind,
+            # CPU fallback only: the virtual-mesh compute scaling factor,
+            # fitted to the class of host the constants were tuned on
+            "cpu_contention_fitted_to_host_class": contention,
             **large,
         },
     }

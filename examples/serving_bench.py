@@ -61,6 +61,48 @@ def drive(submit, n_clients=8, requests_per_client=50, din=64):
     return n / wall, lat[n // 2] * 1e3, lat[int(n * 0.99)] * 1e3
 
 
+def grpc_drive(served, din, n_clients=8, requests_per_client=50):
+    """The same concurrent-clients drive through the KServe v2 gRPC
+    transport (VERDICT r3 ask #8): wire serialization + RPC + the
+    server-side DynamicBatcher. Returns None when grpcio is absent."""
+    try:
+        import grpc  # noqa: F401
+
+        from flexflow_tpu.serving import kserve_v2_pb2 as pb
+        from flexflow_tpu.serving.grpc_server import GrpcInferenceServer
+    except Exception as e:
+        print(f"grpc path unavailable: {e!r}")
+        return None
+
+    srv = GrpcInferenceServer(port=0, max_delay_s=0.002)
+    srv.register(served)
+    with srv:
+        channel = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        infer = channel.unary_unary(
+            "/inference.GRPCInferenceService/ModelInfer",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.ModelInferResponse.FromString,
+        )
+        in_name = served.inputs[0].name
+
+        def submit(x):
+            req = pb.ModelInferRequest(model_name=served.name)
+            t = req.inputs.add()
+            t.name = in_name
+            t.datatype = "FP32"
+            t.shape.extend(x.shape)
+            t.contents.fp32_contents.extend(x.reshape(-1).tolist())
+            resp = infer(req, timeout=60)
+            assert resp.outputs
+            return resp
+
+        submit(np.zeros((1, din), np.float32))  # warmup (compile)
+        thru, p50, p99 = drive(submit, n_clients=n_clients,
+                               requests_per_client=requests_per_client, din=din)
+        channel.close()
+    return {"reqs_per_s": round(thru, 1), "p50_ms": round(p50, 2), "p99_ms": round(p99, 2)}
+
+
 def main():
     din = 64
     served = InferenceModel(build_model(din=din), name="mlp", max_batch=64)
@@ -75,10 +117,12 @@ def main():
     finally:
         batcher.stop()
     u_thru, u_p50, u_p99 = drive(lambda x: served.infer([x]), din=din)
+    grpc_stats = grpc_drive(served, din)
     print(json.dumps({
         "batched": {"reqs_per_s": round(b_thru, 1), "p50_ms": round(b_p50, 2), "p99_ms": round(b_p99, 2)},
         "unbatched": {"reqs_per_s": round(u_thru, 1), "p50_ms": round(u_p50, 2), "p99_ms": round(u_p99, 2)},
         "batching_speedup": round(b_thru / u_thru, 2),
+        "grpc_batched": grpc_stats,
     }))
 
 

@@ -33,6 +33,13 @@ class TPUChipSpec:
     ici_latency: float = 1e-6  # seconds
     dcn_bandwidth: float = 25e9  # bytes/s per host
     dcn_latency: float = 10e-6
+    # fixed cost PER COLLECTIVE INVOCATION, independent of group size —
+    # negligible on real ICI (0 by default) but dominant on the virtual
+    # CPU mesh, where every collective is a cross-thread rendezvous: a
+    # strategy with many sequential subgroup collectives (hybrid dp x tp)
+    # pays this once per psum/allreduce where a per-hop-linear latency
+    # model predicts almost nothing
+    coll_overhead: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

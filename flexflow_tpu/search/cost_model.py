@@ -170,8 +170,17 @@ class CostModel:
         n: int,
         option: ParameterSyncOption = ParameterSyncOption.DEFAULT,
         intra_node: bool = True,
+        include_overhead: bool = True,
+        groups: int = 1,
     ) -> float:
         """Closed-form allreduce cost over n devices.
+
+        ``groups``: number of INDEPENDENT group instances of this
+        collective launched together (a dp x tp mesh psums over
+        n_dev/n groups of n at once). On real ICI they run concurrently
+        (no extra cost); the host-platform virtual mesh serializes them
+        through one rendezvous, so the per-invocation constant is paid
+        per group.
 
         Reference: the fork's AllreduceHelper expands ring / butterfly /
         double-binary-tree patterns into p2p sends and simulates them
@@ -180,26 +189,32 @@ class CostModel:
           ring:      2(n-1)/n * bytes/B          + 2(n-1) L
           butterfly: log2(n) * bytes/B           + log2(n) L  (recursive halving-doubling)
           DBT:       2 * bytes/B (pipelined)     + 2 log2(n) L
+
+        ``include_overhead=False`` drops the per-invocation rendezvous
+        constant: callers modeling FUSABLE collectives (per-weight
+        gradient syncs that XLA combines into one launch per replica
+        group) charge the constant once per group themselves.
         """
         if n <= 1 or nbytes <= 0:
             return 0.0
         B = self.link_bandwidth(intra_node)
         L = self.link_latency(intra_node)
+        C = self.chip.coll_overhead * max(1, groups) if include_overhead else 0.0
         if option == ParameterSyncOption.BUTTERFLY:
             k = math.log2(n) if n > 1 else 1.0
-            return k * L + math.ceil(k) * (nbytes / n) * 2 / B * (n / 2)
+            return C + k * L + math.ceil(k) * (nbytes / n) * 2 / B * (n / 2)
         if option == ParameterSyncOption.DOUBLE_BINARY_TREE:
             k = math.log2(n) if n > 1 else 1.0
-            return 2 * k * L + 2 * nbytes / B
+            return C + 2 * k * L + 2 * nbytes / B
         # DEFAULT and RING: bandwidth-optimal ring
-        return 2 * (n - 1) * L + 2 * (n - 1) / n * nbytes / B
+        return C + 2 * (n - 1) * L + 2 * (n - 1) / n * nbytes / B
 
     def all_gather_time(self, nbytes_total: float, n: int, intra_node: bool = True) -> float:
         if n <= 1:
             return 0.0
         B = self.link_bandwidth(intra_node)
         L = self.link_latency(intra_node)
-        return (n - 1) * L + (n - 1) / n * nbytes_total / B
+        return self.chip.coll_overhead + (n - 1) * L + (n - 1) / n * nbytes_total / B
 
     def reduce_scatter_time(self, nbytes_total: float, n: int, intra_node: bool = True) -> float:
         return self.all_gather_time(nbytes_total, n, intra_node)
@@ -211,7 +226,11 @@ class CostModel:
         L = self.link_latency(intra_node)
         # each device exchanges (n-1)/n of its shard; torus bisection ~n/4 links
         bisection = max(1, n // 4)
-        return (n - 1) * L / n + (nbytes_total * (n - 1) / n) / (B * bisection)
+        return (
+            self.chip.coll_overhead
+            + (n - 1) * L / n
+            + (nbytes_total * (n - 1) / n) / (B * bisection)
+        )
 
     # ------------------------------------------------- parallel-op xfers
     def xfer_time(

@@ -534,6 +534,12 @@ def predict_strategy_time(
     specs = infer_all_specs(graph)
     axis = {k: v for k, v in strategy.axis_sizes.items() if v > 1}
     total = 0.0
+    # gradient syncs are OFF the critical path and fusable: XLA combines
+    # the per-weight allreduces of one replica group into few launches,
+    # so the per-invocation rendezvous constant is charged once per
+    # distinct group (activation psums stay per-invocation — each sits
+    # between two dependent ops and cannot fuse away)
+    grad_sync_groups: set = set()
     for node in graph.topo_order():
         if node.op_type in (OpType.INPUT, OpType.WEIGHT, OpType.NOOP):
             continue
@@ -586,7 +592,13 @@ def predict_strategy_time(
             for a in out_axes - waxes:
                 replicas *= axis.get(a, 1)
             if replicas > 1:
-                total += cm.allreduce_time(w.spec.size_bytes / w_shard, replicas)
+                total += cm.allreduce_time(
+                    w.spec.size_bytes / w_shard, replicas, include_overhead=False
+                )
+                # key fused launches by the AXES forming the replica
+                # group: two equal-sized groups over different axes are
+                # distinct launches
+                grad_sync_groups.add(frozenset(out_axes - waxes))
             partial_axes |= waxes - out_axes
         # contraction over a sharded dim -> partial-sum allreduce of the
         # output, forward and backward; once per node per axis (a
@@ -594,7 +606,17 @@ def predict_strategy_time(
         for a in partial_axes:
             n = axis.get(a, 1)
             if n > 1 and out_bytes > 0:
-                total += 2.0 * cm.allreduce_time(out_bytes, n)
+                # a psum over one mesh axis runs n_total/n independent
+                # group instances; the virtual CPU mesh serializes their
+                # rendezvous (groups multiplier is a no-op when
+                # coll_overhead is 0, i.e. on real chips)
+                n_total = 1
+                for v in axis.values():
+                    n_total *= v
+                total += 2.0 * cm.allreduce_time(
+                    out_bytes, n, groups=max(1, n_total // n)
+                )
+    total += cm.chip.coll_overhead * len(grad_sync_groups)
     return total
 
 

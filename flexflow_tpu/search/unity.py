@@ -510,9 +510,12 @@ def _propose_pipeline(
             tp_coll = 0.0
             if tp > 1:
                 # Megatron: 2 activation allreduces per block per
-                # direction (after wo and ff2, and their transposes)
+                # direction (after wo and ff2, and their transposes);
+                # dp_eff independent group instances serialize on the
+                # virtual CPU mesh (groups multiplier, same convention
+                # as predict_strategy_time)
                 tp_coll = 4.0 * (R // pp) * cost_model.allreduce_time(
-                    boundary_bytes / max(1, mb_parts), tp
+                    boundary_bytes / max(1, mb_parts), tp, groups=max(1, dp_eff)
                 )
             outer_t = sum(op_time(n, max(1, dp_eff)) for n in outer_nodes)
             # only the provably-shardable weights divide by tp; the rest
@@ -644,9 +647,11 @@ def _propose_context_parallel(
                 total += 2.0 * (cp - 1) * cost_model.p2p_time(kv_bytes)
             if tp > 1:
                 # Megatron: 2 activation allreduces per block per
-                # direction over the tp groups (one block ~ one MHA node)
+                # direction over the tp groups (one block ~ one MHA
+                # node); dp*cp independent group instances serialize on
+                # the virtual CPU mesh (groups, as predict_strategy_time)
                 total += 4.0 * len(attn_nodes) * cost_model.allreduce_time(
-                    act_bytes / max(1, dp * cp), tp
+                    act_bytes / max(1, dp * cp), tp, groups=max(1, dp * cp)
                 )
                 # grad sync: sharded weights reduce over their dp*cp
                 # replica group; replicated ones over all devices
