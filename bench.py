@@ -52,6 +52,14 @@ _PEAK_BF16 = [
 ]
 
 
+def train_flops_per_token(n_params: int, num_layers: int, seq_length: int, hidden_size: int) -> float:
+    """6N (fwd+bwd matmul FLOPs per token) + attention score/value
+    matmuls 12*L*S*H — the PaLM-appendix-style accounting; 6N alone
+    undercounts the work. Shared with tools/tpu_evidence.py so the two
+    evidence surfaces can't drift."""
+    return 6.0 * n_params + 12.0 * num_layers * seq_length * hidden_size
+
+
 def peak_flops_per_device(device_kind: str, backend: str) -> float:
     kind = device_kind.lower()
     if backend == "cpu":
@@ -179,10 +187,9 @@ def child_main():
 
     model_dp = build(only_dp=True, budget=0)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(model_dp.executor.params))
-    # 6N (fwd+bwd matmul FLOPs per token) + attention score/value matmuls
-    # 12*L*S*H (2 matmuls x 2S*d_head*heads fwd, x3 for train) — the
-    # PaLM-appendix-style accounting; 6N alone undercounts the work
-    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * cfg.seq_length * cfg.hidden_size
+    flops_per_token = train_flops_per_token(
+        n_params, cfg.num_layers, cfg.seq_length, cfg.hidden_size
+    )
     step_dp = _bench_one(model_dp.executor, batch, cfg, iters)
     graph = model_dp.graph
     del model_dp
@@ -312,7 +319,7 @@ def child_main():
             )
             lstep = _bench_one(lmodel.executor, lbatch, lcfg, 12)
             ltok = lbatch * lcfg.seq_length / lstep
-            lf = 6.0 * lparams + 12.0 * lcfg.num_layers * lcfg.seq_length * lcfg.hidden_size
+            lf = train_flops_per_token(lparams, lcfg.num_layers, lcfg.seq_length, lcfg.hidden_size)
             large = {
                 "bert_large_step_ms": round(lstep * 1e3, 2),
                 "bert_large_mfu": round(ltok * lf / peak, 4),

@@ -32,9 +32,13 @@ def on_tpu() -> bool:
     """True on real TPU backends (incl. the tunneled 'axon' platform)."""
     return jax.default_backend() in ("tpu", "axon")
 
-# default sequence block sizes; 128 matches the MXU systolic dimension
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# default sequence block sizes; 128 matches the MXU systolic dimension.
+# Env-overridable (FF_FLASH_BLOCK_Q/K) so the on-chip evidence runner can
+# sweep block configurations across clean child processes.
+import os as _os
+
+DEFAULT_BLOCK_Q = int(_os.environ.get("FF_FLASH_BLOCK_Q", "128"))
+DEFAULT_BLOCK_K = int(_os.environ.get("FF_FLASH_BLOCK_K", "128"))
 
 
 def supports_shapes(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...]) -> bool:

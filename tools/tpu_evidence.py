@@ -1,0 +1,292 @@
+"""On-chip evidence runner: idle calibration first, then the MFU levers.
+
+VERDICT r3 asks #1 and #2 in one resilient script, built for a tunneled
+TPU that can die at any moment (the round-3 failure mode):
+
+  Phase A  probe the chip, run the calibration suite on the QUIET chip
+           (before anything else loads the machine), and persist the
+           factory table to flexflow_tpu/search/calibration_data/;
+  Phase B  measure the landed-but-unmeasured throughput levers, each in
+           its own CLEAN child process (fresh XLA, env-selected flash
+           block sizes): BERT-Base batch 16/32/64, BERT-Large 16/32,
+           searched-vs-dp on the best config, flash block_q/block_k
+           sweep;
+  Phase C  one bench.py run for the headline JSON + BENCH_RESULT.json.
+
+EVERY result is appended to BENCH_TPU_evidence_r4.json IMMEDIATELY so a
+dead tunnel never erases progress. Run it the moment the chip answers:
+
+    python tools/tpu_evidence.py [--skip-calibration] [--quick]
+
+Reference analogs: measured op costs feeding the search
+(src/runtime/simulator.cc:588-628), the OSDI'22 AE BERT configs
+(scripts/osdi22ae/bert.sh), and BASELINE.json's >=45% MFU north star.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+EVIDENCE = REPO / "BENCH_TPU_evidence_r4.json"
+_CHILD = "_FF_EVIDENCE_CHILD"
+
+
+def _load() -> dict:
+    if EVIDENCE.exists():
+        try:
+            return json.loads(EVIDENCE.read_text())
+        except json.JSONDecodeError:
+            pass
+    return {"what": "round-4 on-chip evidence (idle calibration + MFU levers)",
+            "runs": []}
+
+
+def _append(entry: dict):
+    # atomic replace: a kill mid-write must never corrupt the file and
+    # silently erase every previously recorded phase
+    data = _load()
+    data["runs"].append(entry)
+    tmp = EVIDENCE.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(data, indent=1) + "\n")
+    os.replace(tmp, EVIDENCE)
+    print(f"recorded: {json.dumps(entry)[:200]}", file=sys.stderr)
+
+
+def _graceful_run(cmd, env=None, timeout=600.0):
+    """subprocess.run with a SIGINT-first timeout: hard-killing a child
+    mid-TPU-operation is the documented trigger for wedging the tunnel
+    for hours, so give it a grace window to unwind before SIGKILL."""
+    import signal
+
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        return proc.returncode, out, err, False
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGINT)
+        try:
+            out, err = proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+        return proc.returncode, out or "", err or "", True
+
+
+def _run_child(payload: dict, timeout: float):
+    env = dict(os.environ)
+    env[_CHILD] = json.dumps(payload)
+    for k in ("FF_FLASH_BLOCK_Q", "FF_FLASH_BLOCK_K"):
+        if k in payload:
+            env[k] = str(payload[k])
+    rc, out, err, timed_out = _graceful_run(
+        [sys.executable, os.path.abspath(__file__)], env=env, timeout=timeout
+    )
+    sys.stderr.write(err[-2000:])
+    if timed_out:
+        return None, f"timeout {timeout:.0f}s"
+    for line in reversed(out.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict):
+                return obj, None
+        except json.JSONDecodeError:
+            continue
+    return None, f"rc={rc}: {(err or out)[-400:]}"
+
+
+# ---------------------------------------------------------------------------
+# child: one measured configuration, fresh process
+# ---------------------------------------------------------------------------
+
+
+def child_main(payload: dict):
+    import jax
+
+    sys.path.insert(0, str(REPO))
+    import numpy as np
+
+    from bench import _bench_one, peak_flops_per_device
+    from flexflow_tpu import DataType, FFConfig, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+
+    backend = jax.default_backend()
+    devs = jax.devices()
+    kind = getattr(devs[0], "device_kind", backend)
+    if payload.get("require_tpu", True) and backend == "cpu":
+        print(json.dumps({"error": "no TPU in child"}))
+        return
+    peak = peak_flops_per_device(kind, backend) * len(devs)
+
+    cfg = TransformerConfig(
+        num_layers=payload["layers"], hidden_size=payload["hidden"],
+        num_heads=payload["heads"], ff_size=payload["ff"],
+        seq_length=payload.get("seq", 128), dtype=DataType.BFLOAT16,
+    )
+    batch = payload["batch"]
+    config = FFConfig(
+        batch_size=batch, workers_per_node=len(devs), num_nodes=1,
+        only_data_parallel=not payload.get("searched", False),
+        search_budget=5 if payload.get("searched", False) else 0,
+    )
+    model = build_transformer(config, cfg)
+    model.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=LossType.MEAN_SQUARED_ERROR)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(model.executor.params))
+    step = _bench_one(model.executor, batch, cfg, payload.get("iters", 30))
+    toks = batch * cfg.seq_length / step
+    from bench import train_flops_per_token
+
+    fpt = train_flops_per_token(n_params, cfg.num_layers, cfg.seq_length, cfg.hidden_size)
+    # record the EFFECTIVE block sizes (the kernel clamps to seq length)
+    bq = min(int(os.environ.get("FF_FLASH_BLOCK_Q", "128")), cfg.seq_length)
+    bk = min(int(os.environ.get("FF_FLASH_BLOCK_K", "128")), cfg.seq_length)
+    print(json.dumps({
+        "backend": backend, "device_kind": kind, "batch": batch,
+        "seq": cfg.seq_length,
+        "step_ms": round(step * 1e3, 3),
+        "samples_per_s": round(batch / step, 1),
+        "mfu": round(toks * fpt / peak, 4),
+        "params": n_params,
+        "block_q_eff": bq,
+        "block_k_eff": bk,
+    }))
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestrate phases
+# ---------------------------------------------------------------------------
+
+BERT_BASE = {"layers": 12, "hidden": 768, "heads": 12, "ff": 3072}
+BERT_LARGE = {"layers": 24, "hidden": 1024, "heads": 16, "ff": 4096}
+
+
+def probe(timeout=150.0):
+    # bench.py's probe program (runs a real matmul so a backend that
+    # initializes but hangs at dispatch is caught here, not mid-run)
+    from bench import _PROBE
+
+    rc, out, err, timed_out = _graceful_run(
+        [sys.executable, "-c", _PROBE], env=dict(os.environ), timeout=timeout
+    )
+    if timed_out:
+        return None
+    for line in reversed(out.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+            # the tunneled chip may register under a bridge platform
+            # name (axon) while still being a real TPU
+            if isinstance(obj, dict) and obj.get("backend") in ("tpu", "axon"):
+                return obj
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def calibrate_idle(kind: str):
+    """Phase A: the quiet-chip recapture (VERDICT r3 ask #1)."""
+    code = f"""
+import json, sys
+sys.path.insert(0, {str(REPO)!r})
+from pathlib import Path
+from flexflow_tpu.search.calibration import _slug, calibrate, chip_spec_for
+from flexflow_tpu.parallel.machine import MachineSpec
+machine = MachineSpec(num_nodes=1, devices_per_node=1, chip=chip_spec_for({kind!r}))
+cal = calibrate(machine, device_kind={kind!r})
+path = Path({str(REPO)!r}) / "flexflow_tpu" / "search" / "calibration_data" / f"opcosts_{{_slug({kind!r})}}.json"
+cal.save(path)
+cal.save()  # user cache too
+print(json.dumps({{"entries": len(cal.entries), "derates": cal.derates, "path": str(path)}}))
+"""
+    rc, out, err, timed_out = _graceful_run(
+        [sys.executable, "-c", code], env=dict(os.environ), timeout=1800
+    )
+    sys.stderr.write(err[-2000:])
+    if timed_out:
+        return None, "calibration timeout"
+    for line in reversed(out.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict) and "entries" in obj:
+                return obj, None
+        except json.JSONDecodeError:
+            continue
+    return None, f"rc={rc}: {(err or '')[-400:]}"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-calibration", action="store_true")
+    ap.add_argument("--quick", action="store_true", help="fewest configs")
+    args = ap.parse_args()
+
+    info = probe()
+    if info is None:
+        print("TPU probe failed — tunnel down; nothing recorded", file=sys.stderr)
+        sys.exit(2)
+    print(f"TPU up: {info}", file=sys.stderr)
+
+    if not args.skip_calibration:
+        t0 = time.time()
+        cal, err = calibrate_idle(info["kind"])
+        if cal is not None:
+            _append({"phase": "calibration_idle", "seconds": round(time.time() - t0, 1),
+                     **{k: cal.get(k) for k in ("entries", "derates", "path")}})
+        else:
+            _append({"phase": "calibration_idle", "error": err})
+
+    # Phase B: lever sweep, cheapest-information-first so a dying tunnel
+    # still yields the batch-32 answer
+    configs = [
+        ("bert_base_b16_dp", {**BERT_BASE, "batch": 16}),
+        ("bert_base_b32_dp", {**BERT_BASE, "batch": 32}),
+        ("bert_base_b64_dp", {**BERT_BASE, "batch": 64}),
+        ("bert_large_b16_dp", {**BERT_LARGE, "batch": 16, "iters": 12}),
+        ("bert_large_b32_dp", {**BERT_LARGE, "batch": 32, "iters": 12}),
+        ("bert_base_b32_searched", {**BERT_BASE, "batch": 32, "searched": True}),
+    ]
+    if args.quick:
+        configs = configs[:2]
+
+    # flash block sweep needs seq >= block or the kernel clamps every
+    # config back to the 128x128 baseline: sweep at seq 512, batch 8
+    sweep = [] if args.quick else [
+        (f"seq512_bq{bq}_bk{bk}",
+         {**BERT_BASE, "batch": 8, "seq": 512, "iters": 12,
+          "FF_FLASH_BLOCK_Q": bq, "FF_FLASH_BLOCK_K": bk},
+         "flash_block_sweep")
+        for bq, bk in ((128, 128), (256, 256), (512, 512), (128, 256), (256, 128))
+    ]
+    for name, payload, phase in [(n, p, "lever") for n, p in configs] + sweep:
+        obj, err = _run_child(payload, timeout=1200)
+        _append({"phase": phase, "config": name, **(obj or {"error": err})})
+        if obj is None and "timeout" in (err or ""):
+            # a killed child may have wedged the tunnel (the documented
+            # hang mode): re-probe before burning more configs
+            if probe(timeout=120) is None:
+                _append({"phase": "abort", "reason": "tunnel unresponsive after child timeout"})
+                sys.exit(3)
+
+    # Phase C: headline bench (writes BENCH_RESULT.json durably)
+    rc, out, err, timed_out = _graceful_run(
+        [sys.executable, str(REPO / "bench.py")], env=dict(os.environ), timeout=3000
+    )
+    if timed_out:
+        _append({"phase": "bench_headline", "error": "timeout"})
+    else:
+        line = out.strip().splitlines()[-1] if out.strip() else ""
+        _append({"phase": "bench_headline", "stdout": line[:2000]})
+    print("evidence complete:", EVIDENCE, file=sys.stderr)
+
+
+if __name__ == "__main__":
+    if os.environ.get(_CHILD):
+        child_main(json.loads(os.environ[_CHILD]))
+    else:
+        main()
