@@ -34,11 +34,21 @@ def on_tpu() -> bool:
 
 # default sequence block sizes; 128 matches the MXU systolic dimension.
 # Env-overridable (FF_FLASH_BLOCK_Q/K) so the on-chip evidence runner can
-# sweep block configurations across clean child processes.
+# sweep block configurations across clean child processes. Read once at
+# import; malformed values fall back to the default rather than breaking
+# every import of the package.
 import os as _os
 
-DEFAULT_BLOCK_Q = int(_os.environ.get("FF_FLASH_BLOCK_Q", "128"))
-DEFAULT_BLOCK_K = int(_os.environ.get("FF_FLASH_BLOCK_K", "128"))
+
+def _env_block(name: str, default: int = 128) -> int:
+    try:
+        return int(_os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+DEFAULT_BLOCK_Q = _env_block("FF_FLASH_BLOCK_Q")
+DEFAULT_BLOCK_K = _env_block("FF_FLASH_BLOCK_K")
 
 
 def supports_shapes(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...]) -> bool:

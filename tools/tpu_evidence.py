@@ -144,9 +144,17 @@ def child_main(payload: dict):
     from bench import train_flops_per_token
 
     fpt = train_flops_per_token(n_params, cfg.num_layers, cfg.seq_length, cfg.hidden_size)
-    # record the EFFECTIVE block sizes (the kernel clamps to seq length)
-    bq = min(int(os.environ.get("FF_FLASH_BLOCK_Q", "128")), cfg.seq_length)
-    bk = min(int(os.environ.get("FF_FLASH_BLOCK_K", "128")), cfg.seq_length)
+    # record the EFFECTIVE block sizes (the kernel clamps to seq) and
+    # whether the flash kernel actually accepts the shape — a
+    # non-dividing block silently falls back to the dense path, which
+    # must not masquerade as a flash measurement
+    from flexflow_tpu.ops.kernels import flash_attention as _fa
+
+    bq = min(_fa.DEFAULT_BLOCK_Q, cfg.seq_length)
+    bk = min(_fa.DEFAULT_BLOCK_K, cfg.seq_length)
+    head_dim = cfg.hidden_size // cfg.num_heads
+    qshape = (batch, cfg.seq_length, cfg.num_heads, head_dim)
+    flash_active = bool(_fa.supports_shapes(qshape, qshape))
     print(json.dumps({
         "backend": backend, "device_kind": kind, "batch": batch,
         "seq": cfg.seq_length,
@@ -156,6 +164,7 @@ def child_main(payload: dict):
         "params": n_params,
         "block_q_eff": bq,
         "block_k_eff": bk,
+        "flash_kernel_active": flash_active,
     }))
 
 
@@ -198,10 +207,10 @@ from pathlib import Path
 from flexflow_tpu.search.calibration import _slug, calibrate, chip_spec_for
 from flexflow_tpu.parallel.machine import MachineSpec
 machine = MachineSpec(num_nodes=1, devices_per_node=1, chip=chip_spec_for({kind!r}))
-cal = calibrate(machine, device_kind={kind!r})
+cal = calibrate(machine, device_kind={kind!r}, save=False)
 path = Path({str(REPO)!r}) / "flexflow_tpu" / "search" / "calibration_data" / f"opcosts_{{_slug({kind!r})}}.json"
 cal.save(path)
-cal.save()  # user cache too
+cal.save()  # user cache copy (factory path above is the committed one)
 print(json.dumps({{"entries": len(cal.entries), "derates": cal.derates, "path": str(path)}}))
 """
     rc, out, err, timed_out = _graceful_run(
@@ -281,7 +290,10 @@ def main():
         _append({"phase": "bench_headline", "error": "timeout"})
     else:
         line = out.strip().splitlines()[-1] if out.strip() else ""
-        _append({"phase": "bench_headline", "stdout": line[:2000]})
+        entry = {"phase": "bench_headline", "rc": rc, "stdout": line[:2000]}
+        if rc != 0:
+            entry["error"] = (err or "")[-400:]
+        _append(entry)
     print("evidence complete:", EVIDENCE, file=sys.stderr)
 
 
