@@ -84,6 +84,7 @@ class MultiHeadAttentionOp(OpDef):
             qh = qh + weights["bq"]
             kh = kh + weights["bk"]
             vh = vh + weights["bv"]
+        cp_axis = getattr(ctx, "cp_axis", None)
         mesh = getattr(ctx, "mesh", None)
         seq_cp = (
             mesh is not None
@@ -95,7 +96,17 @@ class MultiHeadAttentionOp(OpDef):
             # in_specs reject it at trace time — fall back to dense
             and kh.shape[1] % mesh.shape["seq"] == 0
         )
-        if seq_cp:
+        if cp_axis is not None:
+            # manual context parallelism (inside a pipeline stage's
+            # shard_map): the sequence dim of q/k/v is sharded over
+            # cp_axis — K/V ride the ring (pp x cp composition); shares
+            # the projection/bias/dropout tail below
+            from .kernels.ring_attention import ring_attention
+
+            ctx_out = ring_attention(
+                qh, kh, vh, axis_name=cp_axis, causal=params.causal
+            )
+        elif seq_cp:
             # context parallelism: sequence dim sharded on the "seq" axis,
             # K/V ride the ICI ring (new capability; reference has none).
             # cp x tp: Megatron-sharded projections keep their heads on
@@ -128,7 +139,13 @@ class MultiHeadAttentionOp(OpDef):
             out = out + weights["bo"]
         if params.dropout > 0.0 and ctx.training:
             keep = 1.0 - params.dropout
-            mask = jax.random.bernoulli(ctx.node_rng(), keep, out.shape)
+            key = ctx.node_rng()
+            if cp_axis is not None:
+                # per-shard key: every seq shard must draw an INDEPENDENT
+                # mask (one shared key would repeat the pattern every
+                # S/cp positions)
+                key = jax.random.fold_in(key, jax.lax.axis_index(cp_axis))
+            mask = jax.random.bernoulli(key, keep, out.shape)
             out = jnp.where(mask, out / keep, 0.0).astype(out.dtype)
         return [out.astype(params.dtype.jnp)]
 

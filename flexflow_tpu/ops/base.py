@@ -73,6 +73,10 @@ class LowerCtx:
     # a sharded dim (row parallel -> psum over tp_axis).
     tp_axis: Optional[str] = None
     weight_specs: Optional[Dict] = None
+    # manual context parallelism (inside shard_map — pipeline stages with
+    # the sequence dim sharded on "seq"): attention lowers to ring
+    # attention over this axis instead of local dense attention
+    cp_axis: Optional[str] = None
 
     def node_rng(self) -> jax.Array:
         if self.rng is None:

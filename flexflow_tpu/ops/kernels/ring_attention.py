@@ -100,9 +100,15 @@ def ring_attention(
         vc = jax.lax.ppermute(vc, axis_name, perm)
         return (kc, vc, m, l, acc), None
 
-    m0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s_local), jnp.float32)
-    acc0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    # derive the scan inits from q (x*0 keeps the value exact for finite
+    # x) so they inherit q's varying manual axes — fresh zeros would be
+    # invarying and reject the scan carry under nested shard_map vma
+    # tracking (the pp x cp composition runs this inside the pipeline's
+    # shard_map)
+    zero_bhs = jnp.swapaxes(qf, 1, 2)[..., 0] * 0.0
+    m0 = zero_bhs + NEG_INF
+    l0 = zero_bhs
+    acc0 = qf * 0.0
     # n-1 rotating steps, then attend to the last-held chunk without the
     # final ppermute pair (whose result would be discarded)
     (kc, vc, m, l, acc), _ = jax.lax.scan(step, (k, v, m0, l0, acc0), jnp.arange(n - 1))

@@ -66,6 +66,8 @@ def gpipe(
     axis: str = PIPE_AXIS,
     with_aux: bool = False,
     param_specs: Any = None,
+    carry_specs: Any = None,
+    shared_specs: Any = None,
 ) -> Callable[[Any, jax.Array], jax.Array]:
     """Build a pipelined apply: (stacked_params, x[, shared]) -> y.
 
@@ -83,6 +85,12 @@ def gpipe(
     currently holding microbatch m sees m's shared context — but are
     never banked or psum-broadcast at the exit, and stage_fn receives
     them as a third argument: stage_fn(params, carry, shared) -> carry.
+
+    ``carry_specs``/``shared_specs``: optional per-leaf PartitionSpecs
+    for the MICROBATCHED layout [M, mb, ...] — pp x cp composition
+    shards the carry's sequence dim on "seq" (P(None, data, "seq",
+    None)) so each stage runs ring attention over its sequence shard.
+    Default: batch dim on "data", everything else replicated.
 
     with_aux=True: stage_fn returns (activation, aux_scalar) and the
     pipelined apply returns (y, aux) where aux sums each stage's scalar
@@ -119,6 +127,31 @@ def gpipe(
 
         xs, ss = to_mb(x), to_mb(shared)
 
+        # shard specs for the microbatched layout, needed both by the
+        # shard_map boundary and by the per-leaf variance setup inside
+        from .mesh import DATA_AXIS
+
+        data = DATA_AXIS if DATA_AXIS in mesh.axis_names and mesh.shape[DATA_AXIS] > 1 else None
+        mb_spec = lambda t: jax.tree.map(lambda _: PartitionSpec(None, data), t)
+        xs_spec = carry_specs if carry_specs is not None else mb_spec(xs)
+        ss_spec = shared_specs if shared_specs is not None else mb_spec(ss)
+
+        def _spec_axes(spec):
+            out = ()
+            for entry in spec:
+                for a in (entry if isinstance(entry, tuple) else (entry,)):
+                    if a and a != axis and a not in out:
+                        out = out + (a,)
+            return out
+
+        all_axes = ()
+        for _sp in jax.tree.leaves(
+            (xs_spec, ss_spec), is_leaf=lambda s: isinstance(s, PartitionSpec)
+        ):
+            for _a in _spec_axes(_sp):
+                if _a not in all_axes:
+                    all_axes = all_axes + (_a,)
+
         def per_device(params, xs_local, ss_local):
             # params: this stage's slice, leading axis of size 1
             params = jax.tree.map(lambda p: p[0], params)
@@ -130,26 +163,25 @@ def gpipe(
             # only the ROTATING streams get an output bank: shared
             # tensors are read-only context the caller already holds —
             # banking them would buy an [M, mb, ...] buffer + an
-            # all-stage psum per shared leaf for values we then discard
-            outs0 = jax.tree.map(jnp.zeros_like, xs_local)
+            # all-stage psum per shared leaf for values we then discard.
+            # FRESH zeros (not zeros_like) so the bank starts invarying
+            # and the pcast below can set its full variance explicitly.
+            outs0 = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), xs_local)
             aux0 = jnp.zeros((), jnp.float32)
             if hasattr(jax.lax, "pcast"):
-                # newer shard_map tracks varying manual axes: the carries
-                # must enter the scan with the variance they will have
-                # after a tick — {pipe} ∪ {data if batch-sharded}.
-                # outs0 = zeros_like(xs_local) already varies like the
-                # input (data); act0/shr0 are fresh zeros (invarying).
-                from .mesh import DATA_AXIS as _DA
-
-                data_v = (_DA,) if (_DA in mesh.axis_names and mesh.shape[_DA] > 1) else ()
-                vary = lambda t: jax.tree.map(
-                    lambda a: jax.lax.pcast(a, (axis,) + data_v, to="varying"), t
+                # newer shard_map tracks varying manual axes: each carry
+                # leaf must enter the scan with the variance it will have
+                # after a tick — {pipe} ∪ the axes ITS spec shards over
+                # (data for the batch dim; seq in pp x cp). The banked
+                # outs pick up the same per-leaf axes (they hold copies
+                # of the rotating values) plus pipe.
+                vary_leaf = lambda a, sp: jax.lax.pcast(
+                    a, (axis,) + _spec_axes(sp), to="varying"
                 )
-                act0, shr0 = vary(act0), vary(shr0)
-                outs0 = jax.tree.map(
-                    lambda a: jax.lax.pcast(a, (axis,), to="varying"), outs0
-                )
-                aux0 = jax.lax.pcast(aux0, (axis,) + data_v, to="varying")
+                act0 = jax.tree.map(vary_leaf, act0, xs_spec)
+                shr0 = jax.tree.map(vary_leaf, shr0, ss_spec)
+                outs0 = jax.tree.map(vary_leaf, outs0, xs_spec)
+                aux0 = jax.lax.pcast(aux0, (axis,) + all_axes, to="varying")
 
             def tick(carry, t):
                 act, shr, outs, aux_acc = carry
@@ -205,13 +237,13 @@ def gpipe(
             if not with_aux:
                 return y_out
             # sum stages (each stage = distinct blocks), average over
-            # microbatches; the data-axis mean matches how a non-pipelined
-            # GSPMD run reduces a sharded-batch aux loss
-            from .mesh import DATA_AXIS as _DA
-
+            # microbatches; the mean over every carry-sharded axis (data,
+            # and seq under pp x cp) matches how a non-pipelined GSPMD
+            # run reduces a sharded-batch aux loss — and leaves the
+            # scalar invariant, as the PartitionSpec() out_spec requires
             aux = jax.lax.psum(aux_acc, axis) / n_microbatches
-            if _DA in mesh.axis_names and mesh.shape[_DA] > 1:
-                aux = jax.lax.pmean(aux, _DA)
+            for a in all_axes:
+                aux = jax.lax.pmean(aux, a)
             return y_out, aux
 
         # param_specs carries tp-sharded stacked specs (dp x pp x tp);
@@ -221,13 +253,6 @@ def gpipe(
             if param_specs is not None
             else jax.tree.map(lambda _: PartitionSpec(axis), stacked_params)
         )
-        # combine with data parallelism when the mesh has a "data" axis:
-        # the microbatch dim rides it (dp x pp, reference-style hybrid)
-        from .mesh import DATA_AXIS
-
-        data = DATA_AXIS if DATA_AXIS in mesh.axis_names and mesh.shape[DATA_AXIS] > 1 else None
-        mb_spec = lambda t: jax.tree.map(lambda _: PartitionSpec(None, data), t)
-        xs_spec, ss_spec = mb_spec(xs), mb_spec(ss)
         out_specs = (xs_spec, PartitionSpec()) if with_aux else xs_spec
         result = shard_map(
             per_device,

@@ -416,14 +416,15 @@ def pipeline_strategy(
     pp: int,
     dp: int = 1,
     tp: int = 1,
+    cp: int = 1,
     n_microbatches: int = 0,
     batch_dim: int = 0,
 ) -> ParallelStrategy:
-    """dp x pp (x tp) hybrid: the graph's repeated block stack is split
-    into ``pp`` GPipe stages (stage costs balanced via balanced_stages
-    over the analytic cost model — the search half the reference's graph
-    splits performed, graph.cc:206-231), activations ride the "data"
-    axis, stage params ride "pipe".
+    """dp x pp (x tp) (x cp) hybrid: the graph's repeated block stack is
+    split into ``pp`` GPipe stages (stage costs balanced via
+    balanced_stages over the analytic cost model — the search half the
+    reference's graph splits performed, graph.cc:206-231), activations
+    ride the "data" axis, stage params ride "pipe".
 
     tp > 1 composes Megatron tensor parallelism INSIDE each stage (3-D
     parallelism, a capability the reference never had): block weights
@@ -431,6 +432,12 @@ def pipeline_strategy(
     the stage program reduces row-parallel partials with an explicit
     psum over "model" (ops consult LowerCtx.weight_sharded_dim — GSPMD
     cannot see inside the schedule's shard_map).
+
+    cp > 1 additionally shards the CARRY's sequence dim over "seq"
+    inside each stage (pp x cp, the long-context composition): every
+    stage runs ring attention over its sequence shard
+    (LowerCtx.cp_axis), halving per-device activation memory per doubling
+    of cp. Weights stay replicated over "seq".
 
     Requires the number of repeated blocks to be divisible by pp (stages
     must be isomorphic so the executor can stack their params [S, r, ...]
@@ -473,6 +480,8 @@ def pipeline_strategy(
     st.axis_sizes = {DATA_AXIS: dp, PIPE_AXIS: pp}
     if tp > 1:
         st.axis_sizes[MODEL_AXIS] = tp
+    if cp > 1:
+        st.axis_sizes[SEQ_AXIS] = cp
     st.pipeline = pipeline
     if dp <= 1:
         # build_mesh drops size-1 axes: no "data" axis exists, so no

@@ -53,6 +53,9 @@ class _PipelinePlan:
     rotating_in: List[Tuple[int, int]]  # [(guid, idx)]
     shared: List[Tuple[int, int]]  # [(guid, idx)], produced in pre
     out_streams: List[Tuple[int, int]]  # [(template_pos, out_idx)]
+    # global shapes of the carry entries (rotating then shared), for
+    # building pp x cp sequence-sharded carry specs
+    entry_shapes: List[Tuple[int, ...]] = dataclasses.field(default_factory=list)
 
 
 def _build_pipeline_plan(graph: PCGraph, strategy) -> Optional[_PipelinePlan]:
@@ -97,6 +100,7 @@ def _build_pipeline_plan(graph: PCGraph, strategy) -> Optional[_PipelinePlan]:
             f"pipeline carry entries disagree on the leading (batch) dim: {lead} "
             "— batch-less shared tensors cannot ride the microbatch schedule"
         )
+    entry_shapes = [tuple(specs[g][i].shape) for g, i in rotating_in + shared]
     return _PipelinePlan(
         pre=pre,
         repeats=repeats,
@@ -106,6 +110,7 @@ def _build_pipeline_plan(graph: PCGraph, strategy) -> Optional[_PipelinePlan]:
         rotating_in=rotating_in,
         shared=shared,
         out_streams=out_streams,
+        entry_shapes=entry_shapes,
     )
 
 
@@ -423,7 +428,7 @@ class CompiledExecutor:
         # manual tensor parallelism inside the stage program (dp x pp x tp):
         # GSPMD cannot see through shard_map, so ops get the strategy's
         # weight SpecTuples and psum row-parallel partials themselves
-        from ..parallel.mesh import MODEL_AXIS
+        from ..parallel.mesh import MODEL_AXIS, SEQ_AXIS
 
         tp_axis = (
             MODEL_AXIS
@@ -434,6 +439,18 @@ class CompiledExecutor:
             )
             else None
         )
+        # pp x cp: the carry's sequence dim shards over "seq" inside the
+        # stage shard_map; attention lowers to ring attention over it
+        cp_axis = (
+            SEQ_AXIS
+            if (
+                self.strategy is not None
+                and self.strategy.axis_sizes.get(SEQ_AXIS, 1) > 1
+                and SEQ_AXIS in self.mesh.axis_names
+            )
+            else None
+        )
+        cp_size = self.mesh.shape[SEQ_AXIS] if cp_axis else 1
         tpl_wspecs = {
             node.guid: (
                 self.strategy.node_shardings[node.guid].weights
@@ -466,6 +483,7 @@ class CompiledExecutor:
                     mesh=None,  # inside shard_map: manual, no GSPMD constraints
                     seq_length=self.seq_length,
                     tp_axis=tp_axis,
+                    cp_axis=cp_axis,
                 )
                 for node in template:
                     op_def = get_op_def(node.op_type)
@@ -488,13 +506,16 @@ class CompiledExecutor:
             aux0 = jnp.zeros((), jnp.float32)
             if hasattr(jax.lax, "pcast"):
                 # newer shard_map tracks varying manual axes: the aux
-                # accumulator picks up pipe (per-stage weights) and data
-                # (per-shard tokens) variance inside the scan
+                # accumulator picks up pipe (per-stage weights), data
+                # (per-shard tokens), and seq (per-sequence-shard
+                # partials under pp x cp) variance inside the scan
                 from ..parallel.mesh import DATA_AXIS, PIPE_AXIS
 
                 vaxes = (PIPE_AXIS,)
                 if DATA_AXIS in self.mesh.axis_names and self.mesh.shape[DATA_AXIS] > 1:
                     vaxes = vaxes + (DATA_AXIS,)
+                if cp_axis is not None:
+                    vaxes = vaxes + (cp_axis,)
                 aux0 = jax.lax.pcast(aux0, vaxes, to="varying")
             (act, aux_sum), _ = jax.lax.scan(
                 body, (act, aux0), (stage_params, jnp.arange(r))
@@ -506,12 +527,44 @@ class CompiledExecutor:
         # specs recorded at stacking time — the device_put sharding and
         # the shard_map in_specs are structurally the same objects
         param_specs = self._pipe_param_specs
+        carry_specs = shared_specs = None
+        if cp_axis is not None:
+            # microbatched layout [M, mb, S, ...]: shard the sequence dim
+            # (index 2) on "seq" for every rank>=3 entry whose S divides
+            from jax.sharding import PartitionSpec as _P
+
+            from ..parallel.mesh import DATA_AXIS as _DA
+
+            d_ax = _DA if (_DA in self.mesh.axis_names and self.mesh.shape[_DA] > 1) else None
+
+            def entry_spec(shape):
+                # only rank>=3 [B, S, ...] entries carry a sequence dim;
+                # a rank-2 [B, F] stream's dim 1 is FEATURES, never shard
+                # it over "seq"
+                if len(shape) >= 3 and shape[1] % cp_size == 0:
+                    return _P(None, d_ax, cp_axis, *([None] * (len(shape) - 2)))
+                return _P(None, d_ax, *([None] * max(0, len(shape) - 1)))
+
+            # ring attention treats every local array as a sequence
+            # shard: a rotating stream whose seq dim cannot shard would
+            # silently attend over wrong positions — reject instead
+            for s in plan.entry_shapes[: len(plan.rotating_in)]:
+                if len(s) >= 3 and s[1] % cp_size != 0:
+                    raise ValueError(
+                        f"pp x cp: rotating stream seq dim {s[1]} not divisible "
+                        f"by cp={cp_size}"
+                    )
+            n_rot = len(plan.rotating_in)
+            carry_specs = tuple(entry_spec(s) for s in plan.entry_shapes[:n_rot])
+            shared_specs = tuple(entry_spec(s) for s in plan.entry_shapes[n_rot:])
         pipelined = gpipe(
             stage_fn,
             n_microbatches=plan.n_microbatches,
             mesh=self.mesh,
             with_aux=with_aux,
             param_specs=param_specs,
+            carry_specs=carry_specs,
+            shared_specs=shared_specs,
         )
         if with_aux:
             y, pipe_aux = pipelined(params[_PIPE_KEY], x, x_shared)

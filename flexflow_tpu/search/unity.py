@@ -52,8 +52,11 @@ class SearchResult:
     # (pp, n_microbatches) when the search chose pipeline parallelism
     pipeline: Optional[Tuple[int, int]] = None
     # in-stage tensor parallelism of that pipeline (dp x pp x tp); the
-    # effective dp is num_devices // (pp * pipeline_tp)
+    # effective dp is num_devices // (pp * pipeline_tp * pipeline_cp)
     pipeline_tp: int = 1
+    # in-stage sequence/context parallelism (pp x cp): the carry's seq
+    # dim shards over "seq" and stages run ring attention
+    pipeline_cp: int = 1
     # (dp, cp) when the search chose sequence/context parallelism
     context_parallel: Optional[Tuple[int, int]] = None
     # Megatron tp composed with that cp (cp x tp; effective dp is
@@ -381,6 +384,7 @@ class _PipelineCandidate:
     n_microbatches: int
     memory_per_device: float = 0.0
     tp: int = 1  # tensor parallelism inside each stage (3-D dp x pp x tp)
+    cp: int = 1  # sequence/context parallelism inside each stage (pp x cp)
 
 
 def _propose_pipeline(
@@ -441,6 +445,16 @@ def _propose_pipeline(
 
     outer_nodes = [n for n in pre + post if _is_compute(n)]
     block_nodes = [n for n in repeats[0] if _is_compute(n)]
+    # sequence context for the pp x cp sweep: the block's attention nodes
+    # and the sequence length their inputs carry ([B, S, E] convention)
+    block_attn = [n for n in block_nodes if n.op_type == OpType.MULTIHEAD_ATTENTION]
+    block_seq = 0
+    if block_attn:
+        a_in = [specs_map[e.src][e.src_idx] for e in graph.in_edges(block_attn[0])]
+        if a_in and a_in[0].ndim == 3:
+            block_seq = a_in[0].shape[1]
+        else:
+            block_attn = []
     repeat_wbytes = _weight_bytes(
         specs_map, graph, [n for rep in repeats for n in rep if _is_compute(n)]
     )
@@ -494,48 +508,67 @@ def _propose_pipeline(
             if num_devices % (pp * tp) != 0 or (tp > 1 and not tp_divides(tp)):
                 tp *= 2
                 continue
-            dp_eff = num_devices // (pp * tp)
-            if batch % max(1, dp_eff) != 0:
-                tp *= 2
-                continue
-            M = default_microbatches(batch, pp, dp_eff)
-            mb_parts = dp_eff * M  # microbatch shard = batch / (M * dp)
-            block_t = sum(
-                op_time(n, mb_parts * (tp if n.guid in tp_nodes else 1))
-                for n in block_nodes
-            )
-            stage_t = block_t * (R // pp)
-            ticks = M + pp - 1
-            p2p = cost_model.p2p_time(boundary_bytes / max(1, mb_parts))
-            tp_coll = 0.0
-            if tp > 1:
-                # Megatron: 2 activation allreduces per block per
-                # direction (after wo and ff2, and their transposes);
-                # dp_eff independent group instances serialize on the
-                # virtual CPU mesh (groups multiplier, same convention
-                # as predict_strategy_time)
-                tp_coll = 4.0 * (R // pp) * cost_model.allreduce_time(
-                    boundary_bytes / max(1, mb_parts), tp, groups=max(1, dp_eff)
+            # cp: sequence sharding INSIDE each stage (pp x cp) — viable
+            # when the block has attention and the block seq divides
+            cp = 1
+            while pp * tp * cp <= num_devices:
+                if num_devices % (pp * tp * cp) != 0 or (
+                    cp > 1 and (not block_attn or block_seq % cp != 0)
+                ):
+                    cp *= 2
+                    continue
+                dp_eff = num_devices // (pp * tp * cp)
+                if batch % max(1, dp_eff) != 0:
+                    cp *= 2
+                    continue
+                M = default_microbatches(batch, pp, dp_eff)
+                mb_parts = dp_eff * M  # microbatch shard = batch / (M * dp)
+                act_parts = mb_parts * cp  # activations also divide by cp
+                block_t = sum(
+                    op_time(n, act_parts * (tp if n.guid in tp_nodes else 1))
+                    for n in block_nodes
                 )
-            outer_t = sum(op_time(n, max(1, dp_eff)) for n in outer_nodes)
-            # only the provably-shardable weights divide by tp; the rest
-            # replicate across the model axis at full size
-            per_dev_w = sharded_total / (pp * tp) + repl_total / pp
-            sync_t = cost_model.allreduce_time(per_dev_w, dp_eff)
-            sync_t += cost_model.allreduce_time(outer_wbytes, num_devices)
-            total = ticks * (stage_t + tp_coll + p2p) + outer_t + sync_t
-            # per-device memory: stage weights (4x for param+grad+2
-            # moments) plus live GPipe activations (every in-flight
-            # microbatch keeps its boundary activation per block)
-            mem = 4.0 * (per_dev_w + outer_wbytes)
-            mem += boundary_bytes * (R // pp) / max(1, dp_eff)
-            cand = _PipelineCandidate(total, pp, M, mem, tp)
-            if best is None or total < best.cost:
-                best = cand
-            if capacity is not None and mem <= capacity and (
-                best_fit is None or total < best_fit.cost
-            ):
-                best_fit = cand
+                stage_t = block_t * (R // pp)
+                ticks = M + pp - 1
+                p2p = cost_model.p2p_time(boundary_bytes / max(1, act_parts))
+                coll = 0.0
+                if tp > 1:
+                    # Megatron: 2 activation allreduces per block per
+                    # direction (after wo and ff2, and their transposes);
+                    # dp_eff*cp independent group instances serialize on
+                    # the virtual CPU mesh (groups multiplier, same
+                    # convention as predict_strategy_time)
+                    coll += 4.0 * (R // pp) * cost_model.allreduce_time(
+                        boundary_bytes / max(1, act_parts), tp,
+                        groups=max(1, dp_eff * cp),
+                    )
+                if cp > 1:
+                    # ring attention: K and V rotate cp-1 hops per block
+                    # per direction
+                    coll += 4.0 * (R // pp) * len(block_attn) * (cp - 1) * (
+                        cost_model.p2p_time(2.0 * boundary_bytes / max(1, act_parts))
+                    )
+                outer_t = sum(op_time(n, max(1, dp_eff)) for n in outer_nodes)
+                # only the provably-shardable weights divide by tp; the
+                # rest replicate across the model axis at full size
+                per_dev_w = sharded_total / (pp * tp) + repl_total / pp
+                sync_t = cost_model.allreduce_time(per_dev_w, dp_eff * cp)
+                sync_t += cost_model.allreduce_time(outer_wbytes, num_devices)
+                total = ticks * (stage_t + coll + p2p) + outer_t + sync_t
+                # per-device memory: stage weights (4x for param+grad+2
+                # moments) plus live GPipe activations (every in-flight
+                # microbatch keeps its boundary activation per block;
+                # sequence sharding divides them by cp)
+                mem = 4.0 * (per_dev_w + outer_wbytes)
+                mem += boundary_bytes * (R // pp) / max(1, dp_eff * cp)
+                cand = _PipelineCandidate(total, pp, M, mem, tp, cp)
+                if best is None or total < best.cost:
+                    best = cand
+                if capacity is not None and mem <= capacity and (
+                    best_fit is None or total < best_fit.cost
+                ):
+                    best_fit = cand
+                cp *= 2
             tp *= 2
         pp *= 2
     # under a known HBM capacity prefer the cheapest candidate that FITS
@@ -960,8 +993,9 @@ def unity_optimize(
                     strategy = pipeline_strategy(
                         graph,
                         pp=pipe.pp,
-                        dp=num_devices // (pipe.pp * pipe.tp),
+                        dp=num_devices // (pipe.pp * pipe.tp * pipe.cp),
                         tp=pipe.tp,
+                        cp=pipe.cp,
                         n_microbatches=pipe.n_microbatches,
                     )
                 except ValueError:
@@ -985,6 +1019,7 @@ def unity_optimize(
                     strategy, graph, pp_views, pipe.cost, pipe.memory_per_device,
                     pipeline=(pipe.pp, pipe.n_microbatches),
                     pipeline_tp=pipe.tp,
+                    pipeline_cp=pipe.cp,
                 )
 
     strategy = strategy_from_pcg(best_graph, result_dp.views, num_devices)
