@@ -404,7 +404,21 @@ def _emit_result(obj, ok: bool = True):
     except OSError as e:
         print(f"could not write {name}: {e!r}", file=sys.stderr)
     sys.stderr.flush()
-    print(json.dumps(obj), flush=True)
+    # stdout must stay small enough for the driver's tail window (r4's
+    # BENCH_r04.json came back parsed:null because six ~400-char
+    # tpu_errors entries overflowed it). Full detail lives in the durable
+    # file written above; stdout gets a count + one capped error.
+    out = obj
+    errs = obj.get("extra", {}).get("tpu_errors")
+    if errs:
+        out = dict(obj)
+        out["extra"] = {
+            k: v for k, v in obj["extra"].items() if k != "tpu_errors"
+        }
+        out["extra"]["tpu_probe_failures"] = len(errs)
+        out["extra"]["last_error"] = str(errs[-1])[-200:]
+        out["extra"]["error_detail_in"] = name
+    print(json.dumps(out), flush=True)
 
 
 def _run_child(args, extra_env=None, timeout=None):

@@ -96,7 +96,21 @@ class MultiHeadAttentionOp(OpDef):
             # in_specs reject it at trace time — fall back to dense
             and kh.shape[1] % mesh.shape["seq"] == 0
         )
-        if cp_axis is not None:
+        if cp_axis is not None and getattr(ctx, "kv_seq_replicated", False):
+            # pp x cp cross-attention whose shared K/V seq dim couldn't
+            # shard: K/V are FULL-LENGTH on every cp shard, so dense
+            # attention over the local complete memory gives the exact
+            # result — a ring over cp identical copies computes the same
+            # softmax at cp x the FLOPs plus cp-1 full-size ppermutes
+            # (ADVICE r4)
+            if params.causal:
+                raise ValueError(
+                    "pp x cp: causal attention over cp-replicated K/V has "
+                    "no well-defined local mask; use a seq length divisible "
+                    "by cp or drop cp"
+                )
+            ctx_out = attention_core(qh, kh, vh, causal=False, backend=ctx.backend)
+        elif cp_axis is not None:
             # manual context parallelism (inside a pipeline stage's
             # shard_map): the sequence dim of q/k/v is sharded over
             # cp_axis — K/V ride the ring (pp x cp composition); shares
@@ -139,12 +153,10 @@ class MultiHeadAttentionOp(OpDef):
             out = out + weights["bo"]
         if params.dropout > 0.0 and ctx.training:
             keep = 1.0 - params.dropout
-            key = ctx.node_rng()
-            if cp_axis is not None:
-                # per-shard key: every seq shard must draw an INDEPENDENT
-                # mask (one shared key would repeat the pattern every
-                # S/cp positions)
-                key = jax.random.fold_in(key, jax.lax.axis_index(cp_axis))
+            # per-shard key: every manual shard (seq and/or data) must
+            # draw an INDEPENDENT mask — one shared key would repeat the
+            # pattern every S/cp positions and across batch shards
+            key = ctx.shard_rng()
             mask = jax.random.bernoulli(key, keep, out.shape)
             out = jnp.where(mask, out / keep, 0.0).astype(out.dtype)
         return [out.astype(params.dtype.jnp)]

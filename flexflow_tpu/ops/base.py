@@ -77,11 +77,32 @@ class LowerCtx:
     # the sequence dim sharded on "seq"): attention lowers to ring
     # attention over this axis instead of local dense attention
     cp_axis: Optional[str] = None
+    # manual data parallelism axis inside shard_map (pipeline stages with
+    # the batch dim sharded on "data"): stochastic ops fold the shard
+    # index into their key via shard_rng()
+    dp_axis: Optional[str] = None
+    # pp x cp: True when the current node's K/V input (input 1) is
+    # FULL-LENGTH on every cp shard (a shared cross-attention memory
+    # whose seq dim didn't divide cp) — attention must go dense on the
+    # local complete K/V, not ring over cp identical copies
+    kv_seq_replicated: bool = False
 
     def node_rng(self) -> jax.Array:
         if self.rng is None:
             raise ValueError("op requires an RNG but none was provided")
         return jax.random.fold_in(self.rng, self.node_guid)
+
+    def shard_rng(self) -> jax.Array:
+        """node_rng decorrelated per shard: inside a manual shard_map
+        every shard traces the same key, so a stochastic op sampling at
+        its LOCAL shape would repeat the identical pattern on every
+        shard (every S/cp positions under cp; across batch shards under
+        dp). Fold in the index along each manual axis that is set."""
+        key = self.node_rng()
+        for ax in (self.dp_axis, self.cp_axis):
+            if ax is not None:
+                key = jax.random.fold_in(key, jax.lax.axis_index(ax))
+        return key
 
     def weight_sharded_dim(self, wname: str) -> Optional[int]:
         """Index of the dim of weight ``wname`` sharded on tp_axis, or

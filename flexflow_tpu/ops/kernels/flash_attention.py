@@ -42,9 +42,10 @@ import os as _os
 
 def _env_block(name: str, default: int = 128) -> int:
     try:
-        return int(_os.environ.get(name, default))
+        v = int(_os.environ.get(name, default))
     except (TypeError, ValueError):
         return default
+    return v if v > 0 else default
 
 
 DEFAULT_BLOCK_Q = _env_block("FF_FLASH_BLOCK_Q")

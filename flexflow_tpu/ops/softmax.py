@@ -61,7 +61,7 @@ class DropoutOp(OpDef):
         (x,) = inputs
         if not ctx.training or params.rate <= 0.0:
             return [x]
-        key = ctx.node_rng()
+        key = ctx.shard_rng()
         keep = 1.0 - params.rate
         mask = jax.random.bernoulli(key, keep, x.shape)
         return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)]

@@ -111,27 +111,25 @@ def enumerate_machine_views(machine: MachineSpec, max_dims: int = 2) -> List[Mac
 
     On a TPU slice, useful views are contiguous runs along torus axes —
     XLA collectives are fastest over physically-adjacent chips — so we
-    enumerate power-of-two sized runs and 2-D tiles, not arbitrary
-    stride patterns.
+    enumerate runs at every divisor size of the machine (the reference
+    instantiates per-divisor degrees, substitution.cc:1726-1840; a
+    6-device machine must offer size-3 and size-6 views, not just
+    powers of two) at aligned offsets, plus 2-D tiles.
     """
     n = machine.num_devices
     views: List[MachineView] = []
-    # 1-D views: every power-of-two size, every aligned offset
-    size = 1
-    while size <= n:
+    # 1-D views: every divisor size, every aligned offset
+    for size in _divisors(n):
         for start in range(0, n - size + 1, size):
             views.append(MachineView(start, (size,), (1,)))
-        size *= 2
     if max_dims >= 2:
-        size = 2
-        while size <= n:
+        for size in _divisors(n):
             for d0 in _divisors(size):
                 d1 = size // d0
                 if d0 < 2 or d1 < 2:
                     continue
                 for start in range(0, n - size + 1, size):
                     views.append(MachineView(start, (d0, d1), (d1, 1)))
-            size *= 2
     return views
 
 

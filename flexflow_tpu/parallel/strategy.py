@@ -158,6 +158,10 @@ class OpSharding:
     outputs: List[Optional[SpecTuple]] = dataclasses.field(default_factory=list)
     weights: Dict[str, Optional[SpecTuple]] = dataclasses.field(default_factory=dict)
     machine_view_hash: int = 0  # provenance from the search, for export
+    # structural view (start_device_id, dims, strides) — the reference
+    # serializes full per-op placement, not just a hash
+    # (src/runtime/graph.cc:2162+); round-trips through to_json/from_json
+    machine_view: Optional[Tuple[int, Tuple[int, ...], Tuple[int, ...]]] = None
 
 
 @dataclasses.dataclass
@@ -215,6 +219,11 @@ class ParallelStrategy:
                         "outputs": [list(map(list, o)) if o is not None else None for o in s.outputs],
                         "weights": {k: (list(map(list, v)) if v is not None else None) for k, v in s.weights.items()},
                         "machine_view_hash": s.machine_view_hash,
+                        "machine_view": (
+                            [s.machine_view[0], list(s.machine_view[1]), list(s.machine_view[2])]
+                            if s.machine_view is not None
+                            else None
+                        ),
                     }
                     for g, s in self.node_shardings.items()
                 },
@@ -241,6 +250,11 @@ class ParallelStrategy:
                     for k, v in s["weights"].items()
                 },
                 machine_view_hash=s.get("machine_view_hash", 0),
+                machine_view=(
+                    (s["machine_view"][0], tuple(s["machine_view"][1]), tuple(s["machine_view"][2]))
+                    if s.get("machine_view") is not None
+                    else None
+                ),
             )
         return st
 

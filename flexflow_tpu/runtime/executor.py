@@ -451,6 +451,13 @@ class CompiledExecutor:
             else None
         )
         cp_size = self.mesh.shape[SEQ_AXIS] if cp_axis else 1
+        from ..parallel.mesh import DATA_AXIS as _DATA_AXIS
+
+        dp_axis = (
+            _DATA_AXIS
+            if _DATA_AXIS in self.mesh.axis_names and self.mesh.shape[_DATA_AXIS] > 1
+            else None
+        )
         tpl_wspecs = {
             node.guid: (
                 self.strategy.node_shardings[node.guid].weights
@@ -476,6 +483,20 @@ class CompiledExecutor:
                 # their repeat-0 entry keys, shared values by their own
                 local = {k: act_in[i] for i, k in enumerate(plan.rotating_in)}
                 local.update({k: shr[i] for i, k in enumerate(plan.shared)})
+                # pp x cp: static bookkeeping of which values carry a
+                # cp-REPLICATED (full-length) seq dim — shared entries
+                # whose seq didn't divide cp stay unsharded (entry_spec
+                # below), and cross-attention over them must lower dense,
+                # not ring (ADVICE r4). Propagated like the values: an
+                # op's outputs follow its first input (attention output
+                # follows q; elementwise follows its operand).
+                repl = {}
+                if cp_axis is not None:
+                    repl = {k: False for k in plan.rotating_in}
+                    n_rot_ = len(plan.rotating_in)
+                    for i, k in enumerate(plan.shared):
+                        shp = plan.entry_shapes[n_rot_ + i]
+                        repl[k] = len(shp) >= 3 and shp[1] % cp_size != 0
                 ctx = LowerCtx(
                     training=training,
                     rng=jax.random.fold_in(rng, stage_idx * r + ridx),
@@ -484,15 +505,21 @@ class CompiledExecutor:
                     seq_length=self.seq_length,
                     tp_axis=tp_axis,
                     cp_axis=cp_axis,
+                    dp_axis=dp_axis,
                 )
                 for node in template:
                     op_def = get_op_def(node.op_type)
-                    ins = [local[(e.src, e.src_idx)] for e in self.graph.in_edges(node)]
+                    in_keys = [(e.src, e.src_idx) for e in self.graph.in_edges(node)]
+                    ins = [local[k] for k in in_keys]
                     ctx.node_guid = node.guid
                     ctx.weight_specs = tpl_wspecs[node.guid]
+                    ins_repl = [repl.get(k, False) for k in in_keys]
+                    ctx.kv_seq_replicated = len(ins_repl) >= 2 and bool(ins_repl[1])
                     outs = op_def.lower(node.params, ins, rep_params.get(_node_key(node), {}), ctx)
+                    out_repl = bool(ins_repl[0]) if ins_repl else False
                     for i, o in enumerate(outs):
                         local[(node.guid, i)] = o
+                        repl[(node.guid, i)] = out_repl
                 aux_out = aux_in
                 for a in ctx.aux_losses:
                     aux_out = aux_out + a.astype(jnp.float32)

@@ -152,3 +152,17 @@ def test_search_proposes_context_parallelism_for_long_sequences():
     m2 = build_transformer(config, cfg2)
     _, sr2 = unity_optimize(m2.graph, config)
     assert sr2.context_parallel is None
+
+
+def test_flash_env_block_rejects_nonpositive(monkeypatch):
+    """ADVICE r4: FF_FLASH_BLOCK_Q=0 (or negative) must fall back to the
+    default rather than arming a ZeroDivisionError in supports_shapes."""
+    from flexflow_tpu.ops.kernels.flash_attention import _env_block
+
+    for bad in ("0", "-64", "nonsense", ""):
+        monkeypatch.setenv("FF_TEST_BLOCK", bad)
+        assert _env_block("FF_TEST_BLOCK") == 128, bad
+    monkeypatch.setenv("FF_TEST_BLOCK", "256")
+    assert _env_block("FF_TEST_BLOCK") == 256
+    monkeypatch.delenv("FF_TEST_BLOCK")
+    assert _env_block("FF_TEST_BLOCK") == 128

@@ -550,3 +550,98 @@ def test_mcmc_propagate_mode_consistent_and_cheaper_proposals():
     inc2 = dc2.apply([attn_guid], v2)
     fresh2 = _DeltaCost(m2.graph, helper, build_cost_specs(m2.graph)).rebuild(v2)
     assert inc2 == pytest.approx(fresh2, rel=1e-9)
+
+
+# ------------------------------------------------ non-power-of-two degrees
+def test_six_device_search_adopts_cp3_tp2():
+    """VERDICT r4 ask #7: divisor-degree sweeps (reference instantiates
+    xfers per divisor, substitution.cc:1726-1840). On a 6-device machine
+    under weight memory pressure with tp=3 indivisible (hidden 512), the
+    only feasible composition is cp=3 x tp=2 — a strategy a
+    power-of-two-only sweep can never propose — and it trains green on a
+    real 6-device mesh."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+    from flexflow_tpu.parallel.machine import MachineSpec, TPUChipSpec
+    from flexflow_tpu.search.unity import unity_optimize
+
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=512, num_heads=4, ff_size=2048, seq_length=384
+    )
+    config = FFConfig(batch_size=2, workers_per_node=6, search_budget=2)
+    model = build_transformer(config, cfg)
+    chip = dataclasses.replace(TPUChipSpec(), hbm_capacity=80e6)
+    machine = MachineSpec(num_nodes=1, devices_per_node=6, chip=chip)
+    strategy, sr = unity_optimize(model.graph, config, machine=machine)
+    assert sr.context_parallel is not None, (sr.pipeline, sr.context_parallel)
+    dp, cp = sr.context_parallel
+    assert cp == 3 and sr.context_parallel_tp == 2, (dp, cp, sr.context_parallel_tp)
+
+    model.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR,
+        strategy=strategy,
+    )
+    assert dict(zip(model.mesh.axis_names, model.mesh.devices.shape)) == {
+        "seq": 3, "model": 2,
+    }
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 384, 512), jnp.float32)
+    y = jnp.asarray(rs.randn(2, 384, 512), jnp.float32)
+    losses = [
+        float(model.executor.train_batch([x], y, jax.random.key(i))["loss"])
+        for i in range(3)
+    ]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+def test_six_device_pipeline_pp3_trains():
+    """Divisor pipeline degrees: pp=3 x dp=2 on a 6-device mesh (6-layer
+    stack) — the proposer offers pp=3 and the strategy trains green."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+    from flexflow_tpu.parallel.machine import MachineSpec, TPUChipSpec
+    from flexflow_tpu.parallel.strategy import pipeline_strategy
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.unity import _propose_pipeline
+
+    cfg = TransformerConfig(
+        num_layers=6, hidden_size=32, num_heads=2, ff_size=64, seq_length=8
+    )
+    m = build_transformer(FFConfig(batch_size=6, workers_per_node=6), cfg)
+    cm = CostModel(MachineSpec(1, 6, chip=TPUChipSpec()))
+    # the proposer's divisor sweep reaches pp=3 on 6 devices (a doubling
+    # sweep would only ever offer pp=2): tightening capacity below the
+    # pp=2 footprint forces a deeper stage split
+    cand = _propose_pipeline(m.graph, 6, cm, batch=6, capacity=None)
+    assert cand is not None
+    tight = _propose_pipeline(
+        m.graph, 6, cm, batch=6, capacity=cand.memory_per_device * 0.9
+    )
+    assert tight is not None and tight.pp in (3, 6), tight
+
+    st = pipeline_strategy(m.graph, pp=3, dp=2)
+    m.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.MEAN_SQUARED_ERROR,
+        strategy=st,
+    )
+    assert dict(zip(m.mesh.axis_names, m.mesh.devices.shape)) == {
+        "data": 2, "pipe": 3,
+    }
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(6, 8, 32), jnp.float32)
+    y = jnp.asarray(rs.randn(6, 8, 32), jnp.float32)
+    losses = [
+        float(m.executor.train_batch([x], y, jax.random.key(i))["loss"])
+        for i in range(3)
+    ]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
