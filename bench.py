@@ -401,16 +401,20 @@ def _emit_result(obj, ok: bool = True):
             except (json.JSONDecodeError, OSError):
                 pass
         path.write_text(json.dumps(obj, indent=1) + "\n")
+        wrote_durable = True
     except OSError as e:
+        wrote_durable = False
         print(f"could not write {name}: {e!r}", file=sys.stderr)
     sys.stderr.flush()
     # stdout must stay small enough for the driver's tail window (r4's
     # BENCH_r04.json came back parsed:null because six ~400-char
     # tpu_errors entries overflowed it). Full detail lives in the durable
-    # file written above; stdout gets a count + one capped error.
+    # file written above; stdout gets a count + one capped error — but
+    # only when that file actually landed, else stdout keeps everything
+    # (the errors would otherwise exist nowhere).
     out = obj
     errs = obj.get("extra", {}).get("tpu_errors")
-    if errs:
+    if errs and wrote_durable:
         out = dict(obj)
         out["extra"] = {
             k: v for k, v in obj["extra"].items() if k != "tpu_errors"

@@ -118,12 +118,15 @@ def enumerate_machine_views(machine: MachineSpec, max_dims: int = 2) -> List[Mac
     """
     n = machine.num_devices
     views: List[MachineView] = []
-    # 1-D views: every divisor size, every aligned offset
-    for size in _divisors(n):
+    # 1-D views: every divisor size PLUS every power-of-two size (a
+    # 6-device machine keeps its partial-machine dp=4 placement), every
+    # aligned offset
+    sizes = sorted(set(_divisors(n)) | {1 << k for k in range(n.bit_length()) if (1 << k) <= n})
+    for size in sizes:
         for start in range(0, n - size + 1, size):
             views.append(MachineView(start, (size,), (1,)))
     if max_dims >= 2:
-        for size in _divisors(n):
+        for size in sizes:
             for d0 in _divisors(size):
                 d1 = size // d0
                 if d0 < 2 or d1 < 2:

@@ -120,9 +120,19 @@ def gpipe(
         mb = b // n_microbatches
 
         def to_mb(tree):
-            # [M, mb, ...] microbatch schedule, per leaf
+            # [M, mb, ...] microbatch schedule, per leaf. Split B with mb
+            # MAJOR, then transpose: a batch dim sharded on "data"
+            # propagates onto the mb dim through this reshape (contiguous
+            # shards stay aligned) and the transpose carries it to dim 1
+            # for free. The M-major split instead lands the sharding on
+            # the microbatch-INDEX dim, and moving it off again at the
+            # xs_spec constraint costs an involuntary full
+            # rematerialization under pp x cp (XLA spmd_partitioner
+            # warning; ADVICE/VERDICT r4). unmb below is its exact
+            # inverse, so per-sample outputs stay aligned with inputs.
             return jax.tree.map(
-                lambda a: a.reshape((n_microbatches, mb) + a.shape[1:]), tree
+                lambda a: a.reshape((mb, n_microbatches) + a.shape[1:]).swapaxes(0, 1),
+                tree,
             )
 
         xs, ss = to_mb(x), to_mb(shared)
@@ -260,7 +270,9 @@ def gpipe(
             in_specs=(specs_params, xs_spec, ss_spec),
             out_specs=out_specs,
         )(stacked_params, xs, ss)
-        unmb = lambda t: jax.tree.map(lambda a: a.reshape((b,) + a.shape[2:]), t)
+        unmb = lambda t: jax.tree.map(
+            lambda a: a.swapaxes(0, 1).reshape((b,) + a.shape[2:]), t
+        )
         if with_aux:
             y, aux = result
             return unmb(y), aux

@@ -453,6 +453,8 @@ class CompiledExecutor:
         cp_size = self.mesh.shape[SEQ_AXIS] if cp_axis else 1
         from ..parallel.mesh import DATA_AXIS as _DATA_AXIS
 
+        # single source of truth for the manual data axis: shared by the
+        # LowerCtx (shard_rng decorrelation) and the carry entry_spec
         dp_axis = (
             _DATA_AXIS
             if _DATA_AXIS in self.mesh.axis_names and self.mesh.shape[_DATA_AXIS] > 1
@@ -560,9 +562,7 @@ class CompiledExecutor:
             # (index 2) on "seq" for every rank>=3 entry whose S divides
             from jax.sharding import PartitionSpec as _P
 
-            from ..parallel.mesh import DATA_AXIS as _DA
-
-            d_ax = _DA if (_DA in self.mesh.axis_names and self.mesh.shape[_DA] > 1) else None
+            d_ax = dp_axis
 
             def entry_spec(shape):
                 # only rank>=3 [B, S, ...] entries carry a sequence dim;

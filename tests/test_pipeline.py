@@ -854,3 +854,47 @@ def test_dropout_mask_decorrelated_across_manual_shards():
     shards = [out[:2, :4], out[:2, 4:], out[2:, :4], out[2:, 4:]]
     pats = [tuple((s == 0).ravel().tolist()) for s in shards]
     assert len(set(pats)) == 4, "shards drew correlated dropout masks"
+
+
+def test_pp_cp_no_involuntary_rematerialization():
+    """VERDICT r4 ask #6: the pp x dp x cp layout must not trip XLA's
+    "[SPMD] Involuntary full rematerialization" at the microbatch
+    reshape. The mb-major split + transpose in gpipe's to_mb keeps the
+    data sharding riding the batch dim through the reshape; regression-
+    pin it by compiling the composed train step in a subprocess and
+    scanning the C++ stderr."""
+    import subprocess
+    import sys
+
+    prog = """
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np, jax.numpy as jnp
+from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+from flexflow_tpu.models import TransformerConfig, build_transformer
+from flexflow_tpu.parallel.strategy import pipeline_strategy
+
+cfg = TransformerConfig(num_layers=4, hidden_size=32, num_heads=2, ff_size=64, seq_length=16)
+m = build_transformer(FFConfig(batch_size=8, workers_per_node=8), cfg)
+st = pipeline_strategy(m.graph, pp=2, dp=2, cp=2)
+m.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=LossType.MEAN_SQUARED_ERROR, strategy=st)
+rs = np.random.RandomState(0)
+x = jnp.asarray(rs.randn(8, 16, 32), jnp.float32)
+y = jnp.asarray(rs.randn(8, 16, 32), jnp.float32)
+print('loss', float(m.executor.train_batch([x], y, jax.random.key(0))['loss']))
+"""
+    import os
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["TF_CPP_MIN_LOG_LEVEL"] = "0"
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", prog], capture_output=True, text=True,
+        timeout=500, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "loss" in r.stdout, r.stdout
+    assert "Involuntary full rematerialization" not in r.stderr, (
+        [l for l in r.stderr.splitlines() if "rematerialization" in l][:2]
+    )
