@@ -140,9 +140,49 @@ double ffc_pcg_optimize(ffc_pcg_t *pcg, ffc_mm_t *mm, int32_t batch,
 
 /* One SHARED degree for the whole graph (the DP leaf's uniform-view
  * scan, dp_search.py _leaf_cost): returns the best cost, *out_degree
- * receives the chosen power-of-two degree. */
+ * receives the chosen divisor degree. */
 double ffc_pcg_uniform_best(ffc_pcg_t *pcg, ffc_mm_t *mm, int32_t batch,
                             int32_t max_degree, int32_t *out_degree);
+
+/* Structural attributes for hybrid (pipeline / context-parallel)
+ * candidates (mirror of the aggregates unity.py's proposers derive from
+ * the PCG): repeat_idx = which instance of the repeated block the op
+ * belongs to (-1 = outside the pipelined stack), is_attention marks
+ * ring-attention-capable ops, tp_shardable_bytes / tp_dim_size describe
+ * the op's Megatron-shardable weights (tp must divide tp_dim_size), and
+ * pipe_tp_ok marks ops the CONSERVATIVE in-stage tp lowering can shard
+ * (complete column->row pairs) — pipeline candidates count only those
+ * toward the sharded inventory, cp candidates count the full set.
+ * Returns 0, or -1 on a bad op id. */
+int32_t ffc_pcg_op_set_parallel_attrs(ffc_pcg_t *pcg, int64_t op,
+                                      int32_t repeat_idx,
+                                      int32_t is_attention,
+                                      double tp_shardable_bytes,
+                                      int64_t tp_dim_size,
+                                      int32_t pipe_tp_ok);
+
+typedef struct {
+  int32_t kind; /* 0 = data parallel, 1 = pipeline, 2 = context parallel */
+  int32_t dp;
+  int32_t pp;
+  int32_t tp;
+  int32_t cp;
+  int32_t n_microbatches;
+  double cost;           /* modeled step seconds */
+  double mem_per_device; /* modeled bytes (params+grads+moments+carry) */
+} ffc_hybrid_t;
+
+/* Hybrid winner across dp / pipeline(pp x tp x cp) / context-parallel
+ * (dp x cp x tp) candidates with divisor-degree sweeps — the native
+ * mirror of unity.py's _propose_pipeline + _propose_context_parallel +
+ * feasible-cheapest-first winner walk (reference: one search engine for
+ * every API entry, graph.cc:2047). boundary_bytes = rotating carry +
+ * shared tensor bytes at the stage boundary; seq_len = block attention
+ * sequence length (0 = none); capacity = per-device HBM bytes (<= 0:
+ * unconstrained). Returns 0 and fills *out. */
+int32_t ffc_pcg_propose_hybrid(ffc_pcg_t *pcg, ffc_mm_t *mm, int32_t batch,
+                               double boundary_bytes, int64_t seq_len,
+                               double capacity, ffc_hybrid_t *out);
 
 /* ------------------------------------------------------------------ *
  * Full-model C API (reference: python/flexflow_c.h wraps FFModel for

@@ -26,6 +26,12 @@ struct PcgOp {
   double output_bytes = 0.0; // boundary tensor size (reshard charge)
   std::string name;
   std::vector<int64_t> inputs;
+  // hybrid-candidate structural attributes (unity.py proposer aggregates)
+  int32_t repeat_idx = -1;   // pipelined-block instance; -1 = outer
+  int32_t is_attention = 0;  // ring-attention-capable
+  double tp_shardable_bytes = 0.0;  // Megatron-shardable weight bytes
+  int64_t tp_dim_size = 0;          // dim tp must divide
+  int32_t pipe_tp_ok = 0;           // in-stage (pipeline) tp can shard it
 };
 
 struct Pcg {
@@ -66,6 +72,72 @@ static double reshard_time(MachineModel *mm, double nbytes, int degree) {
   double lat = intra ? mm->ici_latency : mm->dcn_latency;
   double bw = intra ? mm->ici_bandwidth : mm->dcn_bandwidth;
   return lat + nbytes / (bw * 0.85);
+}
+
+// inter-device link (latency, effective bandwidth) for an n-wide group,
+// honoring the NETWORKED model's cross-node links like sync_time does
+static void link_params(MachineModel *mm, int n, double *lat, double *bw) {
+  bool intra = n <= mm->devices_per_node;
+  *lat = intra ? mm->ici_latency : mm->dcn_latency;
+  *bw = intra ? mm->ici_bandwidth : mm->dcn_bandwidth;
+  if (mm->kind == MachineModel::NETWORKED && !intra) {
+    *lat = mm->link_latency;
+    *bw = mm->link_bandwidth;
+  }
+  *bw *= 0.85;
+}
+
+// point-to-point hop (CostModel.p2p_time: latency + bytes / effective bw)
+static double p2p_time(MachineModel *mm, double nbytes) {
+  double lat, bw;
+  link_params(mm, 1, &lat, &bw);
+  return lat + nbytes / bw;
+}
+
+// bandwidth-optimal ring allreduce over n devices (CostModel
+// .allreduce_time); ``groups`` independent group instances serialize
+// their per-invocation rendezvous (approximated by one link latency
+// each, the same role chip.coll_overhead plays host-side)
+static double ring_time(MachineModel *mm, double nbytes, int n,
+                        int groups = 1) {
+  if (n <= 1 || nbytes <= 0.0) return 0.0;
+  double lat, bw;
+  link_params(mm, n, &lat, &bw);
+  return std::max(1, groups) * lat + 2.0 * (n - 1) * lat +
+         2.0 * (n - 1) / n * nbytes / bw;
+}
+
+// every divisor of n >= lo, ascending (possibly EMPTY — degree 1 must
+// not leak into the >= 2 proposer sweeps) — the reference instantiates
+// xfers per divisor degree (substitution.cc:1726-1840)
+static std::vector<int> divisor_degrees(int n, int lo) {
+  std::vector<int> out;
+  for (int d = lo; d <= n; ++d)
+    if (n % d == 0) out.push_back(d);
+  return out;
+}
+
+// divisors PLUS power-of-two sizes <= n: flat per-op degree scans keep
+// partial-machine placements (degree 4 of 6 devices) alongside the
+// divisor degrees (mirror of machine.py enumerate_machine_views)
+static std::vector<int> flat_degrees(int n, int lo) {
+  std::vector<int> out = divisor_degrees(n, lo);
+  for (int d = 1; d <= n; d *= 2)
+    if (d >= lo && n % d != 0) out.push_back(d);
+  if (out.empty()) out.push_back(1);
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
+// GPipe microbatch count (strategy.py default_microbatches)
+static int default_microbatches(int batch, int pp, int dp) {
+  const int prefs[3] = {4 * pp, 2 * pp, pp};
+  for (int m : prefs)
+    if (m <= batch && batch % (m * dp) == 0) return m;
+  int hi = std::min(batch / std::max(1, dp), 4 * pp);
+  for (int m = hi; m > 0; --m)
+    if (batch % (m * dp) == 0) return m;
+  return 1;
 }
 
 }  // namespace ffcore
@@ -121,9 +193,9 @@ double ffc_pcg_optimize(ffc_pcg_t *pcg, ffc_mm_t *mm_, int32_t batch,
   int32_t num_devices = mm->num_nodes * mm->devices_per_node;
   if (max_degree <= 0 || max_degree > num_devices) max_degree = num_devices;
 
-  // candidate power-of-two degrees dividing the batch
+  // candidate divisor + power-of-two degrees dividing the batch
   std::vector<int> degrees;
-  for (int d = 1; d <= max_degree; d *= 2)
+  for (int d : flat_degrees(max_degree, 1))
     if (batch <= 0 || batch % d == 0) degrees.push_back(d);
   if (degrees.empty()) degrees.push_back(1);
 
@@ -219,7 +291,7 @@ double ffc_pcg_uniform_best(ffc_pcg_t *pcg, ffc_mm_t *mm_, int32_t batch,
   if (max_degree <= 0 || max_degree > num_devices) max_degree = num_devices;
   double bcost = std::numeric_limits<double>::infinity();
   int32_t bdeg = 1;
-  for (int d = 1; d <= max_degree; d *= 2) {
+  for (int d : flat_degrees(max_degree, 1)) {
     if (batch > 0 && batch % d != 0) continue;
     double total = 0.0;
     for (int64_t i = 0; i < n; ++i) {
@@ -232,6 +304,226 @@ double ffc_pcg_uniform_best(ffc_pcg_t *pcg, ffc_mm_t *mm_, int32_t batch,
   }
   if (out_degree) *out_degree = bdeg;
   return bcost;
+}
+
+int32_t ffc_pcg_op_set_parallel_attrs(ffc_pcg_t *pcg, int64_t op,
+                                      int32_t repeat_idx,
+                                      int32_t is_attention,
+                                      double tp_shardable_bytes,
+                                      int64_t tp_dim_size,
+                                      int32_t pipe_tp_ok) {
+  Pcg *p = reinterpret_cast<Pcg *>(pcg);
+  if (op < 0 || op >= (int64_t)p->ops.size()) return -1;
+  PcgOp &o = p->ops[op];
+  o.repeat_idx = repeat_idx;
+  o.is_attention = is_attention;
+  o.tp_shardable_bytes = tp_shardable_bytes;
+  o.tp_dim_size = tp_dim_size;
+  o.pipe_tp_ok = pipe_tp_ok;
+  return 0;
+}
+
+int32_t ffc_pcg_propose_hybrid(ffc_pcg_t *pcg, ffc_mm_t *mm_, int32_t batch,
+                               double boundary_bytes, int64_t seq_len,
+                               double capacity, ffc_hybrid_t *out) {
+  // Native mirror of unity.py's _propose_pipeline +
+  // _propose_context_parallel + the feasible-cheapest-first winner walk
+  // (reference: ONE search engine behind every API entry, graph.cc:2047
+  // — a C caller must not get a strictly weaker search than Python).
+  Pcg *p = reinterpret_cast<Pcg *>(pcg);
+  MachineModel *mm = reinterpret_cast<MachineModel *>(mm_);
+  if (!out) return -1;
+  const int64_t n = static_cast<int64_t>(p->ops.size());
+  const int N = mm->num_nodes * mm->devices_per_node;
+
+  // ---- aggregates (what the Python proposers derive from the PCG)
+  int R = 0;  // number of repeated-block instances
+  double wbytes = 0.0, repeat_w = 0.0, outer_w = 0.0;
+  double sharded_repeat = 0.0, sharded_all = 0.0;
+  int n_attn_block = 0, n_attn_all = 0;
+  double attn_act_bytes = 0.0;
+  std::vector<int64_t> block0, outer, attn_ops;
+  // SEPARATE tp inventories, as in unity.py: the pipeline proposer's
+  // tp_divides consults only the repeated BLOCK's shardable dims (an
+  // odd-vocab outer embedding must not veto pp x tp), while the cp
+  // proposer consults the whole graph's megatron set
+  std::vector<int64_t> block_tp_dims, all_tp_dims;
+  bool block_shardable = false, all_shardable = false;
+  bool block_dims_known = true, all_dims_known = true;
+  for (int64_t i = 0; i < n; ++i) {
+    const PcgOp &o = p->ops[i];
+    wbytes += o.weight_bytes;
+    bool in_repeat = o.repeat_idx >= 0;
+    if (in_repeat) {
+      R = std::max(R, o.repeat_idx + 1);
+      repeat_w += o.weight_bytes;
+      if (o.pipe_tp_ok) sharded_repeat += o.tp_shardable_bytes;
+      if (o.repeat_idx == 0) {
+        block0.push_back(i);
+        if (o.is_attention) n_attn_block++;
+      }
+    } else {
+      outer.push_back(i);
+      outer_w += o.weight_bytes;
+    }
+    sharded_all += o.tp_shardable_bytes;
+    if (o.tp_shardable_bytes > 0.0) {
+      all_shardable = true;
+      if (o.tp_dim_size > 0)
+        all_tp_dims.push_back(o.tp_dim_size);
+      else
+        all_dims_known = false;
+      if (in_repeat && o.pipe_tp_ok) {
+        block_shardable = true;
+        if (o.tp_dim_size > 0)
+          block_tp_dims.push_back(o.tp_dim_size);
+        else
+          block_dims_known = false;
+      }
+    }
+    if (o.is_attention) {
+      n_attn_all++;
+      attn_ops.push_back(i);
+      if (attn_act_bytes <= 0.0) attn_act_bytes = o.output_bytes;
+    }
+  }
+  double repl_repeat = std::max(0.0, repeat_w - sharded_repeat);
+  double repl_all = std::max(0.0, wbytes - sharded_all);
+  auto divides_all = [](const std::vector<int64_t> &dims, int t) {
+    for (int64_t d : dims)
+      if (d % t != 0) return false;
+    return true;
+  };
+  auto block_tp_divides = [&](int t) {
+    return block_shardable && block_dims_known && divides_all(block_tp_dims, t);
+  };
+  auto all_tp_divides = [&](int t) {
+    return all_shardable && all_dims_known && divides_all(all_tp_dims, t);
+  };
+
+  const double INF = std::numeric_limits<double>::infinity();
+  ffc_hybrid_t best_dp{0, 1, 1, 1, 1, 1, INF, 4.0 * wbytes};
+  ffc_hybrid_t cand;
+  std::vector<ffc_hybrid_t> cands;
+
+  // ---- dp baseline: one shared degree (weights replicate)
+  for (int d : flat_degrees(N, 1)) {
+    if (batch > 0 && batch % d != 0) continue;
+    double total = 0.0;
+    for (int64_t i = 0; i < n; ++i)
+      total += op_time(*p, p->ops[i], d) + sync_time(mm, p->ops[i], d);
+    if (total < best_dp.cost) {
+      best_dp.cost = total;
+      best_dp.dp = d;
+    }
+  }
+  cands.push_back(best_dp);
+
+  // ---- pipeline candidates (pp x tp x cp; unity._propose_pipeline)
+  if (R >= 2 && batch >= 2 && !block0.empty()) {
+    for (int pp : divisor_degrees(N, 2)) {
+      if (pp > R || R % pp != 0) continue;
+      std::vector<int> tps = divisor_degrees(N / pp, 2);
+      tps.insert(tps.begin(), 1);
+      for (int tp : tps) {
+        if ((N / pp) % tp != 0) continue;
+        if (tp > 1 && !block_tp_divides(tp)) continue;
+        std::vector<int> cps = divisor_degrees(N / (pp * tp), 2);
+        cps.insert(cps.begin(), 1);
+        for (int cp : cps) {
+          if ((N / (pp * tp)) % cp != 0) continue;
+          if (cp > 1 && (n_attn_block == 0 || seq_len <= 0 || seq_len % cp != 0))
+            continue;
+          int dp_eff = N / (pp * tp * cp);
+          if (dp_eff < 1 || batch % std::max(1, dp_eff) != 0) continue;
+          int M = default_microbatches(batch, pp, dp_eff);
+          int act_parts = dp_eff * M * cp;
+          double block_t = 0.0;
+          for (int64_t i : block0) {
+            const PcgOp &o = p->ops[i];
+            int parts = act_parts *
+                        (o.pipe_tp_ok && o.tp_shardable_bytes > 0.0 ? tp : 1);
+            block_t += op_time(*p, o, parts);
+          }
+          double stage_t = block_t * (R / pp);
+          int ticks = M + pp - 1;
+          double pt = p2p_time(mm, boundary_bytes / std::max(1, act_parts));
+          double coll = 0.0;
+          if (tp > 1)
+            coll += 4.0 * (R / pp) *
+                    ring_time(mm, boundary_bytes / std::max(1, act_parts), tp,
+                              dp_eff * cp);
+          if (cp > 1)
+            coll += 4.0 * (R / pp) * n_attn_block * (cp - 1) *
+                    p2p_time(mm, 2.0 * boundary_bytes / std::max(1, act_parts));
+          double outer_t = 0.0;
+          for (int64_t i : outer)
+            outer_t += op_time(*p, p->ops[i], std::max(1, dp_eff));
+          double per_dev_w = sharded_repeat / (pp * tp) + repl_repeat / pp;
+          double sync = ring_time(mm, per_dev_w, dp_eff * cp) +
+                        ring_time(mm, outer_w, N);
+          cand = ffc_hybrid_t{1, dp_eff, pp, tp, cp, M,
+                              ticks * (stage_t + coll + pt) + outer_t + sync,
+                              4.0 * (per_dev_w + outer_w) +
+                                  boundary_bytes * (R / pp) /
+                                      std::max(1, dp_eff * cp)};
+          cands.push_back(cand);
+        }
+      }
+    }
+  }
+
+  // ---- context-parallel candidates (dp x cp x tp;
+  // unity._propose_context_parallel)
+  if (n_attn_all > 0 && seq_len > 0) {
+    double base = 0.0;
+    for (int64_t i = 0; i < n; ++i) base += op_time(*p, p->ops[i], N);
+    for (int cp : divisor_degrees(N, 2)) {
+      if (cp > seq_len || seq_len % cp != 0) continue;
+      std::vector<int> tps = divisor_degrees(N / cp, 2);
+      tps.insert(tps.begin(), 1);
+      for (int tp : tps) {
+        if ((N / cp) % tp != 0) continue;
+        if (tp > 1 && !all_tp_divides(tp)) continue;
+        int dp = N / (cp * tp);
+        if (dp < 1 || batch % std::max(1, dp) != 0) continue;
+        double total = base;
+        for (int64_t i : attn_ops)
+          total += 2.0 * (cp - 1) *
+                   p2p_time(mm, 2.0 * p->ops[i].output_bytes / std::max(1, N));
+        double mem;
+        if (tp > 1) {
+          total += 4.0 * n_attn_all *
+                   ring_time(mm, attn_act_bytes / std::max(1, dp * cp), tp,
+                             dp * cp);
+          total += ring_time(mm, sharded_all / tp, dp * cp);
+          total += ring_time(mm, repl_all, N);
+          mem = 4.0 * (sharded_all / tp + repl_all);
+        } else {
+          total += ring_time(mm, wbytes, N);
+          mem = 4.0 * wbytes;
+        }
+        cand = ffc_hybrid_t{2, dp, 1, tp, cp, 1, total, mem};
+        cands.push_back(cand);
+      }
+    }
+  }
+
+  // ---- feasible-cheapest-first winner walk (unity.py): under a known
+  // capacity prefer the cheapest candidate that FITS; nothing fits ->
+  // the dp baseline (its weights may shard further under the λ search)
+  const ffc_hybrid_t *win = &best_dp;
+  if (capacity > 0.0) {
+    const ffc_hybrid_t *bf = nullptr;
+    for (const ffc_hybrid_t &c : cands)
+      if (c.mem_per_device <= capacity && (!bf || c.cost < bf->cost)) bf = &c;
+    if (bf) win = bf;
+  } else {
+    for (const ffc_hybrid_t &c : cands)
+      if (c.cost < win->cost) win = &c;
+  }
+  *out = *win;
+  return 0;
 }
 
 }  // extern "C"

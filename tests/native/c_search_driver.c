@@ -1,0 +1,115 @@
+/* Pure-C driver for the native hybrid search (ffcore.h, no CPython):
+ * the C API's search must offer the same candidate families as the
+ * Python engine (pipeline, context parallelism) — reference: one search
+ * engine behind every API entry (src/runtime/graph.cc:2047).
+ *
+ * Scenario 1 (pp-favorable): 8 isomorphic transformer blocks whose
+ * replicated weights overflow a tight per-device HBM while per-stage
+ * sharding fits -> the winner must be a pipeline strategy.
+ * Scenario 2 (cp-favorable): long sequence, batch too small to fill the
+ * machine, weights fit only when tp-sharded -> the winner must be a
+ * context-parallel (cp x tp) strategy.
+ */
+#include "ffcore.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+
+static int64_t add_block_op(ffc_pcg_t *pcg, int64_t prev, double flops,
+                            double bytes, double wbytes, double out_bytes,
+                            int32_t repeat, int32_t is_attn, double shard_b,
+                            int64_t tp_dim, const char *name) {
+  int64_t op = ffc_pcg_add_op(pcg, flops, bytes, wbytes, out_bytes, name);
+  if (prev >= 0 && ffc_pcg_add_edge(pcg, prev, op) != 0) {
+    fprintf(stderr, "add_edge failed\n");
+    exit(1);
+  }
+  if (ffc_pcg_op_set_parallel_attrs(pcg, op, repeat, is_attn, shard_b, tp_dim,
+                                    1) != 0) {
+    fprintf(stderr, "set_parallel_attrs failed\n");
+    exit(1);
+  }
+  return op;
+}
+
+int main(void) {
+  ffc_mm_t *mm = ffc_mm_create_simple(1, 8, 1e-6, 4.5e10, 1e-5, 2.5e10);
+  if (!mm) {
+    fprintf(stderr, "mm create failed\n");
+    return 1;
+  }
+
+  /* ---- scenario 1: deep stack, tight HBM -> pipeline ---- */
+  {
+    ffc_pcg_t *pcg = ffc_pcg_create();
+    /* BERT-ish block at batch 16, seq 128, hidden 512, ff 2048, bf16 */
+    const double act = 16.0 * 128 * 512 * 2;     /* 2.1 MB activation */
+    const double attn_w = 4.0 * 512 * 512 * 2;   /* 2.1 MB qkvo */
+    const double ff_w = 512.0 * 2048 * 2;        /* 2.1 MB each */
+    int64_t prev = -1;
+    for (int r = 0; r < 8; ++r) {
+      prev = add_block_op(pcg, prev, 4.3e9, 4 * act, attn_w, act, r, 1,
+                          attn_w, 512, "attn");
+      prev = add_block_op(pcg, prev, 4.3e9, 5 * act, ff_w, 4 * act, r, 0,
+                          ff_w, 2048, "ff1");
+      prev = add_block_op(pcg, prev, 4.3e9, 5 * act, ff_w, act, r, 0,
+                          ff_w, 2048, "ff2");
+    }
+    add_block_op(pcg, prev, 1e8, 2 * act, 1e6, act, -1, 0, 0.0, 0, "head");
+
+    /* replicated: 8 * 6.3 MB * 4 (param+grad+moments) ~ 202 MB; a
+     * 60 MB budget only fits when stages shard the stack */
+    ffc_hybrid_t out;
+    if (ffc_pcg_propose_hybrid(pcg, mm, 16, act, 128, 60e6, &out) != 0) {
+      fprintf(stderr, "propose_hybrid failed\n");
+      return 1;
+    }
+    printf("s1 kind=%d dp=%d pp=%d tp=%d cp=%d M=%d mem=%.3g\n", out.kind,
+           out.dp, out.pp, out.tp, out.cp, out.n_microbatches,
+           out.mem_per_device);
+    if (out.kind != 1 || out.pp < 2) {
+      fprintf(stderr, "expected a pipeline winner under tight HBM\n");
+      return 1;
+    }
+    if (out.mem_per_device > 60e6) {
+      fprintf(stderr, "winner exceeds capacity\n");
+      return 1;
+    }
+    ffc_pcg_destroy(pcg);
+  }
+
+  /* ---- scenario 2: long context, tiny batch -> cp x tp ---- */
+  {
+    ffc_pcg_t *pcg = ffc_pcg_create();
+    /* 2 blocks (NOT tagged as repeats: too shallow to pipeline), batch
+     * 2, seq 4096, hidden 512 -> dp can use at most 2 devices; weights
+     * ~25 MB replicate to ~100 MB with optimizer state */
+    const double act = 2.0 * 4096 * 512 * 2; /* 8.4 MB activation */
+    int64_t prev = -1;
+    for (int r = 0; r < 2; ++r) {
+      prev = add_block_op(pcg, prev, 1.7e10, 4 * act, 4.2e6, act, -1, 1,
+                          4.2e6, 512, "attn");
+      prev = add_block_op(pcg, prev, 1.7e10, 5 * act, 4.2e6, 4 * act, -1, 0,
+                          4.2e6, 2048, "ff1");
+      prev = add_block_op(pcg, prev, 1.7e10, 5 * act, 4.2e6, act, -1, 0,
+                          4.2e6, 2048, "ff2");
+    }
+
+    ffc_hybrid_t out;
+    if (ffc_pcg_propose_hybrid(pcg, mm, 2, 0.0, 4096, 80e6, &out) != 0) {
+      fprintf(stderr, "propose_hybrid failed\n");
+      return 1;
+    }
+    printf("s2 kind=%d dp=%d pp=%d tp=%d cp=%d mem=%.3g\n", out.kind, out.dp,
+           out.pp, out.tp, out.cp, out.mem_per_device);
+    if (out.kind != 2 || out.cp < 2 || out.tp < 2) {
+      fprintf(stderr, "expected a cp x tp winner for long context\n");
+      return 1;
+    }
+    ffc_pcg_destroy(pcg);
+  }
+
+  ffc_mm_destroy(mm);
+  printf("C_SEARCH_OK\n");
+  return 0;
+}
