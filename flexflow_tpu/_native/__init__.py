@@ -514,7 +514,7 @@ def _pipeline_repeats(graph, specs, batch=None):
         return [], 0.0
 
 
-def pcg_from_graph(graph, machine=None, batch=None, specs=None):
+def pcg_from_graph(graph, machine=None, batch=None, specs=None, repeats=None):
     """Build a NativePcg from a flexflow_tpu PCGraph using the op
     library's cost() (the host supplies the op math; the native engine
     searches). Structural attrs for the hybrid proposer are tagged in
@@ -531,14 +531,18 @@ def pcg_from_graph(graph, machine=None, batch=None, specs=None):
         pcg.set_chip(chip.bf16_flops, 0.55, chip.hbm_bandwidth, 0.8, 2e-6)
     if specs is None:
         specs = infer_all_specs(graph)
-    repeats, _ = _pipeline_repeats(graph, specs, batch)
+    if repeats is None:
+        repeats, _ = _pipeline_repeats(graph, specs, batch)
     rep_idx = {n.guid: ri for ri, rep in enumerate(repeats) for n in rep}
     # pipeline tp legality is the CONSERVATIVE set pipeline_strategy can
-    # shard (complete column->row pairs); for block ops only those count
-    # toward the shardable inventory, so the native candidate's memory
-    # model matches the strategy that would actually run. For outer ops
-    # (cp x tp is GSPMD territory) the full megatron name set applies.
-    shardable_block = tp_shardable_nodes(graph, repeats[0]) if repeats else set()
+    # shard (complete column->row pairs) — computed for EVERY repeat
+    # instance (each block holds distinct nodes), so the native sharded
+    # inventory matches unity's block_sharded_bytes * R, not 1/R of it.
+    # For outer ops (cp x tp is GSPMD territory) the full megatron name
+    # set applies.
+    shardable_block = set()
+    for rep in repeats:
+        shardable_block |= tp_shardable_nodes(graph, rep)
     idx = {}
     for node in graph.topo_order():
         in_specs = [specs[e.src][e.src_idx] for e in graph.in_edges(node)]
@@ -597,9 +601,10 @@ def native_hybrid_search(graph, machine, batch: int, capacity: float = 0.0):
     from ..parallel.propagation import infer_all_specs
 
     specs = infer_all_specs(graph)
-    pcg, _ = pcg_from_graph(graph, machine, batch=batch, specs=specs)
-    # boundary bytes: rotating carry + per-microbatch shared tensors
-    _, boundary = _pipeline_repeats(graph, specs, batch)
+    # ONE repeat/boundary analysis shared with pcg_from_graph
+    repeats, boundary = _pipeline_repeats(graph, specs, batch)
+    pcg, _ = pcg_from_graph(graph, machine, batch=batch, specs=specs,
+                            repeats=repeats)
     # block attention sequence length ([B, S, E] convention)
     seq_len = 0
     for node in graph.topo_order():

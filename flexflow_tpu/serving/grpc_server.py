@@ -56,12 +56,32 @@ def _tensor_to_array(t) -> np.ndarray:
     return np.asarray(data, dtype=dt).reshape(list(t.shape))
 
 
-def _array_to_tensor(out, name: str, arr: np.ndarray):
+def _tensor_from_raw(t, buf: bytes) -> np.ndarray:
+    """KServe v2 raw representation: row-major little-endian bytes in
+    ModelInferRequest.raw_input_contents[i], typed/shaped by inputs[i]
+    (the fast path Triton clients use — protobuf repeated-float packing
+    dominates the wire cost at any real payload size). Unknown datatypes
+    are REJECTED — silently reinterpreting raw bytes as FP32 would run
+    inference on garbage and return it as success."""
+    dt = _V2_TO_NP.get(t.datatype or "FP32")
+    if dt is None:
+        raise ValueError(f"unsupported raw datatype {t.datatype!r}")
+    return np.frombuffer(buf, dtype=dt).reshape(list(t.shape)).copy()
+
+
+def _coerce_v2(arr) -> tuple:
+    """(array, v2 datatype) with the shared unknown-dtype fallback to
+    FP32 — one rule for both the typed and raw response paths."""
     arr = np.asarray(arr)
-    v2 = _NP_TO_V2.get(str(arr.dtype), "FP32")
-    if v2 not in _CONTENTS_FIELD:
+    v2 = _NP_TO_V2.get(str(arr.dtype))
+    if v2 is None or v2 not in _CONTENTS_FIELD:
         arr = arr.astype(np.float32)
         v2 = "FP32"
+    return arr, v2
+
+
+def _array_to_tensor(out, name: str, arr: np.ndarray):
+    arr, v2 = _coerce_v2(arr)
     out.name = name
     out.datatype = v2
     out.shape.extend(arr.shape)
@@ -220,14 +240,26 @@ class GrpcInferenceServer:
         batcher = self.batchers.get(name)
         if model is None or batcher is None:
             self._abort(context, grpc.StatusCode.NOT_FOUND, f"unknown model {name}")
+        use_raw = bool(request.raw_input_contents)
         try:
-            by_name = {t.name: t for t in request.inputs}
+            if use_raw:
+                # raw bytes pair with inputs[] BY POSITION (KServe v2)
+                if len(request.raw_input_contents) != len(request.inputs):
+                    raise ValueError(
+                        "raw_input_contents length must match inputs"
+                    )
+                by_name = {
+                    t.name: _tensor_from_raw(t, raw)
+                    for t, raw in zip(request.inputs, request.raw_input_contents)
+                }
+            else:
+                by_name = {t.name: _tensor_to_array(t) for t in request.inputs}
             arrays = []
             for meta in model.inputs:
-                t = by_name.get(meta.name)
-                if t is None:
+                a = by_name.get(meta.name)
+                if a is None:
                     raise ValueError(f"missing input {meta.name}")
-                arrays.append(_tensor_to_array(t))
+                arrays.append(a)
             fut = batcher.submit(arrays)
         except RuntimeError as e:  # batcher stopped
             self._abort(context, grpc.StatusCode.UNAVAILABLE, str(e))
@@ -242,7 +274,17 @@ class GrpcInferenceServer:
             self._abort(context, grpc.StatusCode.INTERNAL, str(e))
         resp = pb.ModelInferResponse(model_name=name, id=request.id)
         for meta, o in zip(model.outputs, outs):
-            _array_to_tensor(resp.outputs.add(), meta.name, o)
+            if use_raw:
+                # mirror the request representation (Triton convention):
+                # typed/shaped outputs[], data in raw_output_contents
+                arr, v2 = _coerce_v2(o)
+                t = resp.outputs.add()
+                t.name = meta.name
+                t.datatype = v2
+                t.shape.extend(arr.shape)
+                resp.raw_output_contents.append(np.ascontiguousarray(arr).tobytes())
+            else:
+                _array_to_tensor(resp.outputs.add(), meta.name, o)
         return resp
 
     # ---------------------------------------------------------- repository

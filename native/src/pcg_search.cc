@@ -52,18 +52,15 @@ static double op_time(const Pcg &p, const PcgOp &op, int degree) {
   return (1.0 + bwd_factor) * fwd;
 }
 
+static void link_params(MachineModel *mm, int n, double *lat, double *bw);
+
 static double sync_time(MachineModel *mm, const PcgOp &op, int degree) {
   if (degree <= 1 || op.weight_bytes <= 0.0) return 0.0;
   // bandwidth-optimal ring over the view (matches CostModel.allreduce_time)
-  bool intra = degree <= mm->devices_per_node;
-  double lat = intra ? mm->ici_latency : mm->dcn_latency;
-  double bw = intra ? mm->ici_bandwidth : mm->dcn_bandwidth;
-  if (mm->kind == MachineModel::NETWORKED && !intra) {
-    lat = mm->link_latency;
-    bw = mm->link_bandwidth;
-  }
+  double lat, bw;
+  link_params(mm, degree, &lat, &bw);
   return 2.0 * (degree - 1) * lat +
-         2.0 * (degree - 1) / degree * op.weight_bytes / (bw * 0.85);
+         2.0 * (degree - 1) / degree * op.weight_bytes / bw;
 }
 
 static double reshard_time(MachineModel *mm, double nbytes, int degree) {

@@ -494,3 +494,47 @@ def test_grpc_shares_http_batchers(served_model):
             channel.close()
     finally:
         http.stop()
+
+
+def test_grpc_raw_contents_round_trip(served_model):
+    """KServe v2 raw representation (VERDICT r4 ask #8): multi-sample
+    requests with raw_input_contents bytes round-trip through the server
+    and come back as raw_output_contents matching a direct model call —
+    the Triton-client fast path that sidesteps repeated-float packing."""
+    pytest.importorskip("grpc")
+    from flexflow_tpu.serving.grpc_server import GrpcInferenceServer
+
+    srv = GrpcInferenceServer(port=0)
+    srv.register(served_model)
+    with srv:
+        channel, call, pb = _grpc_stub(srv.port)
+        x = np.random.RandomState(1).randn(4, 16).astype(np.float32)
+        req = pb.ModelInferRequest(model_name="mlp")
+        t = req.inputs.add()
+        t.name = served_model.inputs[0].name
+        t.datatype = "FP32"
+        t.shape.extend(x.shape)
+        req.raw_input_contents.append(x.tobytes())
+        resp = call("ModelInfer", req, pb.ModelInferResponse)
+        assert resp.raw_output_contents, "raw request must get a raw response"
+        assert not resp.outputs[0].contents.fp32_contents
+        out = np.frombuffer(resp.raw_output_contents[0], np.float32).reshape(
+            list(resp.outputs[0].shape)
+        )
+        (direct,) = served_model.infer([x])
+        np.testing.assert_allclose(out, np.asarray(direct), rtol=1e-5, atol=1e-6)
+
+        # malformed: raw count must match inputs count
+        bad = pb.ModelInferRequest(model_name="mlp")
+        tb = bad.inputs.add()
+        tb.name = served_model.inputs[0].name
+        tb.datatype = "FP32"
+        tb.shape.extend(x.shape)
+        bad.raw_input_contents.append(x.tobytes())
+        bad.raw_input_contents.append(x.tobytes())
+        import grpc as _grpc
+
+        with pytest.raises(_grpc.RpcError) as ei:
+            call("ModelInfer", bad, pb.ModelInferResponse)
+        assert ei.value.code() == _grpc.StatusCode.INVALID_ARGUMENT
+        channel.close()
