@@ -155,12 +155,18 @@ class FFModel:
         out_dim: int,
         activation: ActiMode = ActiMode.NONE,
         use_bias: bool = True,
-        datatype: DataType = DataType.FLOAT,
+        datatype: Optional[DataType] = None,
         kernel_initializer: str = "glorot_uniform",
         bias_initializer: str = "zeros",
         name: str = "",
     ) -> Tensor:
-        p = linear_mod.LinearParams(out_dim, use_bias, self._acti(activation), datatype, kernel_initializer, bias_initializer)
+        # datatype None inherits the input dtype (the reference's DT_NONE
+        # default, model.h dense) — a bf16 model's dense layers must not
+        # silently compute and store f32 because the caller omitted it
+        p = linear_mod.LinearParams(
+            out_dim, use_bias, self._acti(activation), datatype or input.dtype,
+            kernel_initializer, bias_initializer,
+        )
         return self._one(OpType.LINEAR, p, [input], name=name)
 
     def conv2d(
@@ -567,6 +573,23 @@ class FFModel:
             # frontend Tensor handles remain valid
             if self._search_result.graph is not None:
                 self.graph = self._search_result.graph
+        # a strategy built for (or exported from) a DIFFERENT graph has
+        # guids matching nothing here; the GSPMD path would silently run
+        # fully replicated (every sharding lookup misses) — the bench's
+        # tp/hybrid measurements did exactly that until this guard; only
+        # the pipeline path's stage_of validation caught its own case.
+        # Strategies carry layer names (the reference's strategy files
+        # are name-keyed, triton strategy.cc), so a structurally
+        # identical rebuild remaps cleanly; anything else is an error.
+        remapped = self.strategy.remap_to(self.graph)
+        if remapped is None:
+            raise ValueError(
+                "strategy was built for a different graph: its node guids "
+                "match nothing here and name-based remapping failed "
+                "(missing or ambiguous layer names); rebuild or re-export "
+                "the strategy against THIS model's graph"
+            )
+        self.strategy = remapped
         if self.config.export_strategy_file:
             with open(self.config.export_strategy_file, "w") as f:
                 f.write(self.strategy.to_json())

@@ -40,6 +40,14 @@ class TPUChipSpec:
     # pays this once per psum/allreduce where a per-hop-linear latency
     # model predicts almost nothing
     coll_overhead: float = 0.0
+    # how strongly INDEPENDENT group instances of one collective (a
+    # dp x tp mesh psums over n_dev/n groups at once) serialize through
+    # the rendezvous: the per-invocation constant is multiplied by
+    # groups**coll_groups_alpha. 0 = fully concurrent (real ICI and —
+    # per the round-5 honest hybrid measurement — today's XLA host
+    # platform), 1 = fully serialized (the old assumption, fitted to a
+    # measurement that turned out to be running replicated)
+    coll_groups_alpha: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -187,6 +187,60 @@ class ParallelStrategy:
     axis_sizes: Dict[str, int] = dataclasses.field(default_factory=dict)
     node_shardings: Dict[int, OpSharding] = dataclasses.field(default_factory=dict)
     pipeline: Optional[PipelineAssignment] = None
+    # guid -> layer name at build time: strategies exported to JSON are
+    # name-keyed like the reference's strategy files (triton
+    # strategy.cc / DLRM strategies/*.pb map placements by op name), so
+    # an import into a REBUILT graph (new guids) can remap
+    node_names: Dict[int, str] = dataclasses.field(default_factory=dict)
+
+    def record_names(self, graph) -> "ParallelStrategy":
+        self.node_names = {
+            n.guid: n.name for n in graph.nodes.values() if n.name
+        }
+        return self
+
+    def remap_to(self, graph) -> Optional["ParallelStrategy"]:
+        """Rebind this strategy's guids onto ``graph`` by layer NAME.
+        Returns a remapped copy, self when the guids already match, or
+        None when remapping is impossible (missing/ambiguous names).
+
+        "Already matches" requires FULL containment: guids come from a
+        per-process counter, so a cross-process import can partially
+        collide with unrelated nodes — binding on a partial overlap
+        would attach shardings to the wrong ops, the exact silent
+        misapply this method exists to prevent."""
+        covered = set(self.node_shardings)
+        if self.pipeline is not None:
+            covered |= set(self.pipeline.stage_of)
+        if not self.node_shardings or covered <= set(graph.nodes):
+            return self
+        by_name: Dict[str, int] = {}
+        for n in graph.nodes.values():
+            if n.name:
+                if n.name in by_name:
+                    return None  # ambiguous
+                by_name[n.name] = n.guid
+        out = ParallelStrategy(
+            axis_sizes=dict(self.axis_sizes), node_names={}
+        )
+        for g, sh in self.node_shardings.items():
+            name = self.node_names.get(g)
+            if not name or name not in by_name:
+                return None
+            ng = by_name[name]
+            out.node_shardings[ng] = sh
+            out.node_names[ng] = name
+        if self.pipeline is not None:
+            stage_of = {}
+            for g, s in self.pipeline.stage_of.items():
+                name = self.node_names.get(g)
+                if not name or name not in by_name:
+                    return None
+                stage_of[by_name[name]] = s
+            out.pipeline = PipelineAssignment(
+                self.pipeline.n_stages, self.pipeline.n_microbatches, stage_of
+            )
+        return out
 
     def output_spec(self, guid: int, idx: int = 0) -> Optional[SpecTuple]:
         s = self.node_shardings.get(guid)
@@ -214,6 +268,7 @@ class ParallelStrategy:
                     if self.pipeline
                     else None
                 ),
+                "node_names": {str(g): n for g, n in self.node_names.items()},
                 "nodes": {
                     str(g): {
                         "outputs": [list(map(list, o)) if o is not None else None for o in s.outputs],
@@ -234,7 +289,10 @@ class ParallelStrategy:
     @classmethod
     def from_json(cls, text: str) -> "ParallelStrategy":
         d = json.loads(text)
-        st = cls(axis_sizes=dict(d["axis_sizes"]))
+        st = cls(
+            axis_sizes=dict(d["axis_sizes"]),
+            node_names={int(g): n for g, n in d.get("node_names", {}).items()},
+        )
         if d.get("pipeline"):
             p = d["pipeline"]
             st.pipeline = PipelineAssignment(
@@ -314,7 +372,7 @@ def megatron_strategy(
                 spec = pspec(*axes)
             shardings.append(spec)
         st.node_shardings[node.guid] = OpSharding(outputs=shardings, weights=weights)
-    return st
+    return st.record_names(graph)
 
 
 def context_parallel_strategy(
@@ -370,7 +428,7 @@ def context_parallel_strategy(
                 axes[seq_dim] = SEQ_AXIS
             shardings.append(pspec(*axes) if any(a for a in axes) else None)
         st.node_shardings[node.guid] = OpSharding(outputs=shardings, weights=weights)
-    return st
+    return st.record_names(graph)
 
 
 def expert_parallel_strategy(
@@ -422,7 +480,7 @@ def expert_parallel_strategy(
             else:
                 outputs.append(None)
         st.node_shardings[node.guid] = OpSharding(outputs=outputs, weights=weights)
-    return st
+    return st.record_names(graph)
 
 
 def pipeline_strategy(
@@ -578,4 +636,4 @@ def data_parallel_strategy(graph: PCGraph, num_devices: int, batch_dim: int = 0)
             outputs=shardings,
             weights={w.name: None for w in wspecs},  # None -> replicated
         )
-    return st
+    return st.record_names(graph)

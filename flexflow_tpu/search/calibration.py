@@ -366,26 +366,32 @@ _CHIP_PRESETS = {
     # never compare a TPU roofline against a CPU wall clock): nominal
     # multicore-XLA peaks; the calibration derates correct the rest.
     # ici_*/coll_overhead model XLA host-platform virtual-device
-    # collectives: memcpy-grade bandwidth, but a LARGE fixed cost per
-    # collective invocation (cross-thread rendezvous) that dominates
-    # strategies with many sequential subgroup collectives (hybrid
-    # dp x tp, whose independent group instances additionally SERIALIZE
-    # through one rendezvous — the groups multiplier in
-    # CostModel.allreduce_time). FITTED-TO-HOST-CLASS against quiet
-    # 8-virtual-device dp/tp/hybrid step measurements (round 4; ratios
-    # dp 0.64 / tp 1.01 / hybrid 1.65 with measured-rank agreement and a
-    # ~3.7x predicted hybrid-over-tp margin on the fitting host) —
-    # expect drift on very different core counts, within the bench's
-    # [0.3, 3] validation band.
-    "cpu": TPUChipSpec(name="cpu", bf16_flops=5e10, f32_flops=1e11, hbm_bandwidth=2e10, hbm_capacity=16e9, ici_bandwidth=1e9, ici_links=1, ici_latency=1e-3, coll_overhead=0.45),
+    # collectives: memcpy-grade bandwidth plus a LARGE fixed cost per
+    # collective invocation (cross-thread rendezvous).
+    # REFITTED in round 5 after two honesty fixes: (a) the bench's
+    # tp/hybrid "measurements" had been silently running REPLICATED
+    # (strategies built for a different graph never applied — now a
+    # compile-time error), and (b) bf16 models had been computing their
+    # dense layers in f32. Against honest quiet dp/tp/hybrid bf16 steps
+    # the fit is coll_overhead=0.25 with coll_groups_alpha=0 —
+    # independent group instances of one collective do NOT serialize on
+    # today's XLA host platform (the old x groups assumption came from
+    # the replicated fake measurement) — giving ratios dp 0.73 /
+    # tp 0.92 / hybrid 1.42 with measured-rank agreement. The pipeline
+    # family is deliberately left OUT of the fitting set as a transfer
+    # check (bench reports its ratio separately). Expect drift on very
+    # different core counts, within the bench's [0.3, 3] band.
+    "cpu": TPUChipSpec(name="cpu", bf16_flops=5e10, f32_flops=1e11, hbm_bandwidth=2e10, hbm_capacity=16e9, ici_bandwidth=1e9, ici_links=1, ici_latency=1e-3, coll_overhead=0.25, coll_groups_alpha=0.0),
 }
 
 # virtual-device compute scaling for the CPU fallback: N virtual devices
 # share one physical machine, so the bench divides per-device peaks by
-# N * this factor; fitted jointly with the cpu preset above (< 1 because
-# the single-device calibration entries already absorb part of the
-# thread-pool sharing)
-CPU_FITTED_CONTENTION = 0.8
+# N * this factor; fitted jointly with the cpu preset above. The round-5
+# value is > 1 because the fitting model is bf16 and the calibration
+# suite's measured entries are f32-op timings: XLA's CPU bf16 emulation
+# runs several times slower than f32, and that gap folds into this
+# constant (a dtype-aware calibration suite would move it back toward 1)
+CPU_FITTED_CONTENTION = 5.0
 
 
 def chip_spec_for(device_kind: str) -> TPUChipSpec:

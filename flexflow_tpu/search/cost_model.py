@@ -177,10 +177,11 @@ class CostModel:
 
         ``groups``: number of INDEPENDENT group instances of this
         collective launched together (a dp x tp mesh psums over
-        n_dev/n groups of n at once). On real ICI they run concurrently
-        (no extra cost); the host-platform virtual mesh serializes them
-        through one rendezvous, so the per-invocation constant is paid
-        per group.
+        n_dev/n groups of n at once). Charged as
+        coll_overhead * groups**chip.coll_groups_alpha — alpha 0 (the
+        default, and the round-5 honest-measurement refit for the CPU
+        host class) means concurrent groups add NO cost; a host class
+        that does serialize them can set alpha up to 1.
 
         Reference: the fork's AllreduceHelper expands ring / butterfly /
         double-binary-tree patterns into p2p sends and simulates them
@@ -199,7 +200,12 @@ class CostModel:
             return 0.0
         B = self.link_bandwidth(intra_node)
         L = self.link_latency(intra_node)
-        C = self.chip.coll_overhead * max(1, groups) if include_overhead else 0.0
+        C = (
+            self.chip.coll_overhead
+            * max(1, groups) ** getattr(self.chip, "coll_groups_alpha", 0.0)
+            if include_overhead
+            else 0.0
+        )
         if option == ParameterSyncOption.BUTTERFLY:
             k = math.log2(n) if n > 1 else 1.0
             return C + k * L + math.ceil(k) * (nbytes / n) * 2 / B * (n / 2)

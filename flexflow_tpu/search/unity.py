@@ -338,7 +338,7 @@ def strategy_from_pcg(
             weights=weights,
             machine_view_hash=views.get(node.guid, MachineView(0, (1,), (1,))).to_hash(),
         )
-    return strategy
+    return strategy.record_names(graph)
 
 
 # ---------------------------------------------------------------------------
@@ -435,6 +435,7 @@ def _propose_pipeline(
     cost_model: CostModel,
     batch: int,
     capacity: Optional[float] = None,
+    fixed: Optional[Tuple[int, int, int]] = None,
 ) -> Optional[_PipelineCandidate]:
     """Cost the (pp, microbatch) candidates the GPipe executor can run
     (VERDICT r2 missing #3: the search must propose pipeline parallelism,
@@ -540,73 +541,109 @@ def _propose_pipeline(
 
     best: Optional[_PipelineCandidate] = None
     best_fit: Optional[_PipelineCandidate] = None
-    # every divisor degree, as the reference instantiates per-divisor
-    # xfers (substitution.cc:1726-1840) — not just powers of two
-    for pp in _parallel_degrees(num_devices):
-        if pp > R or R % pp != 0:
+    if fixed is not None:
+        triples = [fixed]
+    else:
+        # every divisor degree, as the reference instantiates per-divisor
+        # xfers (substitution.cc:1726-1840) — not just powers of two
+        triples = [
+            (pp, tp, cp)
+            for pp in _parallel_degrees(num_devices)
+            for tp in (1, *_parallel_degrees(num_devices // pp))
+            for cp in (1, *_parallel_degrees(num_devices // (pp * tp)))
+        ]
+    for pp, tp, cp in triples:
+        if pp > R or R % pp != 0 or num_devices % (pp * tp * cp) != 0:
             continue
-        for tp in (1, *_parallel_degrees(num_devices // pp)):
-            if tp > 1 and not tp_divides(tp):
-                continue
-            # cp: sequence sharding INSIDE each stage (pp x cp) — viable
-            # when the block has attention and the block seq divides
-            for cp in (1, *_parallel_degrees(num_devices // (pp * tp))):
-                if cp > 1 and (not block_attn or block_seq % cp != 0):
-                    continue
-                dp_eff = num_devices // (pp * tp * cp)
-                if batch % max(1, dp_eff) != 0:
-                    continue
-                M = default_microbatches(batch, pp, dp_eff)
-                mb_parts = dp_eff * M  # microbatch shard = batch / (M * dp)
-                act_parts = mb_parts * cp  # activations also divide by cp
-                block_t = sum(
-                    op_time(n, act_parts * (tp if n.guid in tp_nodes else 1))
-                    for n in block_nodes
-                )
-                stage_t = block_t * (R // pp)
-                ticks = M + pp - 1
-                p2p = cost_model.p2p_time(boundary_bytes / max(1, act_parts))
-                coll = 0.0
-                if tp > 1:
-                    # Megatron: 2 activation allreduces per block per
-                    # direction (after wo and ff2, and their transposes);
-                    # dp_eff*cp independent group instances serialize on
-                    # the virtual CPU mesh (groups multiplier, same
-                    # convention as predict_strategy_time)
-                    coll += 4.0 * (R // pp) * cost_model.allreduce_time(
-                        boundary_bytes / max(1, act_parts), tp,
-                        groups=max(1, dp_eff * cp),
-                    )
-                if cp > 1:
-                    # ring attention: K and V rotate cp-1 hops per block
-                    # per direction
-                    coll += 4.0 * (R // pp) * len(block_attn) * (cp - 1) * (
-                        cost_model.p2p_time(2.0 * boundary_bytes / max(1, act_parts))
-                    )
-                outer_t = sum(op_time(n, max(1, dp_eff)) for n in outer_nodes)
-                # only the provably-shardable weights divide by tp; the
-                # rest replicate across the model axis at full size
-                per_dev_w = sharded_total / (pp * tp) + repl_total / pp
-                sync_t = cost_model.allreduce_time(per_dev_w, dp_eff * cp)
-                sync_t += cost_model.allreduce_time(outer_wbytes, num_devices)
-                total = ticks * (stage_t + coll + p2p) + outer_t + sync_t
-                # per-device memory: stage weights (4x for param+grad+2
-                # moments) plus live GPipe activations (every in-flight
-                # microbatch keeps its boundary activation per block;
-                # sequence sharding divides them by cp)
-                mem = 4.0 * (per_dev_w + outer_wbytes)
-                mem += boundary_bytes * (R // pp) / max(1, dp_eff * cp)
-                cand = _PipelineCandidate(total, pp, M, mem, tp, cp)
-                if best is None or total < best.cost:
-                    best = cand
-                if capacity is not None and mem <= capacity and (
-                    best_fit is None or total < best_fit.cost
-                ):
-                    best_fit = cand
+        if tp > 1 and not tp_divides(tp):
+            continue
+        # cp: sequence sharding INSIDE each stage (pp x cp) — viable
+        # when the block has attention and the block seq divides
+        if cp > 1 and (not block_attn or block_seq % cp != 0):
+            continue
+        dp_eff = num_devices // (pp * tp * cp)
+        if batch % max(1, dp_eff) != 0:
+            continue
+        M = default_microbatches(batch, pp, dp_eff)
+        mb_parts = dp_eff * M  # microbatch shard = batch / (M * dp)
+        act_parts = mb_parts * cp  # activations also divide by cp
+        block_t = sum(
+            op_time(n, act_parts * (tp if n.guid in tp_nodes else 1))
+            for n in block_nodes
+        )
+        stage_t = block_t * (R // pp)
+        ticks = M + pp - 1
+        p2p = cost_model.p2p_time(boundary_bytes / max(1, act_parts))
+        coll = 0.0
+        if tp > 1:
+            # Megatron: 2 activation allreduces per block per
+            # direction (after wo and ff2, and their transposes);
+            # groups passes the dp_eff*cp instance count through to
+            # allreduce_time, which charges it per the chip's
+            # coll_groups_alpha (0 after the round-5 refit: concurrent
+            # group instances do not serialize)
+            coll += 4.0 * (R // pp) * cost_model.allreduce_time(
+                boundary_bytes / max(1, act_parts), tp,
+                groups=max(1, dp_eff * cp),
+            )
+        if cp > 1:
+            # ring attention: K and V rotate cp-1 hops per block
+            # per direction
+            coll += 4.0 * (R // pp) * len(block_attn) * (cp - 1) * (
+                cost_model.p2p_time(2.0 * boundary_bytes / max(1, act_parts))
+            )
+        outer_t = sum(op_time(n, max(1, dp_eff)) for n in outer_nodes)
+        # only the provably-shardable weights divide by tp; the
+        # rest replicate across the model axis at full size
+        per_dev_w = sharded_total / (pp * tp) + repl_total / pp
+        sync_t = cost_model.allreduce_time(per_dev_w, dp_eff * cp)
+        sync_t += cost_model.allreduce_time(outer_wbytes, num_devices)
+        total = ticks * (stage_t + coll + p2p) + outer_t + sync_t
+        # per-device memory: stage weights (4x for param+grad+2
+        # moments) plus live GPipe activations (every in-flight
+        # microbatch keeps its boundary activation per block;
+        # sequence sharding divides them by cp)
+        mem = 4.0 * (per_dev_w + outer_wbytes)
+        mem += boundary_bytes * (R // pp) / max(1, dp_eff * cp)
+        cand = _PipelineCandidate(total, pp, M, mem, tp, cp)
+        if best is None or total < best.cost:
+            best = cand
+        if capacity is not None and mem <= capacity and (
+            best_fit is None or total < best_fit.cost
+        ):
+            best_fit = cand
     # under a known HBM capacity prefer the cheapest candidate that FITS
     # (deeper pp or pp x tp shards weights further; the fastest candidate
     # may not fit in the memory-pressure regime pipeline exists for)
     return best_fit if capacity is not None and best_fit is not None else best
+
+
+def predict_pipeline_time(
+    graph: PCGraph,
+    num_devices: int,
+    batch: int,
+    pp: int,
+    tp: int = 1,
+    cp: int = 1,
+    machine: Optional[MachineSpec] = None,
+    calibration=None,
+    cost_model: Optional[CostModel] = None,
+) -> Optional[float]:
+    """Modeled step seconds of ONE given pipeline layout — the proposer's
+    cost formula evaluated at a fixed (pp, tp, cp) point. The bench uses
+    it to validate the PIPELINE cost model against a measured GPipe step:
+    the pipeline family is not in the CPU constant-fitting set
+    (dp/tp/hybrid), so its predicted/measured ratio is a transfer check
+    of the model, not a refit. Returns None when the layout is illegal
+    for this graph (the proposer's own feasibility rules)."""
+    cm = cost_model or CostModel(
+        machine or MachineSpec(num_nodes=1, devices_per_node=num_devices),
+        calibration=calibration,
+    )
+    cand = _propose_pipeline(
+        graph, num_devices, cm, batch, capacity=None, fixed=(pp, tp, cp)
+    )
+    return cand.cost if cand is not None else None
 
 
 # ---------------------------------------------------------------------------
@@ -710,8 +747,8 @@ def _propose_context_parallel(
             if tp > 1:
                 # Megatron: 2 activation allreduces per block per
                 # direction over the tp groups (one block ~ one MHA
-                # node); dp*cp independent group instances serialize on
-                # the virtual CPU mesh (groups, as predict_strategy_time)
+                # node); groups count charged per the chip's
+                # coll_groups_alpha (0 after the round-5 refit)
                 total += 4.0 * len(attn_nodes) * cost_model.allreduce_time(
                     act_bytes / max(1, dp * cp), tp, groups=max(1, dp * cp)
                 )

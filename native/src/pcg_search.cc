@@ -92,16 +92,18 @@ static double p2p_time(MachineModel *mm, double nbytes) {
 }
 
 // bandwidth-optimal ring allreduce over n devices (CostModel
-// .allreduce_time); ``groups`` independent group instances serialize
-// their per-invocation rendezvous (approximated by one link latency
-// each, the same role chip.coll_overhead plays host-side)
+// .allreduce_time). ``groups`` (independent group instances of the
+// collective) is accepted for call-site symmetry with the Python
+// predictor but NOT charged: the round-5 honest measurements showed
+// concurrent group instances do not serialize (coll_groups_alpha=0 in
+// the refitted host model), and real ICI runs them concurrently too.
 static double ring_time(MachineModel *mm, double nbytes, int n,
                         int groups = 1) {
+  (void)groups;
   if (n <= 1 || nbytes <= 0.0) return 0.0;
   double lat, bw;
   link_params(mm, n, &lat, &bw);
-  return std::max(1, groups) * lat + 2.0 * (n - 1) * lat +
-         2.0 * (n - 1) / n * nbytes / bw;
+  return 2.0 * (n - 1) * lat + 2.0 * (n - 1) / n * nbytes / bw;
 }
 
 // every divisor of n >= lo, ascending (possibly EMPTY — degree 1 must

@@ -142,3 +142,54 @@ def test_tp_shardable_rejects_rows_of_inconsistent_columns():
     assert by_name["good_ff2"] in shardable
     assert by_name["bad_ff1"] not in shardable
     assert by_name["bad_ff2"] not in shardable
+
+
+def test_compile_remaps_or_rejects_foreign_strategy():
+    """A strategy whose node guids match nothing in the model must never
+    silently no-op (the GSPMD path would run fully replicated — this
+    measured as a fake 'tp' in the bench until the guard existed).
+    Strategies carry layer names, so a STRUCTURALLY IDENTICAL rebuild
+    remaps by name (the reference's strategy files are name-keyed,
+    triton strategy.cc); a structurally different model is rejected."""
+    import numpy as np
+    import pytest as _pytest
+
+    from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+    from flexflow_tpu.parallel.strategy import megatron_strategy
+
+    cfg = TransformerConfig(num_layers=2, hidden_size=32, num_heads=2, ff_size=64, seq_length=8)
+    m1 = build_transformer(FFConfig(batch_size=8, workers_per_node=8), cfg)
+    m2 = build_transformer(FFConfig(batch_size=8, workers_per_node=8), cfg)
+    st_foreign = megatron_strategy(m1.graph, dp=4, tp=2)
+    assert not (set(st_foreign.node_shardings) & set(m2.graph.nodes))
+    m2.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR,
+        strategy=st_foreign,
+    )
+    # the remapped strategy's shardings actually BIND to m2's graph
+    assert set(m2.strategy.node_shardings) <= set(m2.graph.nodes)
+    assert any(
+        any(o is not None for o in sh.outputs)
+        for sh in m2.strategy.node_shardings.values()
+    )
+    x = np.random.RandomState(0).randn(8, 8, 32).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 8, 32).astype(np.float32)
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    loss = float(m2.executor.train_batch([_jnp.asarray(x)], _jnp.asarray(y), _jax.random.key(0))["loss"])
+    assert np.isfinite(loss)
+
+    # structurally DIFFERENT model (extra layers -> names missing): reject
+    cfg3 = TransformerConfig(num_layers=4, hidden_size=32, num_heads=2, ff_size=64, seq_length=8)
+    m3 = build_transformer(FFConfig(batch_size=8, workers_per_node=8), cfg3)
+    st3 = megatron_strategy(m3.graph, dp=4, tp=2)
+    m4 = build_transformer(FFConfig(batch_size=8, workers_per_node=8), cfg)
+    with _pytest.raises(ValueError, match="different graph"):
+        m4.compile(
+            optimizer=SGDOptimizer(lr=0.01),
+            loss_type=LossType.MEAN_SQUARED_ERROR,
+            strategy=st3,
+        )

@@ -294,6 +294,25 @@ def main():
         if rc != 0:
             entry["error"] = (err or "")[-400:]
         _append(entry)
+
+    # Phase D: the serving comparison ON-CHIP (VERDICT r4 ask #8 fold-in:
+    # SERVING_BENCH.json's CPU numbers show the server winning via
+    # weight-streaming amortization; on the real chip the batched path
+    # additionally turns many tiny tunnel dispatches into one MXU batch)
+    senv = dict(os.environ)
+    senv["PYTHONPATH"] = f"{REPO}:{senv.get('PYTHONPATH', '')}".rstrip(":")
+    rc, out, err, timed_out = _graceful_run(
+        [sys.executable, str(REPO / "examples" / "serving_bench.py")],
+        env=senv, timeout=1500,
+    )
+    if timed_out:
+        _append({"phase": "serving_onchip", "error": "timeout"})
+    else:
+        line = out.strip().splitlines()[-1] if out.strip() else ""
+        entry = {"phase": "serving_onchip", "rc": rc, "stdout": line[:4000]}
+        if rc != 0:
+            entry["error"] = (err or "")[-400:]
+        _append(entry)
     print("evidence complete:", EVIDENCE, file=sys.stderr)
 
 
