@@ -212,8 +212,20 @@ class ParallelStrategy:
         covered = set(self.node_shardings)
         if self.pipeline is not None:
             covered |= set(self.pipeline.stage_of)
-        if not self.node_shardings or covered <= set(graph.nodes):
+        if not self.node_shardings:
             return self
+        if covered <= set(graph.nodes):
+            # containment alone is not identity: guids restart at 1000
+            # per process, so a cross-process import can cover a PREFIX
+            # of a larger graph's guids while meaning different ops —
+            # accept the identity binding only when the recorded names
+            # agree for every covered guid (no names recorded = legacy
+            # strategy, keep the old behavior)
+            if not self.node_names or all(
+                graph.nodes[g].name == self.node_names.get(g, graph.nodes[g].name)
+                for g in covered
+            ):
+                return self
         by_name: Dict[str, int] = {}
         for n in graph.nodes.values():
             if n.name:

@@ -129,3 +129,35 @@ def test_inception_builds():
     config = FFConfig(batch_size=2)
     model = build_inception_v3(config, num_classes=10, image_hw=299)
     assert model.num_layers() > 90
+
+
+def test_bf16_model_has_no_f32_param_leak():
+    """Round-5 regression pin: model.dense inherits the input dtype (the
+    reference's DT_NONE default) — a bf16 transformer must hold every
+    weight in bf16 and produce bf16 activations. Before the fix the
+    dense layers silently computed and stored f32 (halving achievable
+    MXU throughput on the chip for the FLOPs-dominant ops)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flexflow_tpu import DataType, FFConfig, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=32, num_heads=2, ff_size=64, seq_length=8,
+        dtype=DataType.BFLOAT16,
+    )
+    m = build_transformer(FFConfig(batch_size=4), cfg)
+    for n in m.graph.topo_order():
+        d = getattr(n.params, "dtype", None)
+        assert d in (None, DataType.BFLOAT16), (n, d)
+    m.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=LossType.MEAN_SQUARED_ERROR)
+    bad = [
+        p.dtype for p in jax.tree.leaves(m.executor.params)
+        if p.dtype not in (jnp.bfloat16,)
+    ]
+    assert not bad, bad
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 8, 32), jnp.bfloat16)
+    out = m.executor.predict([x])[0]
+    assert out.dtype == jnp.bfloat16, out.dtype

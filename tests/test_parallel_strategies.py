@@ -193,3 +193,49 @@ def test_compile_remaps_or_rejects_foreign_strategy():
             loss_type=LossType.MEAN_SQUARED_ERROR,
             strategy=st3,
         )
+
+
+def test_remap_rejects_identity_on_guid_collision():
+    """Cross-process import: guids restart at 1000 per process, so an
+    imported strategy can cover a PREFIX of a larger graph's guids while
+    meaning different ops. Identity binding is accepted only when the
+    recorded layer names agree; otherwise the strategy remaps by NAME
+    (reproducing the misbind found in review: a 2-layer export's
+    final_ln sharding must not land on the 4-layer model's l2_ln1)."""
+    from flexflow_tpu import FFConfig
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+    from flexflow_tpu.parallel.strategy import ParallelStrategy, megatron_strategy
+
+    small_cfg = TransformerConfig(num_layers=2, hidden_size=32, num_heads=2, ff_size=64, seq_length=8)
+    big_cfg = TransformerConfig(num_layers=4, hidden_size=32, num_heads=2, ff_size=64, seq_length=8)
+    m_small = build_transformer(FFConfig(batch_size=8, workers_per_node=8), small_cfg)
+    m_big = build_transformer(FFConfig(batch_size=8, workers_per_node=8), big_cfg)
+    st = megatron_strategy(m_small.graph, dp=4, tp=2)
+
+    # simulate the fresh-process guid collision: shift the strategy's
+    # guids onto the big graph's FIRST guids (covered ⊆ graph.nodes)
+    big_guids = sorted(m_big.graph.nodes)
+    mapping = dict(zip(sorted(st.node_shardings), big_guids))
+    shifted = ParallelStrategy(
+        axis_sizes=dict(st.axis_sizes),
+        node_shardings={mapping[g]: sh for g, sh in st.node_shardings.items()},
+        node_names={
+            mapping[g]: st.node_names[g]
+            for g in st.node_shardings
+            if g in st.node_names
+        },
+    )
+    assert set(shifted.node_shardings) <= set(m_big.graph.nodes)
+
+    out = shifted.remap_to(m_big.graph)
+    assert out is not None and out is not shifted, "identity binding must be refused"
+    # final_ln's sharding landed on the node NAMED final_ln, not on the
+    # node whose guid happened to collide
+    by_name = {n.name: n.guid for n in m_big.graph.nodes.values() if n.name}
+    src_final = next(g for g, n in st.node_names.items() if n == "final_ln")
+    assert out.node_shardings[by_name["final_ln"]] == st.node_shardings[src_final]
+    collided_guid = mapping[src_final]
+    if m_big.graph.nodes[collided_guid].name != "final_ln":
+        assert out.node_shardings.get(collided_guid) != st.node_shardings[src_final] or (
+            m_big.graph.nodes[collided_guid].name in st.node_names.values()
+        )
