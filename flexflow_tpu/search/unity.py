@@ -646,6 +646,31 @@ def predict_pipeline_time(
     return cand.cost if cand is not None else None
 
 
+def predict_cp_time(
+    graph: PCGraph,
+    num_devices: int,
+    batch: int,
+    cp: int,
+    tp: int = 1,
+    machine: Optional[MachineSpec] = None,
+    calibration=None,
+    cost_model: Optional[CostModel] = None,
+) -> Optional[float]:
+    """Modeled step seconds of ONE given context-parallel layout — the cp
+    proposer's cost formula at a fixed (cp, tp) point, for bench
+    validation like predict_pipeline_time: the cp family is also outside
+    the CPU constant-fitting set, so its predicted/measured ratio is a
+    transfer check of the ring-attention comm model."""
+    cm = cost_model or CostModel(
+        machine or MachineSpec(num_nodes=1, devices_per_node=num_devices),
+        calibration=calibration,
+    )
+    cand = _propose_context_parallel(
+        graph, num_devices, cm, batch, capacity=None, fixed=(cp, tp)
+    )
+    return cand.cost if cand is not None else None
+
+
 # ---------------------------------------------------------------------------
 # sequence/context-parallel candidates
 # ---------------------------------------------------------------------------
@@ -666,6 +691,7 @@ def _propose_context_parallel(
     cost_model: CostModel,
     batch: int,
     capacity: Optional[float] = None,
+    fixed: Optional[Tuple[int, int]] = None,
 ) -> Optional[_ContextParallelCandidate]:
     """Cost (dp, cp) sequence-parallel candidates (NEW capability — the
     reference has no sequence parallelism, SURVEY §5; this is the search
@@ -726,49 +752,57 @@ def _propose_context_parallel(
 
     best: Optional[_ContextParallelCandidate] = None
     best_fit: Optional[_ContextParallelCandidate] = None
-    # every divisor degree (reference: per-divisor xfer instantiation,
-    # substitution.cc:1726-1840) — degree-3/6 meshes are searchable
-    for cp in _parallel_degrees(num_devices):
-        if cp > seq_len or seq_len % cp != 0:
+    if fixed is not None:
+        pairs = [fixed]
+    else:
+        # every divisor degree (reference: per-divisor xfer
+        # instantiation, substitution.cc:1726-1840) — degree-3/6 meshes
+        # are searchable
+        pairs = [
+            (cp, tp)
+            for cp in _parallel_degrees(num_devices)
+            for tp in (1, *_parallel_degrees(num_devices // cp))
+        ]
+    for cp, tp in pairs:
+        if cp > seq_len or seq_len % cp != 0 or num_devices % (cp * tp) != 0:
             continue
-        for tp in (1, *_parallel_degrees(num_devices // cp)):
-            if tp > 1 and not tp_divides(tp):
-                continue
-            dp = num_devices // (cp * tp)
-            if batch % max(1, dp) != 0:
-                continue
-            total = base
-            # ring attention: K and V blocks rotate cp-1 hops, fwd + bwd
-            for node in attn_nodes:
-                ins = [specs_map[e.src][e.src_idx] for e in graph.in_edges(node)]
-                s = ins[0]
-                kv_bytes = 2.0 * s.size_bytes / max(1, num_devices)
-                total += 2.0 * (cp - 1) * cost_model.p2p_time(kv_bytes)
-            if tp > 1:
-                # Megatron: 2 activation allreduces per block per
-                # direction over the tp groups (one block ~ one MHA
-                # node); groups count charged per the chip's
-                # coll_groups_alpha (0 after the round-5 refit)
-                total += 4.0 * len(attn_nodes) * cost_model.allreduce_time(
-                    act_bytes / max(1, dp * cp), tp, groups=max(1, dp * cp)
-                )
-                # grad sync: sharded weights reduce over their dp*cp
-                # replica group; replicated ones over all devices
-                total += cost_model.allreduce_time(sharded_bytes / tp, dp * cp)
-                total += cost_model.allreduce_time(repl_bytes, num_devices)
-                mem = 4.0 * (sharded_bytes / tp + repl_bytes)
-            else:
-                total += cost_model.allreduce_time(wbytes, num_devices)
-                # CP replicates all weights: full 4x footprint
-                # (param + grad + 2 moments) on every device
-                mem = 4.0 * wbytes
-            cand = _ContextParallelCandidate(total, dp, cp, mem, tp)
-            if best is None or total < best.cost:
-                best = cand
-            if capacity is not None and mem <= capacity and (
-                best_fit is None or total < best_fit.cost
-            ):
-                best_fit = cand
+        if tp > 1 and not tp_divides(tp):
+            continue
+        dp = num_devices // (cp * tp)
+        if batch % max(1, dp) != 0:
+            continue
+        total = base
+        # ring attention: K and V blocks rotate cp-1 hops, fwd + bwd
+        for node in attn_nodes:
+            ins = [specs_map[e.src][e.src_idx] for e in graph.in_edges(node)]
+            s = ins[0]
+            kv_bytes = 2.0 * s.size_bytes / max(1, num_devices)
+            total += 2.0 * (cp - 1) * cost_model.p2p_time(kv_bytes)
+        if tp > 1:
+            # Megatron: 2 activation allreduces per block per
+            # direction over the tp groups (one block ~ one MHA
+            # node); groups count charged per the chip's
+            # coll_groups_alpha (0 after the round-5 refit)
+            total += 4.0 * len(attn_nodes) * cost_model.allreduce_time(
+                act_bytes / max(1, dp * cp), tp, groups=max(1, dp * cp)
+            )
+            # grad sync: sharded weights reduce over their dp*cp
+            # replica group; replicated ones over all devices
+            total += cost_model.allreduce_time(sharded_bytes / tp, dp * cp)
+            total += cost_model.allreduce_time(repl_bytes, num_devices)
+            mem = 4.0 * (sharded_bytes / tp + repl_bytes)
+        else:
+            total += cost_model.allreduce_time(wbytes, num_devices)
+            # CP replicates all weights: full 4x footprint
+            # (param + grad + 2 moments) on every device
+            mem = 4.0 * wbytes
+        cand = _ContextParallelCandidate(total, dp, cp, mem, tp)
+        if best is None or total < best.cost:
+            best = cand
+        if capacity is not None and mem <= capacity and (
+            best_fit is None or total < best_fit.cost
+        ):
+            best_fit = cand
     # under a known HBM capacity prefer the cheapest candidate that FITS:
     # an infeasible pure-cp minimum must not shadow a feasible cp x tp
     # composition (same rule as the pipeline proposer)

@@ -234,8 +234,15 @@ def test_remap_rejects_identity_on_guid_collision():
     by_name = {n.name: n.guid for n in m_big.graph.nodes.values() if n.name}
     src_final = next(g for g, n in st.node_names.items() if n == "final_ln")
     assert out.node_shardings[by_name["final_ln"]] == st.node_shardings[src_final]
+    # the collided guid carries the sharding for ITS OWN name (the name
+    # remap assigns by name, never by the accidental guid alignment)
     collided_guid = mapping[src_final]
-    if m_big.graph.nodes[collided_guid].name != "final_ln":
-        assert out.node_shardings.get(collided_guid) != st.node_shardings[src_final] or (
-            m_big.graph.nodes[collided_guid].name in st.node_names.values()
+    collided_name = m_big.graph.nodes[collided_guid].name
+    if collided_name and collided_name != "final_ln":
+        src_for_name = next(
+            (g for g, n in st.node_names.items() if n == collided_name), None
         )
+        expected = (
+            st.node_shardings.get(src_for_name) if src_for_name is not None else None
+        )
+        assert out.node_shardings.get(collided_guid) == expected, collided_name
