@@ -387,10 +387,12 @@ _CHIP_PRESETS = {
 # virtual-device compute scaling for the CPU fallback: N virtual devices
 # share one physical machine, so the bench divides per-device peaks by
 # N * this factor; fitted jointly with the cpu preset above. The round-5
-# value is > 1 because the fitting model is bf16 and the calibration
-# suite's measured entries are f32-op timings: XLA's CPU bf16 emulation
-# runs several times slower than f32, and that gap folds into this
-# constant (a dtype-aware calibration suite would move it back toward 1)
+# value absorbs everything the per-op model can't see on this host
+# class — thread-pool sharing across the virtual devices, XLA's bf16
+# CPU emulation cost on the ops the class derates don't cover exactly,
+# and reshard/fusion effects between ops — fitted against honest quiet
+# dp/tp/hybrid bf16 step measurements (the suite's entries themselves
+# are bf16, calibration_data/opcosts_cpu.json)
 CPU_FITTED_CONTENTION = 5.0
 
 
