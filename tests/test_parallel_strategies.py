@@ -246,3 +246,66 @@ def test_remap_rejects_identity_on_guid_collision():
             st.node_shardings.get(src_for_name) if src_for_name is not None else None
         )
         assert out.node_shardings.get(collided_guid) == expected, collided_name
+
+
+def test_strategy_import_across_processes_with_shifted_guids():
+    """The real import workflow: process A exports a strategy; process B
+    builds OTHER graphs first (shifting the per-process guid counter so
+    the imported guids collide with unrelated prefixes), rebuilds the
+    same model, and imports the file. The name-based remap must bind
+    shardings to the right ops and train."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    cfg = TransformerConfig(num_layers=2, hidden_size=32, num_heads=2, ff_size=64, seq_length=8)
+    m = build_transformer(FFConfig(batch_size=8, workers_per_node=8), cfg)
+    st = megatron_strategy(m.graph, dp=4, tp=2)
+    with tempfile.TemporaryDirectory() as td:
+        sf = os.path.join(td, "st.json")
+        # force the collision the docstring describes regardless of how
+        # far THIS process's guid counter has advanced: rewrite the
+        # exported guids into the 1000..N range every fresh process
+        # starts at, so they always overlap the child's early nodes
+        d = json.loads(st.to_json())
+        order = sorted(int(g) for g in d["nodes"])
+        newg = {str(g): str(1000 + i) for i, g in enumerate(order)}
+        d["nodes"] = {newg[g]: v for g, v in d["nodes"].items()}
+        d["node_names"] = {newg[g]: n for g, n in d["node_names"].items()}
+        with open(sf, "w") as f:
+            f.write(json.dumps(d))
+        prog = f"""
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import json
+import numpy as np, jax.numpy as jnp
+from flexflow_tpu import FFConfig, LossType, SGDOptimizer
+from flexflow_tpu.models import TransformerConfig, build_transformer
+
+# shift the guid counter: an unrelated graph consumes guids first, so
+# the imported strategy's guids collide with THIS model's early nodes
+_ = build_transformer(FFConfig(batch_size=8, workers_per_node=8),
+                      TransformerConfig(num_layers=1, hidden_size=16, num_heads=2, ff_size=32, seq_length=8))
+cfg = TransformerConfig(num_layers=2, hidden_size=32, num_heads=2, ff_size=64, seq_length=8)
+m = build_transformer(FFConfig(batch_size=8, workers_per_node=8,
+                               import_strategy_file={sf!r}), cfg)
+m.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=LossType.MEAN_SQUARED_ERROR)
+assert dict(zip(m.mesh.axis_names, m.mesh.devices.shape)) == {{'data': 4, 'model': 2}}
+assert set(m.strategy.node_shardings) <= set(m.graph.nodes)
+by_name = {{n.name: n.guid for n in m.graph.nodes.values() if n.name}}
+sh = m.strategy.node_shardings[by_name['l0_ff1']]
+assert any(w is not None for w in sh.weights.values()), 'ff1 kernel must be tp-sharded'
+x = jnp.asarray(np.random.RandomState(0).randn(8, 8, 32), jnp.float32)
+y = jnp.asarray(np.random.RandomState(1).randn(8, 8, 32), jnp.float32)
+loss = float(m.executor.train_batch([x], y, jax.random.key(0))['loss'])
+print(json.dumps({{'ok': True, 'loss': loss}}))
+"""
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        r = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                           text=True, timeout=420, env=env)
+        assert r.returncode == 0, r.stderr[-2000:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["ok"] and np.isfinite(out["loss"])
