@@ -23,10 +23,12 @@ from typing import Dict, Optional, Sequence
 import jax
 import numpy as np
 
-# axes preferred for the cross-host (DCN) dimension, in order: gradient
-# sync (data) and pipeline hops tolerate DCN latency; tensor/expert
-# collectives should stay on ICI
-_DCN_PREFERENCE = ("data", "pipe", "expert", "model", "seq")
+# which axis spans hosts (DCN) when several could: most latency-tolerant
+# first (scaling-book ordering) — dp syncs once per step, pipe ticks are
+# point-to-point, expert all_to_alls batch, ring attention overlaps its
+# seq hops with compute; Megatron "model" psums sit on every layer's
+# critical path and must stay on ICI if anything else can take the DCN
+_DCN_PREFERENCE = ("data", "pipe", "expert", "seq", "model")
 
 _initialized = False
 

@@ -113,9 +113,49 @@ def main():
     assert all(np.isfinite(plosses)), plosses
     assert plosses[-1] < plosses[0], plosses
 
+    # ---- cross-host RING ATTENTION (round 5): cp=2 x tp=4 puts the
+    # "seq" axis on the process boundary (data/pipe absent and seq
+    # precedes model in _DCN_PREFERENCE — ring hops tolerate DCN
+    # latency, Megatron psums must not), so every ring step's K/V
+    # ppermute crosses hosts while tp rides the 4 intra-host devices.
+    from flexflow_tpu.parallel.strategy import context_parallel_strategy
+
+    ccfg = TransformerConfig(
+        num_layers=2, hidden_size=32, num_heads=4, ff_size=64, seq_length=16
+    )
+    cconfig = FFConfig(batch_size=4, num_nodes=nproc, workers_per_node=4)
+    cm = build_transformer(cconfig, ccfg)
+    cm.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.MEAN_SQUARED_ERROR,
+        strategy=context_parallel_strategy(cm.graph, dp=1, cp=2, tp=4),
+    )
+    cmesh = dict(zip(cm.mesh.axis_names, cm.mesh.devices.shape))
+    assert cmesh == {"seq": 2, "model": 4}, cmesh
+    seq_axis = list(cm.mesh.axis_names).index("seq")
+    seq_procs = [
+        {d.process_index for d in np.moveaxis(cm.mesh.devices, seq_axis, 0)[s].flat}
+        for s in range(2)
+    ]
+    assert seq_procs[0] != seq_procs[1], f"seq does not cross hosts: {seq_procs}"
+    cx = rs.randn(4, 16, 32).astype(np.float32)
+    cy = rs.randn(4, 16, 32).astype(np.float32)
+    # with "seq" on the DCN axis each process feeds its SEQ slice of the
+    # global INPUT (the executor's per-process feeding contract is "this
+    # process's addressable slice", whichever axis rides DCN); labels
+    # are only batch-sharded (replicated over seq), so the full array
+    my_seq = next(s for s in range(2) if pid in seq_procs[s])
+    cxl = cx[:, my_seq * 8 : (my_seq + 1) * 8, :]
+    closses = [
+        float(cm.executor.train_batch([cxl], cy, jax.random.key(i))["loss"])
+        for i in range(3)
+    ]
+    assert all(np.isfinite(closses)), closses
+    assert closses[-1] < closses[0], closses
+
     print(
         f"MULTIHOST_OK pid={pid} losses={losses} window={wlosses.tolist()} "
-        f"pipeline={plosses}",
+        f"pipeline={plosses} ring={closses}",
         flush=True,
     )
 
