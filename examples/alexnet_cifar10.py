@@ -14,13 +14,18 @@ from flexflow_tpu.models import build_alexnet
 
 def main():
     config = FFConfig.from_args()
-    model = build_alexnet(config, num_classes=10, image_hw=32)
+    # AlexNet's stride-4 conv1 + three stride-2 pools need >= 63px
+    # inputs; the reference upscales CIFAR's 32x32 to 229x229 before
+    # feeding it (bootcamp_demo/ff_alexnet_cifar10.py:35). 64 keeps the
+    # geometry valid while the smoke run stays CPU-friendly.
+    hw = 64
+    model = build_alexnet(config, num_classes=10, image_hw=hw)
     model.compile(
         optimizer=SGDOptimizer(lr=config.learning_rate, momentum=0.9),
         loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
         metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
     )
-    x, y = synthetic_classification(4 * config.batch_size, (3, 32, 32), 10)
+    x, y = synthetic_classification(4 * config.batch_size, (3, hw, hw), 10)
     with Timer() as t:
         model.fit([x], y, epochs=config.epochs)
     print(f"done in {t.seconds:.2f}s")

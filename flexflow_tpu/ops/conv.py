@@ -30,7 +30,17 @@ def _pad2(p):
 
 def _out_dim(size, kernel, stride, pad):
     lo, hi = _pad2(pad)
-    return (size + lo + hi - kernel) // stride + 1
+    out = (size + lo + hi - kernel) // stride + 1
+    if out <= 0:
+        # fail AT GRAPH BUILD with the geometry in hand — a 0-dim tensor
+        # otherwise flows silently until a ZeroDivisionError deep in the
+        # search cost model (found via AlexNet's 224-geometry stack fed
+        # 32x32 CIFAR images; the reference upscales CIFAR to 229 first)
+        raise ValueError(
+            f"conv/pool output dim collapsed to {out}: input {size}, "
+            f"kernel {kernel}, stride {stride}, padding {pad}"
+        )
+    return out
 
 
 @dataclasses.dataclass(frozen=True)

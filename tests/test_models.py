@@ -161,3 +161,17 @@ def test_bf16_model_has_no_f32_param_leak():
     x = jnp.asarray(np.random.RandomState(0).randn(4, 8, 32), jnp.bfloat16)
     out = m.executor.predict([x])[0]
     assert out.dtype == jnp.bfloat16, out.dtype
+
+
+def test_conv_rejects_collapsed_geometry():
+    """Round-5 guard: a conv/pool stack whose output collapses to 0 must
+    fail AT GRAPH BUILD with the geometry named, not surface later as a
+    ZeroDivisionError in the search cost model (AlexNet's 224-geometry
+    fed 32x32 images; the reference upscales CIFAR to 229 first)."""
+    import pytest as _pytest
+
+    from flexflow_tpu import FFConfig
+    from flexflow_tpu.models import build_alexnet
+
+    with _pytest.raises(ValueError, match="collapsed"):
+        build_alexnet(FFConfig(batch_size=4), num_classes=10, image_hw=32)
