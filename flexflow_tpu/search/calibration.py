@@ -121,8 +121,37 @@ def load_calibration(device_kind: str) -> Optional[Calibration]:
 # measurement
 # ---------------------------------------------------------------------------
 
-# (shape, dtype, inner, backend) -> measured baseline-loop seconds
+# (shape, dtype, backend) -> measured baseline-loop PER-ITERATION slope
 _BASELINE_CACHE: Dict[tuple, float] = {}
+# per-process dispatch/readback floor (seconds); measured once
+_DISPATCH_FLOOR: Dict[str, float] = {}
+
+
+def _readback_floor(backend: str) -> float:
+    """Best-case dispatch+scalar-readback round trip for this backend.
+
+    Through the axon tunnel this is tens-to-hundreds of ms with heavy
+    jitter — the round-5 root cause of the bad quiet-chip derates: any
+    subtraction of two wall-clock timings can only resolve op work that
+    is LARGE relative to this number, so the loop trip counts below are
+    sized against it.
+    """
+    hit = _DISPATCH_FLOOR.get(backend)
+    if hit is not None:
+        return hit
+    import jax
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda x: (x * 1.000001).sum())
+    x0 = jnp.ones((8,), jnp.float32)
+    float(tiny(x0))
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(tiny(x0))
+        best = min(best, time.perf_counter() - t0)
+    _DISPATCH_FLOOR[backend] = best
+    return best
 
 
 def measure_lowered_op(
@@ -132,18 +161,26 @@ def measure_lowered_op(
     n_parts: int = 1,
     inner: int = 32,
     reps: int = 3,
+    analytic_hint: Optional[float] = None,
 ) -> Optional[float]:
     """Jit one shard of the op's lowering on the default device and time
     it (the reference's inner_measure_operator_cost, operator.h:127).
 
-    Per-dispatch overhead on tunneled/remote devices (several ms through
-    the axon relay) dwarfs the microseconds a single op takes, so the op
-    runs ``inner`` times INSIDE one XLA program (lax.fori_loop with a
-    data dependency through the carry so the loop body can't be hoisted),
-    and a structurally-matched baseline loop — same perturb-input and
-    reduce-output passes, no op — is timed the same way and subtracted.
-    Dispatch cost and the dependency-plumbing memory passes cancel,
-    leaving the op's own time. The flush is a scalar readback:
+    Per-dispatch overhead on tunneled/remote devices (tens-to-hundreds
+    of ms through the axon relay, with jitter of the same magnitude)
+    dwarfs the microseconds a single op takes, so the op runs inside one
+    XLA program (lax.fori_loop with a data dependency through the carry
+    so the loop body can't be hoisted) at TWO trip counts, and the
+    per-iteration cost is the SLOPE (t_hi - t_lo) / (hi - lo): every
+    fixed cost — dispatch, readback, compile-cache lookup — cancels
+    exactly. A structurally-matched baseline loop (same perturb-input
+    and reduce-output passes, no op) is sloped the same way and
+    subtracted so the dependency-plumbing memory passes cancel too.
+
+    ``hi`` is sized from ``analytic_hint`` (the roofline estimate) so the
+    op contributes enough device time to resolve against the readback
+    jitter; with no hint the loop escalates until the hi/lo difference
+    clears the measured floor. The flush is a scalar readback:
     jax.block_until_ready is unreliable through the tunneled transport.
     """
     try:
@@ -180,57 +217,123 @@ def measure_lowered_op(
             outs = op_def.lower(params, inputs, wts, ctx)
             return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
 
-        if inner == 0:  # single-shot fallback (dispatch overhead included)
+        if inner == 0:
+            # single-shot fallback (integer first input: can't thread the
+            # loop carry through it). Dispatches are enqueued async and
+            # flushed once, so the measured window is N device executions
+            # plus ONE readback round trip — subtract that floor rather
+            # than smearing it across the N executions (through the
+            # tunnel the floor alone is orders of magnitude above a
+            # small op's true cost)
             jitted = jax.jit(run_op)
             float(jitted(args, weights))
+            n = max(reps, 1) * 8
             t0 = time.perf_counter()
             acc = None
-            for _ in range(max(reps, 1) * 8):
+            for _ in range(n):
                 acc = jitted(args, weights)
             float(acc)
-            return (time.perf_counter() - t0) / (max(reps, 1) * 8)
+            elapsed = time.perf_counter() - t0
+            per = (elapsed - _readback_floor(backend)) / n
+            return per if per > 0 else None
 
         def perturbed(inputs, acc):
             # cheap data dependency: scales with |inputs[0]|, defeats LICM
             return [inputs[0] + (acc * 1e-30).astype(inputs[0].dtype)] + inputs[1:]
 
-        def loop_with_op(inputs, wts):
-            def body(i, acc):
-                return acc + run_op(perturbed(inputs, acc), wts)
+        def make_loop(with_op: bool):
+            # the trip count is a TRACED argument (fori_loop with a
+            # dynamic bound lowers to while_loop), so every trip count
+            # this measurement ever needs shares ONE compiled program —
+            # each distinct XLA program costs a full compile round trip
+            # through the tunnel (~tens of seconds), which would
+            # otherwise dominate the calibration suite's wall clock
+            def fn(inputs, wts, trip):
+                def body(i, acc):
+                    if with_op:
+                        return acc + run_op(perturbed(inputs, acc), wts)
+                    x = perturbed(inputs, acc)[0]
+                    return acc + jnp.sum(x.astype(jnp.float32))
 
-            return jax.lax.fori_loop(0, inner, body, jnp.float32(0.0))
+                return jax.lax.fori_loop(0, trip, body, jnp.float32(0.0))
 
-        def loop_baseline(inputs, wts):
-            del wts  # same call signature as loop_with_op; unused by design
+            return jax.jit(fn)
 
-            def body(i, acc):
-                x = perturbed(inputs, acc)[0]
-                return acc + jnp.sum(x.astype(jnp.float32))
-
-            return jax.lax.fori_loop(0, inner, body, jnp.float32(0.0))
-
-        def timed(fn) -> float:
-            jitted = jax.jit(fn)
-            float(jitted(args, weights))  # compile + first run
+        def timed(jitted, trip: int) -> float:
+            t = jnp.int32(trip)
             best = float("inf")
             for _ in range(max(reps, 1)):
                 t0 = time.perf_counter()
-                float(jitted(args, weights))
+                float(jitted(args, weights, t))
                 best = min(best, time.perf_counter() - t0)
             return best
 
-        t_with = timed(loop_with_op)
-        # the baseline depends only on (shape, dtype, inner, backend) —
+        # size the trip counts so the op's OWN time across (hi - lo)
+        # iterations is large relative to the readback floor; every
+        # fixed cost cancels in the slope, but noise on two wall clocks
+        # does not
+        floor = _readback_floor(backend)
+        # capped: with a slow tunnel floor (~0.5 s readback) an uncapped
+        # 12x target would balloon every timing run to many seconds;
+        # best-of-``reps`` min-filtering already suppresses the jitter
+        # the multiple is guarding against
+        resolve = min(max(0.25 if backend == "cpu" else 1.0, 12.0 * floor), 4.0)
+        CAP = 1 << 17
+
+        def adaptive_slope(with_op: bool, est_hint: Optional[float]) -> Optional[float]:
+            """Per-iteration slope, or None when it never resolved above
+            the jitter floor (wall-clock noise, not a measurement)."""
+            jitted = make_loop(with_op)
+            lo = max(4, inner // 4)
+            float(jitted(args, weights, jnp.int32(lo)))  # compile + warm
+            t_lo = timed(jitted, lo)
+            # per-iteration estimate for sizing: whichever is LARGER of
+            # the analytic hint and what t_lo itself implies (so a hint
+            # that under-estimates a slow op can't size a loop that runs
+            # for minutes)
+            est = max(est_hint or 0.0, (t_lo - floor) / lo, 1e-9)
+            hi = max(4 * lo, min(lo + int(resolve / est), CAP))
+            t_hi = timed(jitted, hi)
+            per = (t_hi - t_lo) / (hi - lo)
+            # under-resolved (op invisible at this trip count): escalate,
+            # re-sizing from the freshly measured slope
+            tries = 0
+            while per * (hi - lo) < 0.5 * resolve and hi < CAP and tries < 3:
+                lo, t_lo = hi, t_hi
+                est = max(per, est, 1e-9)
+                hi = min(lo + max(int(resolve / est), 3 * lo), CAP)
+                t_hi = timed(jitted, hi)
+                per = (t_hi - t_lo) / (hi - lo)
+                tries += 1
+            # acceptance scales with measured NOISE (the readback
+            # floor), not the sizing convenience target: a tiny op that
+            # tops out at the trip cap with signal well above the floor
+            # is a fine measurement; one buried under tunnel jitter is
+            # not, whatever its sign
+            accept = min(0.5 * resolve, max(10.0 * floor, 1e-3))
+            if per <= 0 or per * (hi - lo) < accept:
+                return None
+            return per
+
+        per_iter = adaptive_slope(True, analytic_hint)
+        if per_iter is None:
+            # never rose above the jitter floor even at the trip-count
+            # cap: a failed measurement, not a number — returning it
+            # would poison the derate geomean and the on-disk cache
+            return None
+        # the baseline slope depends only on (shape, dtype, backend) —
         # memoize it so a suite of ops sharing a first-input signature
-        # pays its compile+timing once
-        base_key = (tuple(args[0].shape), str(args[0].dtype), inner, backend)
-        t_base = _BASELINE_CACHE.get(base_key)
-        if t_base is None:
-            t_base = timed(loop_baseline)
-            _BASELINE_CACHE[base_key] = t_base
+        # pays its compile+timing once. An unresolved baseline means the
+        # plumbing is invisible next to the jitter floor: treat as zero
+        # (don't discard the op's own perfectly good measurement).
+        base_key = (tuple(args[0].shape), str(args[0].dtype), backend)
+        base_per_iter = _BASELINE_CACHE.get(base_key)
+        if base_per_iter is None:
+            base_per_iter = adaptive_slope(False, None) or 0.0
+            _BASELINE_CACHE[base_key] = base_per_iter
         # floor: never let noisy subtraction return <=0; 5% of the loop
         # body is a conservative lower bound for the op itself
-        return max(t_with - t_base, 0.05 * t_with) / inner
+        return max(per_iter - base_per_iter, 0.05 * per_iter)
     except Exception:
         return None
 
@@ -291,9 +394,10 @@ def calibrate(
     machine = machine or MachineSpec(num_nodes=1, devices_per_node=1, chip=chip_spec_for(device_kind))
     base = CostModel(machine)  # uncalibrated roofline
     cal = Calibration(device_kind=device_kind)
-    # the in-program repetition count amortizes dispatch overhead; on CPU
-    # (fallback validation only) 32 iterations of BERT-shaped ops cost
-    # minutes of wall clock for no extra signal — 8 suffices there
+    # ``inner`` only seeds the LOW trip count of the slope measurement
+    # (lo = inner // 4); the high trip count is sized adaptively from
+    # the readback floor and the analytic hint. Smaller seed on CPU
+    # (fallback validation only), where ops are slow and dispatch cheap.
     inner = 8 if device_kind == "cpu" else 32
     ratios: Dict[str, List[float]] = {}
     for op_type, params, specs in suite or default_suite():
@@ -302,8 +406,12 @@ def calibrate(
         analytic = base._roofline_time(
             *_work_of(op_def, params, specs, out_specs), specs[0].dtype
         )
-        measured = measure_lowered_op(op_type, params, specs, inner=inner)
-        if measured is None or analytic <= 0:
+        if analytic <= 0:
+            continue  # degenerate roofline: the ratio would be dropped anyway
+        measured = measure_lowered_op(
+            op_type, params, specs, inner=inner, analytic_hint=analytic
+        )
+        if measured is None:
             continue
         cal.entries[cost_key(op_type, params, specs, 1)] = measured
         ratios.setdefault(op_class(op_type), []).append(measured / analytic)

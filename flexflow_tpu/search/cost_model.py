@@ -101,12 +101,15 @@ class CostModel:
         flops = cost.flops / max(1, n_parts)
         bytes_hbm = cost.bytes_accessed / max(1, n_parts)
         dtype = input_specs[0].dtype if input_specs else DataType.FLOAT
-        fwd = self._roofline_time(flops, bytes_hbm, dtype) * self.calibration.derate(op_type)
+        roofline = self._roofline_time(flops, bytes_hbm, dtype)
+        fwd = roofline * self.calibration.derate(op_type)
         calibrated = self.calibration.lookup(op_type, params, input_specs, n_parts)
         if calibrated is not None:
             fwd = calibrated
         elif self.measure:
-            measured = self._try_measure(op_type, params, input_specs, n_parts)
+            measured = self._try_measure(
+                op_type, params, input_specs, n_parts, analytic_hint=roofline
+            )
             if measured is not None:
                 fwd = measured
         # backward ≈ 2x forward for matmul-dominated ops (dL/dx + dL/dw),
@@ -126,16 +129,20 @@ class CostModel:
         t_memory = bytes_hbm / (self.chip.hbm_bandwidth * HBM_EFFICIENCY)
         return max(t_compute, t_memory) + KERNEL_OVERHEAD
 
-    def _try_measure(self, op_type, params, input_specs, n_parts) -> Optional[float]:
+    def _try_measure(
+        self, op_type, params, input_specs, n_parts, analytic_hint=None
+    ) -> Optional[float]:
         """Measured calibration: jit the op's lowering on the default
         device and time it (the reference's inner_measure_operator_cost
-        on TPU); the result is written through to the on-disk cache."""
+        on TPU); the result is written through to the on-disk cache.
+        ``analytic_hint`` (the caller's roofline estimate) sizes the
+        timing loop so the measurement resolves without escalation."""
         key = (op_type, params, tuple((s.shape, s.dtype) for s in input_specs), n_parts)
         if key in self._measure_cache:
             return self._measure_cache[key]
         from .calibration import cost_key, measure_lowered_op
 
-        t = measure_lowered_op(op_type, params, input_specs, n_parts)
+        t = measure_lowered_op(op_type, params, input_specs, n_parts, analytic_hint=analytic_hint)
         self._measure_cache[key] = t  # type: ignore
         if t is not None:
             self.calibration.entries[cost_key(op_type, params, input_specs, n_parts)] = t

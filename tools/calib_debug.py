@@ -1,0 +1,142 @@
+"""Decompose the calibration harness's measured time on the real chip.
+
+Round-5 finding: quiet-chip derates (matmul 4.6, memory 15.5) match the
+round-3 "polluted" capture — the error is SYSTEMATIC, not contention.
+The committed entries say LayerNorm on (16,128,768) takes 3.39 ms
+(~170x the HBM roofline) while a 2048x768x3072 matmul takes 174 us
+(~2x) — small ops absorb a large overhead the matched-baseline
+subtraction should have cancelled.
+
+This script isolates the suspects, each timed exactly like
+measure_lowered_op (jit, scalar-readback flush, best-of-N):
+
+  A  dispatch+readback floor: an empty-ish program (scalar add)
+  B  readback jitter: 10 reps of the same tiny program
+  C  raw matmul fori_loop at inner=8/32/128 -> per-iter slope vs fixed
+     intercept (separates per-program overhead from per-iteration cost)
+  D  raw LayerNorm-equivalent loop, same inner sweep
+  E  the framework path (measure_lowered_op) on the same two ops for a
+     direct apples-to-apples delta
+
+Writes CALIB_DEBUG.json; prints one summary JSON line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+OUT = REPO / "CALIB_DEBUG.json"
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    print("initializing backend...", file=sys.stderr, flush=True)
+    backend = jax.default_backend()
+    print("backend:", backend, file=sys.stderr, flush=True)
+    kind = getattr(jax.devices()[0], "device_kind", backend)
+    res = {"backend": backend, "device_kind": kind, "steps": {}}
+
+    def timed(jitted, *args, reps=5):
+        float(jitted(*args))  # compile + warm
+        best = float("inf")
+        samples = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(jitted(*args))
+            dt = time.perf_counter() - t0
+            samples.append(dt)
+            best = min(best, dt)
+        return best, samples
+
+    # A/B: dispatch + readback floor and its jitter
+    tiny = jax.jit(lambda x: (x * 1.000001).sum())
+    x0 = jnp.ones((8,), jnp.float32)
+    floor, samples = timed(tiny, x0, reps=10)
+    res["steps"]["dispatch_readback_floor_ms"] = round(floor * 1e3, 3)
+    res["steps"]["dispatch_jitter_ms"] = [round(s * 1e3, 3) for s in samples]
+
+    # C: raw matmul loop, inner sweep (shape of the calibration LINEAR)
+    rs = np.random.RandomState(0)
+    a = jnp.asarray(rs.randn(2048, 768), jnp.bfloat16)
+    w = jnp.asarray(rs.randn(768, 3072) * 0.02, jnp.bfloat16)
+
+    def mm_fn(a, w, trip):
+        def body(i, acc):
+            ap = a + (acc * 1e-30).astype(a.dtype)
+            return acc + jnp.sum((ap @ w).astype(jnp.float32))
+        return jax.lax.fori_loop(0, trip, body, jnp.float32(0.0))
+
+    mm_j = jax.jit(mm_fn)  # trip is traced: ONE compile for the sweep
+    mm = {}
+    for inner in (8, 32, 128, 1024):
+        best, _ = timed(mm_j, a, w, jnp.int32(inner), reps=3)
+        mm[inner] = best
+    # slope between 32 and 128 isolates per-iteration cost
+    per_iter = (mm[1024] - mm[128]) / 896
+    intercept = mm[128] - 128 * per_iter
+    gf = 2 * 2048 * 768 * 3072 / 1e9
+    res["steps"]["matmul_loop_s"] = {str(k): round(v, 5) for k, v in mm.items()}
+    res["steps"]["matmul_per_iter_us"] = round(per_iter * 1e6, 2)
+    res["steps"]["matmul_fixed_overhead_ms"] = round(intercept * 1e3, 3)
+    res["steps"]["matmul_achieved_tflops"] = round(gf / max(per_iter, 1e-9) / 1e3, 1)
+
+    # D: raw LayerNorm-equivalent loop (shape of the calibration LN)
+    xseq = jnp.asarray(rs.randn(16, 128, 768), jnp.bfloat16)
+    g = jnp.ones((768,), jnp.float32)
+    b = jnp.zeros((768,), jnp.float32)
+
+    def ln_fn(x, g, b, trip):
+        def body(i, acc):
+            xp = (x + (acc * 1e-30).astype(x.dtype)).astype(jnp.float32)
+            mu = xp.mean(-1, keepdims=True)
+            var = ((xp - mu) ** 2).mean(-1, keepdims=True)
+            y = (xp - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+            return acc + jnp.sum(y)
+        return jax.lax.fori_loop(0, trip, body, jnp.float32(0.0))
+
+    ln_j = jax.jit(ln_fn)
+    ln = {}
+    for inner in (8, 128, 4096):
+        best, _ = timed(ln_j, xseq, g, b, jnp.int32(inner), reps=3)
+        ln[inner] = best
+    per_iter_ln = (ln[4096] - ln[128]) / 3968
+    res["steps"]["ln_loop_s"] = {str(k): round(v, 5) for k, v in ln.items()}
+    res["steps"]["ln_per_iter_us"] = round(per_iter_ln * 1e6, 2)
+    mb = 16 * 128 * 768 * 2 / 1e6
+    res["steps"]["ln_effective_gbps"] = round(3 * mb / 1e3 / max(per_iter_ln, 1e-9), 1)
+
+    # E: the framework path on the same two ops
+    from flexflow_tpu.core.types import DataType, OpType
+    from flexflow_tpu.core.parallel_tensor import TensorSpec
+    from flexflow_tpu.ops.linear import LinearParams
+    from flexflow_tpu.ops.norm import LayerNormParams
+    from flexflow_tpu.search.calibration import measure_lowered_op
+
+    t0 = time.time()
+    lin = measure_lowered_op(
+        OpType.LINEAR,
+        LinearParams(out_dim=3072, use_bias=True, dtype=DataType.BFLOAT16),
+        [TensorSpec((2048, 768), DataType.BFLOAT16)], inner=32)
+    lnm = measure_lowered_op(
+        OpType.LAYERNORM, LayerNormParams(axes=(2,), dtype=DataType.BFLOAT16),
+        [TensorSpec((16, 128, 768), DataType.BFLOAT16)], inner=32)
+    res["steps"]["framework_linear_us"] = round((lin or 0) * 1e6, 2)
+    res["steps"]["framework_ln_us"] = round((lnm or 0) * 1e6, 2)
+    res["steps"]["framework_seconds"] = round(time.time() - t0, 1)
+
+    tmp = OUT.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(res, indent=1) + "\n")
+    os.replace(tmp, OUT)
+    print(json.dumps(res["steps"]))
+
+
+if __name__ == "__main__":
+    main()

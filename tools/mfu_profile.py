@@ -1,0 +1,189 @@
+"""On-chip XLA profile: where does the non-MXU time go?
+
+VERDICT r4 missing #3: the MFU levers were landed but never profiled on
+the chip — "is flash attention actually MXU-bound at the chosen blocks?
+what does the pipeline shard_map boundary cost?". This tool captures a
+jax.profiler device trace of ONE traced training window (the same
+program bench.py times), parses the xplane protobuf, and reports the
+per-op device-time breakdown grouped into MXU (dot/conv fusions) vs
+vector/elementwise vs copy/layout vs infeed/outfeed vs collective time.
+
+Reference analog: the reference reads per-op measured costs out of its
+simulator to find hotspots (src/runtime/simulator.cc:588-628); on TPU
+the equivalent ground truth is the XLA device trace.
+
+Usage:  python tools/mfu_profile.py [--searched] [--batch 32] [--large]
+Output: MFU_PROFILE.json (durable, appended per run) + stdout summary.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+OUT = REPO / "MFU_PROFILE.json"
+
+
+def _categorize(name: str) -> str:
+    """Bucket an HLO/TPU op name into a hardware-unit category."""
+    n = name.lower()
+    if any(k in n for k in ("convolution", "dot", "einsum", "matmul")):
+        return "mxu"
+    if "fusion" in n:
+        # XLA names loop fusions "fusion.N"; a fusion containing a dot is
+        # usually named after it ("dot_fusion", handled above). Plain
+        # fusions are vector-unit elementwise work.
+        return "vpu_fusion"
+    if any(k in n for k in ("copy", "transpose", "reshape", "bitcast", "layout")):
+        return "copy_layout"
+    if any(k in n for k in ("all-reduce", "all-gather", "reduce-scatter",
+                            "collective", "permute", "send", "recv")):
+        return "collective"
+    if any(k in n for k in ("infeed", "outfeed", "host")):
+        return "host_transfer"
+    if any(k in n for k in ("reduce", "scatter", "gather", "sort", "select",
+                            "iota", "rng", "compare", "broadcast")):
+        return "vpu_other"
+    return "other"
+
+
+def parse_xspace(logdir: str) -> dict:
+    """Aggregate device-side event durations from the captured xplane."""
+    from tensorflow.core.profiler.protobuf import xplane_pb2  # type: ignore
+
+    files = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+    if not files:
+        return {"error": f"no xplane.pb under {logdir}"}
+    xspace = xplane_pb2.XSpace()
+    xspace.ParseFromString(open(sorted(files)[-1], "rb").read())
+
+    per_op: dict = defaultdict(float)
+    device_planes = 0
+    for plane in xspace.planes:
+        # device planes are named like "/device:TPU:0"; skip host threads
+        if "TPU" not in plane.name and "device" not in plane.name.lower():
+            continue
+        device_planes += 1
+        meta = {m.id: m.name for m in plane.event_metadata.values()}
+        for line in plane.lines:
+            # XLA op events live on the per-core "XLA Ops"/step lines
+            for ev in line.events:
+                name = meta.get(ev.metadata_id, "")
+                if not name:
+                    continue
+                per_op[name] += ev.duration_ps / 1e12  # -> seconds
+    if not per_op:
+        return {"error": f"no device events ({device_planes} device planes)"}
+
+    total = sum(per_op.values())
+    cats: dict = defaultdict(float)
+    for name, dur in per_op.items():
+        cats[_categorize(name)] += dur
+    top = sorted(per_op.items(), key=lambda kv: -kv[1])[:25]
+    return {
+        "device_planes": device_planes,
+        "total_device_s": round(total, 6),
+        "category_fractions": {k: round(v / total, 4)
+                               for k, v in sorted(cats.items(), key=lambda kv: -kv[1])},
+        "top_ops": [{"op": n[:120], "s": round(d, 6), "frac": round(d / total, 4)}
+                    for n, d in top],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--searched", action="store_true")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--large", action="store_true")
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--allow-cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    backend = jax.default_backend()
+    if backend == "cpu" and not args.allow_cpu:
+        print(json.dumps({"error": "no TPU; rerun with --allow-cpu for a smoke test"}))
+        sys.exit(2)
+
+    from bench import _bench_one, peak_flops_per_device, train_flops_per_token
+    from flexflow_tpu import DataType, FFConfig, LossType, SGDOptimizer
+    from flexflow_tpu.models import TransformerConfig, build_transformer
+
+    cfg = TransformerConfig(
+        num_layers=24 if args.large else 12,
+        hidden_size=1024 if args.large else 768,
+        num_heads=16 if args.large else 12,
+        ff_size=4096 if args.large else 3072,
+        seq_length=args.seq, dtype=DataType.BFLOAT16,
+    )
+    config = FFConfig(
+        batch_size=args.batch, workers_per_node=len(jax.devices()), num_nodes=1,
+        only_data_parallel=not args.searched,
+        search_budget=5 if args.searched else 0,
+    )
+    model = build_transformer(config, cfg)
+    model.compile(optimizer=SGDOptimizer(lr=0.01),
+                  loss_type=LossType.MEAN_SQUARED_ERROR)
+    ex = model.executor
+
+    # measured step time with the SAME helper bench.py uses, so the
+    # profile fractions can be read against the recorded MFU numbers
+    step_s = _bench_one(ex, args.batch, cfg, args.iters)
+
+    import jax.numpy as jnp
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(args.batch, cfg.seq_length, cfg.hidden_size), cfg.dtype.jnp)
+    y = jnp.asarray(rs.randn(args.batch, cfg.seq_length, cfg.hidden_size), cfg.dtype.jnp)
+    rng = jax.random.key(0)
+
+    logdir = str(REPO / ".profile" / time.strftime("%Y%m%d_%H%M%S"))
+    with jax.profiler.trace(logdir):
+        mets = ex.train_batch_repeated([x], y, rng, num_steps=args.iters)
+        float(mets["loss"])
+
+    breakdown = parse_xspace(logdir)
+
+    devs = jax.devices()
+    kind = getattr(devs[0], "device_kind", backend)
+    peak = peak_flops_per_device(kind, backend) * len(devs)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(ex.params))
+    fpt = train_flops_per_token(n_params, cfg.num_layers, cfg.seq_length, cfg.hidden_size)
+    mfu = (args.batch * cfg.seq_length / step_s) * fpt / peak
+
+    entry = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": backend, "device_kind": kind,
+        "config": {"large": args.large, "batch": args.batch, "seq": args.seq,
+                   "searched": args.searched},
+        "step_ms": round(step_s * 1e3, 3),
+        "mfu": round(mfu, 4),
+        "breakdown": breakdown,
+    }
+    data = {"what": "XLA device-trace breakdown of the timed training window",
+            "runs": []}
+    if OUT.exists():
+        try:
+            data = json.loads(OUT.read_text())
+        except json.JSONDecodeError:
+            pass
+    data["runs"].append(entry)
+    tmp = OUT.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(data, indent=1) + "\n")
+    os.replace(tmp, OUT)
+    print(json.dumps({k: entry[k] for k in ("backend", "step_ms", "mfu")} |
+                     {"categories": breakdown.get("category_fractions"),
+                      "top3": breakdown.get("top_ops", [])[:3]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,7 +13,7 @@ TPU that can die at any moment (the round-3 failure mode):
            sweep;
   Phase C  one bench.py run for the headline JSON + BENCH_RESULT.json.
 
-EVERY result is appended to BENCH_TPU_evidence_r4.json IMMEDIATELY so a
+EVERY result is appended to BENCH_TPU_evidence_r5.json IMMEDIATELY so a
 dead tunnel never erases progress. Run it the moment the chip answers:
 
     python tools/tpu_evidence.py [--skip-calibration] [--quick]
@@ -34,7 +34,7 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
-EVIDENCE = REPO / "BENCH_TPU_evidence_r4.json"
+EVIDENCE = REPO / "BENCH_TPU_evidence_r5.json"
 _CHILD = "_FF_EVIDENCE_CHILD"
 
 
@@ -44,7 +44,7 @@ def _load() -> dict:
             return json.loads(EVIDENCE.read_text())
         except json.JSONDecodeError:
             pass
-    return {"what": "round-4 on-chip evidence (idle calibration + MFU levers)",
+    return {"what": "round-5 on-chip evidence (idle calibration + MFU levers)",
             "runs": []}
 
 
