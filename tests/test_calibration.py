@@ -258,3 +258,18 @@ def test_cpu_mesh_predicted_rank_matches_measured_order():
     # rendezvous groups and bigger activation collectives), not a
     # rounding accident
     assert pred["tp"] > 1.2 * pred["hybrid"], pred
+
+
+def test_measure_integer_input_single_shot_path():
+    """Embedding's first input is integer (can't thread the timing loop's
+    carry through it), exercising the async single-shot fallback, which
+    subtracts the one readback round trip it contains."""
+    from flexflow_tpu.ops.embedding import EmbeddingParams
+
+    t = measure_lowered_op(
+        OpType.EMBEDDING,
+        EmbeddingParams(num_entries=1024, out_dim=64),
+        [TensorSpec((64, 16), DataType.INT32)],
+        reps=2,
+    )
+    assert t is not None and t > 0

@@ -79,7 +79,7 @@ def main():
     for inner in (8, 32, 128, 1024):
         best, _ = timed(mm_j, a, w, jnp.int32(inner), reps=3)
         mm[inner] = best
-    # slope between 32 and 128 isolates per-iteration cost
+    # slope between the two largest trip counts isolates per-iteration cost
     per_iter = (mm[1024] - mm[128]) / 896
     intercept = mm[128] - 128 * per_iter
     gf = 2 * 2048 * 768 * 3072 / 1e9

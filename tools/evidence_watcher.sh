@@ -9,5 +9,9 @@ for i in $(seq 1 90); do
   rc=$?
   echo "=== runner rc=$rc ===" >> .evidence_r5.log
   if [ $rc -eq 0 ]; then break; fi
+  if [ $rc -ne 2 ] && [ $rc -ne 3 ]; then
+    echo "=== unexpected rc=$rc: not a tunnel outage, stopping ===" >> .evidence_r5.log
+    break
+  fi
   sleep 300
 done

@@ -31,69 +31,51 @@ sys.path.insert(0, str(REPO))
 OUT = REPO / "MFU_PROFILE.json"
 
 
-def _categorize(name: str) -> str:
-    """Bucket an HLO/TPU op name into a hardware-unit category."""
-    n = name.lower()
-    if any(k in n for k in ("convolution", "dot", "einsum", "matmul")):
-        return "mxu"
-    if "fusion" in n:
-        # XLA names loop fusions "fusion.N"; a fusion containing a dot is
-        # usually named after it ("dot_fusion", handled above). Plain
-        # fusions are vector-unit elementwise work.
-        return "vpu_fusion"
-    if any(k in n for k in ("copy", "transpose", "reshape", "bitcast", "layout")):
-        return "copy_layout"
-    if any(k in n for k in ("all-reduce", "all-gather", "reduce-scatter",
-                            "collective", "permute", "send", "recv")):
-        return "collective"
-    if any(k in n for k in ("infeed", "outfeed", "host")):
-        return "host_transfer"
-    if any(k in n for k in ("reduce", "scatter", "gather", "sort", "select",
-                            "iota", "rng", "compare", "broadcast")):
-        return "vpu_other"
-    return "other"
-
-
 def parse_xspace(logdir: str) -> dict:
-    """Aggregate device-side event durations from the captured xplane."""
-    from tensorflow.core.profiler.protobuf import xplane_pb2  # type: ignore
+    """Per-op device-time breakdown via the xprof ``hlo_stats`` tool.
 
+    The converter ships its own HLO categorization (convolution fusion,
+    elementwise fusion, copy, all-reduce, ...), so the fractions below
+    use the profiler's official buckets rather than name heuristics.
+    """
     files = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
     if not files:
         return {"error": f"no xplane.pb under {logdir}"}
-    xspace = xplane_pb2.XSpace()
-    xspace.ParseFromString(open(sorted(files)[-1], "rb").read())
+    try:
+        from xprof.convert import raw_to_tool_data as r2t
 
-    per_op: dict = defaultdict(float)
-    device_planes = 0
-    for plane in xspace.planes:
-        # device planes are named like "/device:TPU:0"; skip host threads
-        if "TPU" not in plane.name and "device" not in plane.name.lower():
-            continue
-        device_planes += 1
-        meta = {m.id: m.name for m in plane.event_metadata.values()}
-        for line in plane.lines:
-            # XLA op events live on the per-core "XLA Ops"/step lines
-            for ev in line.events:
-                name = meta.get(ev.metadata_id, "")
-                if not name:
-                    continue
-                per_op[name] += ev.duration_ps / 1e12  # -> seconds
-    if not per_op:
-        return {"error": f"no device events ({device_planes} device planes)"}
+        data, _ctype = r2t.xspace_to_tool_data(sorted(files), "hlo_stats", {})
+    except Exception as e:  # tool matrix varies across installs
+        return {"error": f"hlo_stats conversion failed: {e!r}"}
+    s = data.decode() if isinstance(data, (bytes, bytearray)) else data
+    table = json.loads(s)
+    cols = [c["id"] for c in table.get("cols", [])]
+    try:
+        i_cat = cols.index("category")
+        i_name = cols.index("hlo_op_name")
+        i_self = cols.index("total_self_time")
+    except ValueError:
+        return {"error": f"unexpected hlo_stats columns: {cols}"}
 
-    total = sum(per_op.values())
+    per_op: dict = {}
     cats: dict = defaultdict(float)
-    for name, dur in per_op.items():
-        cats[_categorize(name)] += dur
+    for row in table.get("rows", []):
+        c = [cell.get("v") for cell in row["c"]]
+        self_us = float(c[i_self] or 0.0)
+        cats[str(c[i_cat])] += self_us
+        key = (str(c[i_cat]), str(c[i_name]))
+        per_op[key] = per_op.get(key, 0.0) + self_us
+    total = sum(cats.values())
+    if total <= 0:
+        return {"error": "hlo_stats reported zero device time"}
     top = sorted(per_op.items(), key=lambda kv: -kv[1])[:25]
     return {
-        "device_planes": device_planes,
-        "total_device_s": round(total, 6),
+        "total_device_us": round(total, 1),
         "category_fractions": {k: round(v / total, 4)
                                for k, v in sorted(cats.items(), key=lambda kv: -kv[1])},
-        "top_ops": [{"op": n[:120], "s": round(d, 6), "frac": round(d / total, 4)}
-                    for n, d in top],
+        "top_ops": [{"category": k[0], "op": k[1][:120],
+                     "us": round(us, 1), "frac": round(us / total, 4)}
+                    for k, us in top],
     }
 
 
@@ -105,9 +87,16 @@ def main():
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--allow-cpu", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (smoke test; the hosted "
+                         "sitecustomize force-selects the TPU otherwise)")
     args = ap.parse_args()
 
     import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        args.allow_cpu = True
     import numpy as np
 
     backend = jax.default_backend()
