@@ -62,6 +62,11 @@ class Calibration:
     # exact measured seconds per op signature (reference: the
     # hash_to_operator_cost cache, simulator.cc:588-628)
     entries: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # suite ops whose measurement never resolved above the jitter floor
+    # (cost keys). Persisted so a partial table is LOUD: consumers and
+    # the evidence log can see exactly which ops fell back to
+    # roofline x derate and which classes the derate geomean missed.
+    failed: List[str] = dataclasses.field(default_factory=list)
 
     def derate(self, op_type: OpType) -> float:
         return self.derates.get(op_class(op_type), 1.0)
@@ -80,6 +85,7 @@ class Calibration:
             device_kind=d.get("device_kind", "analytic"),
             derates=dict(d.get("derates", {})),
             entries=dict(d.get("entries", {})),
+            failed=list(d.get("failed", [])),
         )
 
     def save(self, path: Optional[Path] = None) -> Path:
@@ -278,7 +284,14 @@ def measure_lowered_op(
         # best-of-``reps`` min-filtering already suppresses the jitter
         # the multiple is guarding against
         resolve = min(max(0.25 if backend == "cpu" else 1.0, 12.0 * floor), 4.0)
-        CAP = 1 << 17
+        # trip cap bounds ITERATIONS, not wall time (hi is sized from
+        # resolve/est, <= ~4 s of device time per timing either way). It
+        # must be high enough that a ~1 us op can still accumulate
+        # enough total signal to clear the jitter-floor acceptance —
+        # 2^17 silently dropped BATCH_MATMUL/LAYERNORM/RELU on the v5e
+        # (4-6 us/iter tops out at ~0.6 s, under the ~1.2 s tunnel
+        # acceptance), skewing the class derates toward the big ops
+        CAP = 1 << 21
 
         def adaptive_slope(with_op: bool, est_hint: Optional[float]) -> Optional[float]:
             """Per-iteration slope, or None when it never resolved above
@@ -412,6 +425,7 @@ def calibrate(
             op_type, params, specs, inner=inner, analytic_hint=analytic
         )
         if measured is None:
+            cal.failed.append(cost_key(op_type, params, specs, 1))
             continue
         cal.entries[cost_key(op_type, params, specs, 1)] = measured
         ratios.setdefault(op_class(op_type), []).append(measured / analytic)

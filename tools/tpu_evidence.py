@@ -211,7 +211,7 @@ cal = calibrate(machine, device_kind={kind!r}, save=False)
 path = Path({str(REPO)!r}) / "flexflow_tpu" / "search" / "calibration_data" / f"opcosts_{{_slug({kind!r})}}.json"
 cal.save(path)
 cal.save()  # user cache copy (factory path above is the committed one)
-print(json.dumps({{"entries": len(cal.entries), "derates": cal.derates, "path": str(path)}}))
+print(json.dumps({{"entries": len(cal.entries), "derates": cal.derates, "failed": cal.failed, "path": str(path)}}))
 """
     rc, out, err, timed_out = _graceful_run(
         [sys.executable, "-c", code], env=dict(os.environ), timeout=1800
@@ -246,7 +246,7 @@ def main():
         cal, err = calibrate_idle(info["kind"])
         if cal is not None:
             _append({"phase": "calibration_idle", "seconds": round(time.time() - t0, 1),
-                     **{k: cal.get(k) for k in ("entries", "derates", "path")}})
+                     **{k: cal.get(k) for k in ("entries", "derates", "failed", "path")}})
         else:
             _append({"phase": "calibration_idle", "error": err})
 
