@@ -32,24 +32,51 @@ def on_tpu() -> bool:
     """True on real TPU backends (incl. the tunneled 'axon' platform)."""
     return jax.default_backend() in ("tpu", "axon")
 
-# default sequence block sizes; 128 matches the MXU systolic dimension.
-# Env-overridable (FF_FLASH_BLOCK_Q/K) so the on-chip evidence runner can
-# sweep block configurations across clean child processes. Read once at
-# import; malformed values fall back to the default rather than breaking
-# every import of the package.
+# Sequence block sizes. 128 matches the MXU systolic dimension, but the
+# round-5 on-chip sweep (BENCH_TPU_evidence_r5.json, seq 512) measured
+# 256x256 blocks 1.49x faster than 128x128 (fewer grid invocations and
+# online-softmax rescale passes per output row), while 512x512 never
+# finished compiling inside a 20-minute child budget. Default policy:
+# the largest block in _BLOCK_CANDIDATES that divides the sequence, so
+# long sequences get the measured winner and seq 128 keeps 128.
+# Env-overridable (FF_FLASH_BLOCK_Q/K) for sweeps across clean child
+# processes; read once at import; malformed values fall back to the
+# adaptive policy rather than breaking every import of the package.
 import os as _os
 
+_BLOCK_CANDIDATES = (256, 128)
 
-def _env_block(name: str, default: int = 128) -> int:
+
+def _env_block(name: str) -> Optional[int]:
+    raw = _os.environ.get(name)
+    if raw is None:
+        return None
     try:
-        v = int(_os.environ.get(name, default))
+        v = int(raw)
     except (TypeError, ValueError):
-        return default
-    return v if v > 0 else default
+        return None
+    return v if v > 0 else None
 
 
-DEFAULT_BLOCK_Q = _env_block("FF_FLASH_BLOCK_Q")
-DEFAULT_BLOCK_K = _env_block("FF_FLASH_BLOCK_K")
+ENV_BLOCK_Q = _env_block("FF_FLASH_BLOCK_Q")
+ENV_BLOCK_K = _env_block("FF_FLASH_BLOCK_K")
+
+
+def pick_block(seq: int, env: Optional[int]) -> int:
+    """Effective block for a sequence length: the env override clamped
+    to the sequence, else the largest default candidate dividing it,
+    else the largest power-of-two divisor (a non-dividing block would
+    leave sq // bq grid steps covering only a prefix of the rows)."""
+    if env is not None:
+        return min(env, seq)
+    for b in _BLOCK_CANDIDATES + (64, 32, 16, 8):
+        if seq >= b and seq % b == 0:
+            return b
+    return seq
+
+
+def effective_blocks(sq: int, sk: int) -> Tuple[int, int]:
+    return pick_block(sq, ENV_BLOCK_Q), pick_block(sk, ENV_BLOCK_K)
 
 
 def supports_shapes(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...]) -> bool:
@@ -61,8 +88,7 @@ def supports_shapes(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...]) -> bool:
     _, sk, _, _ = k_shape
     if d not in (64, 128, 256):
         return False
-    bq = min(DEFAULT_BLOCK_Q, sq)
-    bk = min(DEFAULT_BLOCK_K, sk)
+    bq, bk = effective_blocks(sq, sk)
     # sequence lengths must tile into blocks and respect the (8, 128)
     # sublane/lane tiling of the TPU vector memory
     return sq % bq == 0 and sk % bk == 0 and sq % 8 == 0 and sk % 8 == 0 and sq >= 8 and sk >= 8
@@ -121,6 +147,12 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     sk = k.shape[2]
     bq = min(block_q, sq)
     bk = min(block_k, sk)
+    if sq % bq or sk % bk:
+        # a non-dividing block would silently compute only the first
+        # (sq // bq) * bq query rows — fail loudly instead
+        raise ValueError(
+            f"sequence lengths ({sq}, {sk}) not divisible by blocks ({bq}, {bk})"
+        )
     grid = (b, h, sq // bq)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, block_k=bk, sk=sk)
     o, lse = pl.pallas_call(
@@ -296,15 +328,21 @@ def flash_attention(
     v: jax.Array,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention over [B, S, H, D] tensors (differentiable).
 
-    ``interpret=None`` auto-selects Pallas interpret mode off-TPU so the
-    same code path is testable on the CPU mesh.
+    ``block_q``/``block_k`` default to the adaptive policy (env override
+    or the largest candidate dividing the sequence). ``interpret=None``
+    auto-selects Pallas interpret mode off-TPU so the same code path is
+    testable on the CPU mesh.
     """
+    if block_q is None:
+        block_q = pick_block(q.shape[1], ENV_BLOCK_Q)
+    if block_k is None:
+        block_k = pick_block(k.shape[1], ENV_BLOCK_K)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:

@@ -156,13 +156,41 @@ def test_search_proposes_context_parallelism_for_long_sequences():
 
 def test_flash_env_block_rejects_nonpositive(monkeypatch):
     """ADVICE r4: FF_FLASH_BLOCK_Q=0 (or negative) must fall back to the
-    default rather than arming a ZeroDivisionError in supports_shapes."""
+    adaptive policy rather than arming a ZeroDivisionError in
+    supports_shapes."""
     from flexflow_tpu.ops.kernels.flash_attention import _env_block
 
     for bad in ("0", "-64", "nonsense", ""):
         monkeypatch.setenv("FF_TEST_BLOCK", bad)
-        assert _env_block("FF_TEST_BLOCK") == 128, bad
+        assert _env_block("FF_TEST_BLOCK") is None, bad
     monkeypatch.setenv("FF_TEST_BLOCK", "256")
     assert _env_block("FF_TEST_BLOCK") == 256
     monkeypatch.delenv("FF_TEST_BLOCK")
-    assert _env_block("FF_TEST_BLOCK") == 128
+    assert _env_block("FF_TEST_BLOCK") is None
+
+
+def test_flash_adaptive_block_policy(monkeypatch):
+    """Round-5 on-chip sweep: 256 blocks beat 128 by 1.49x at seq 512,
+    so the default picks the largest candidate dividing the sequence —
+    while seq not divisible by 256 (e.g. 384) must keep flash via 128
+    instead of silently falling back to dense."""
+    from flexflow_tpu.ops.kernels import flash_attention as fa
+    from flexflow_tpu.ops.kernels.flash_attention import (
+        effective_blocks,
+        pick_block,
+        supports_shapes,
+    )
+
+    # isolate from a leaked FF_FLASH_BLOCK_Q/K (captured at import)
+    monkeypatch.setattr(fa, "ENV_BLOCK_Q", None)
+    monkeypatch.setattr(fa, "ENV_BLOCK_K", None)
+
+    assert pick_block(512, None) == 256
+    assert pick_block(128, None) == 128
+    assert pick_block(384, None) == 128  # 384 % 256 != 0
+    assert pick_block(64, None) == 64  # clamp below smallest candidate
+    assert pick_block(512, 128) == 128  # env override wins
+    assert pick_block(64, 512) == 64  # override still clamped to seq
+    assert effective_blocks(512, 512) == (256, 256)
+    for seq in (128, 256, 384, 512, 1024):
+        assert supports_shapes((2, seq, 4, 64), (2, seq, 4, 64)), seq

@@ -150,8 +150,7 @@ def child_main(payload: dict):
     # must not masquerade as a flash measurement
     from flexflow_tpu.ops.kernels import flash_attention as _fa
 
-    bq = min(_fa.DEFAULT_BLOCK_Q, cfg.seq_length)
-    bk = min(_fa.DEFAULT_BLOCK_K, cfg.seq_length)
+    bq, bk = _fa.effective_blocks(cfg.seq_length, cfg.seq_length)
     head_dim = cfg.hidden_size // cfg.num_heads
     qshape = (batch, cfg.seq_length, cfg.num_heads, head_dim)
     flash_active = bool(_fa.supports_shapes(qshape, qshape))
@@ -241,6 +240,26 @@ def main():
         sys.exit(2)
     print(f"TPU up: {info}", file=sys.stderr)
 
+    # resume: the watcher re-runs this script whole after a mid-run
+    # tunnel death; configs that already recorded a measurement (and a
+    # calibration that resolved its full suite) must not re-burn chip
+    # time or — worse — re-trigger the timeout that wedged the tunnel
+    prior = _load()["runs"]
+    done = {r.get("config") for r in prior if r.get("phase") in ("lever", "flash_block_sweep")
+            and "step_ms" in r}
+    # a capture recorded WITH a "failed" field came from the loud-partial
+    # calibration code (post-d013d8d, 2^21 trip cap); one such capture is
+    # the best this hardware session can do — recapturing on every
+    # resume would re-burn ~95s of quiet-chip time and re-expose the
+    # run to the calibration-timeout wedge risk. Pre-d013d8d captures
+    # (no "failed" key, 2^17 cap known to drop small ops) don't count.
+    have_new_capture = any(
+        r.get("phase") == "calibration_idle" and r.get("entries") and "failed" in r
+        for r in prior
+    )
+    if have_new_capture:
+        args.skip_calibration = True
+
     if not args.skip_calibration:
         t0 = time.time()
         cal, err = calibrate_idle(info["kind"])
@@ -259,20 +278,29 @@ def main():
         ("bert_large_b16_dp", {**BERT_LARGE, "batch": 16, "iters": 12}),
         ("bert_large_b32_dp", {**BERT_LARGE, "batch": 32, "iters": 12}),
         ("bert_base_b32_searched", {**BERT_BASE, "batch": 32, "searched": True}),
+        # BASELINE.json's north star is BERT-LARGE under a SEARCHED
+        # strategy (>=45% MFU), not just dp
+        ("bert_large_b16_searched", {**BERT_LARGE, "batch": 16, "iters": 12,
+                                     "searched": True}),
     ]
     if args.quick:
         configs = configs[:2]
 
     # flash block sweep needs seq >= block or the kernel clamps every
     # config back to the 128x128 baseline: sweep at seq 512, batch 8
+    # 512x512 is deliberately absent: measured as a >20-minute Pallas
+    # compile timeout whose SIGKILL'd child wedged the tunnel (evidence
+    # runs 12-13); the winner at seq 512 is 256x256 (1.49x over 128)
     sweep = [] if args.quick else [
         (f"seq512_bq{bq}_bk{bk}",
          {**BERT_BASE, "batch": 8, "seq": 512, "iters": 12,
           "FF_FLASH_BLOCK_Q": bq, "FF_FLASH_BLOCK_K": bk},
          "flash_block_sweep")
-        for bq, bk in ((128, 128), (256, 256), (512, 512), (128, 256), (256, 128))
+        for bq, bk in ((128, 128), (256, 256), (128, 256), (256, 128))
     ]
     for name, payload, phase in [(n, p, "lever") for n, p in configs] + sweep:
+        if name in done:
+            continue
         obj, err = _run_child(payload, timeout=1200)
         _append({"phase": phase, "config": name, **(obj or {"error": err})})
         if obj is None and "timeout" in (err or ""):
@@ -282,18 +310,27 @@ def main():
                 _append({"phase": "abort", "reason": "tunnel unresponsive after child timeout"})
                 sys.exit(3)
 
-    # Phase C: headline bench (writes BENCH_RESULT.json durably)
-    rc, out, err, timed_out = _graceful_run(
-        [sys.executable, str(REPO / "bench.py")], env=dict(os.environ), timeout=3000
-    )
-    if timed_out:
-        _append({"phase": "bench_headline", "error": "timeout"})
-    else:
+    # Phases C/D: like the lever configs, a phase that already succeeded
+    # must not re-burn chip time on a watcher resume.
+    done_phases = {r.get("phase") for r in prior if r.get("rc") == 0}
+
+    def run_phase(phase: str, cmd, timeout: float, cap: int, env=None):
+        if phase in done_phases:
+            return
+        rc, out, err, timed_out = _graceful_run(cmd, env=env or dict(os.environ),
+                                                timeout=timeout)
+        if timed_out:
+            _append({"phase": phase, "error": "timeout"})
+            return
         line = out.strip().splitlines()[-1] if out.strip() else ""
-        entry = {"phase": "bench_headline", "rc": rc, "stdout": line[:2000]}
+        entry = {"phase": phase, "rc": rc, "stdout": line[:cap]}
         if rc != 0:
             entry["error"] = (err or "")[-400:]
         _append(entry)
+
+    # Phase C: headline bench (writes BENCH_RESULT.json durably)
+    run_phase("bench_headline", [sys.executable, str(REPO / "bench.py")],
+              timeout=3000, cap=2000)
 
     # Phase D: the serving comparison ON-CHIP (VERDICT r4 ask #8 fold-in:
     # SERVING_BENCH.json's CPU numbers show the server winning via
@@ -301,18 +338,9 @@ def main():
     # additionally turns many tiny tunnel dispatches into one MXU batch)
     senv = dict(os.environ)
     senv["PYTHONPATH"] = f"{REPO}:{senv.get('PYTHONPATH', '')}".rstrip(":")
-    rc, out, err, timed_out = _graceful_run(
-        [sys.executable, str(REPO / "examples" / "serving_bench.py")],
-        env=senv, timeout=1500,
-    )
-    if timed_out:
-        _append({"phase": "serving_onchip", "error": "timeout"})
-    else:
-        line = out.strip().splitlines()[-1] if out.strip() else ""
-        entry = {"phase": "serving_onchip", "rc": rc, "stdout": line[:4000]}
-        if rc != 0:
-            entry["error"] = (err or "")[-400:]
-        _append(entry)
+    run_phase("serving_onchip",
+              [sys.executable, str(REPO / "examples" / "serving_bench.py")],
+              timeout=1500, cap=4000, env=senv)
     print("evidence complete:", EVIDENCE, file=sys.stderr)
 
 
