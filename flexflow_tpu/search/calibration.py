@@ -92,7 +92,7 @@ class Calibration:
         path = path or (cache_dir() / f"opcosts_{_slug(self.device_kind)}.json")
         path.parent.mkdir(parents=True, exist_ok=True)
         tmp = path.with_suffix(".tmp")
-        tmp.write_text(self.to_json())
+        tmp.write_text(self.to_json() + "\n")
         tmp.replace(path)
         return path
 
