@@ -341,6 +341,14 @@ def main():
     run_phase("serving_onchip",
               [sys.executable, str(REPO / "examples" / "serving_bench.py")],
               timeout=1500, cap=4000, env=senv)
+
+    # Phase E: XLA device-trace breakdown of the best-MFU config
+    # (BERT-Large b16, 53.1%) — where does the residual non-MXU time
+    # go? (VERDICT r4 missing #3; writes MFU_PROFILE.json durably)
+    run_phase("mfu_profile_large",
+              [sys.executable, str(REPO / "tools" / "mfu_profile.py"),
+               "--large", "--batch", "16", "--iters", "8"],
+              timeout=1500, cap=2000)
     print("evidence complete:", EVIDENCE, file=sys.stderr)
 
 
