@@ -356,7 +356,9 @@ def default_suite(dtype: DataType = DataType.BFLOAT16) -> List[Tuple[OpType, obj
     MXU-friendly sizes (the shapes BERT-class models actually run)."""
     from ..ops.attention import MultiHeadAttentionParams
     from ..ops.batch_matmul import BatchMatmulParams
+    from ..ops.conv import Conv2DParams
     from ..ops.elementwise import ElementUnaryParams
+    from ..ops.embedding import EmbeddingParams
     from ..ops.linear import LinearParams
     from ..ops.norm import LayerNormParams
     from ..ops.softmax import SoftmaxParams
@@ -365,6 +367,19 @@ def default_suite(dtype: DataType = DataType.BFLOAT16) -> List[Tuple[OpType, obj
     x = TensorSpec((B * S, H), dtype)
     seq = TensorSpec((B, S, H), dtype)
     return [
+        # vision + embedding coverage (ResNet stage-2-ish conv; BERT
+        # vocab-sized gather, integer input -> single-shot path)
+        (
+            OpType.CONV2D,
+            Conv2DParams(out_channels=128, kernel=(3, 3), stride=(1, 1),
+                         padding=(1, 1), dtype=dtype),
+            [TensorSpec((16, 64, 56, 56), dtype)],
+        ),
+        (
+            OpType.EMBEDDING,
+            EmbeddingParams(num_entries=30522, out_dim=H, dtype=dtype),
+            [TensorSpec((B, S), DataType.INT32)],
+        ),
         (OpType.LINEAR, LinearParams(out_dim=F, use_bias=True, dtype=dtype), [x]),
         (OpType.LINEAR, LinearParams(out_dim=H, use_bias=True, dtype=dtype), [TensorSpec((B * S, F), dtype)]),
         (
