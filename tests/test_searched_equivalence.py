@@ -250,8 +250,12 @@ def test_single_device_searched_lowers_to_same_program_as_dp():
     cfg = TransformerConfig(num_layers=2, hidden_size=128, num_heads=4,
                             ff_size=256, seq_length=128, dtype=DataType.BFLOAT16)
 
+    start = next(PCGraph._guid_counter)
+
     def lowered_text(only_dp, budget):
-        PCGraph._guid_counter = itertools.count(5000)
+        # both builds mint identical guids, from wherever the global
+        # counter currently stands (never rewound below `start`)
+        PCGraph._guid_counter = itertools.count(start + 1)
         config = FFConfig(batch_size=8, workers_per_node=1, num_nodes=1,
                           only_data_parallel=only_dp, search_budget=budget)
         m = build_transformer(config, cfg)
@@ -268,5 +272,6 @@ def test_single_device_searched_lowers_to_same_program_as_dp():
     try:
         assert lowered_text(True, 0) == lowered_text(False, 5)
     finally:
-        # leave the global counter clear of every guid this test minted
-        PCGraph._guid_counter = itertools.count(20000)
+        # advance the global counter past every guid this test minted
+        # (one build mints < 1000 nodes; never move the counter backward)
+        PCGraph._guid_counter = itertools.count(start + 2000)
