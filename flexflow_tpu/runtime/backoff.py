@@ -1,0 +1,25 @@
+"""Shared exponential-backoff-with-jitter delay computation.
+
+One formula, two consumers: the serving RetryPolicy
+(serving/resilience.py) and ElasticTrainer restarts (runtime/elastic.py)
+— so a tuning change (jitter shape, cap semantics) can never silently
+diverge between them.
+"""
+from __future__ import annotations
+
+import random
+
+
+def backoff_delay(
+    attempt: int,
+    *,
+    base_s: float,
+    max_s: float,
+    jitter: float,
+    rng: random.Random,
+) -> float:
+    """Delay before retry number ``attempt`` (1-based): exponential
+    ``base_s * 2**(attempt-1)`` capped at ``max_s``, stretched by up to
+    ``jitter`` fractional seeded noise."""
+    delay = min(max_s, base_s * (2 ** (attempt - 1)))
+    return delay * (1.0 + jitter * rng.random())

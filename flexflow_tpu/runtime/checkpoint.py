@@ -59,6 +59,9 @@ def _opt_rekey(opt_state: Any, mapping: Dict[str, str]) -> Any:
 
 def save_checkpoint(path: str, executor, step: int = 0, strategy=None) -> None:
     """Write a checkpoint directory: orbax pytree + strategy.json."""
+    from . import faults
+
+    faults.inject("checkpoint.save", path)  # chaos hook: storage failure
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
     fwd = _canon_map(executor)
@@ -128,11 +131,18 @@ class CheckpointManager:
         return sorted(out)
 
     def save(self, executor, step: int, strategy=None) -> str:
-        p = os.path.join(self.directory, f"step_{step}")
-        save_checkpoint(p, executor, step=step, strategy=strategy)
-        for s in self._steps()[: -self.max_to_keep]:
-            import shutil
+        import shutil
 
+        p = os.path.join(self.directory, f"step_{step}")
+        try:
+            save_checkpoint(p, executor, step=step, strategy=strategy)
+        except Exception:
+            # a failed save must not leave a partial step dir that a
+            # later restore_latest would pick as "newest"; the previous
+            # checkpoints stay untouched and usable
+            shutil.rmtree(p, ignore_errors=True)
+            raise
+        for s in self._steps()[: -self.max_to_keep]:
             shutil.rmtree(os.path.join(self.directory, f"step_{s}"), ignore_errors=True)
         return p
 
@@ -141,8 +151,19 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def restore_latest(self, executor) -> Optional[int]:
-        s = self.latest_step()
-        if s is None:
-            return None
-        restore_checkpoint(os.path.join(self.directory, f"step_{s}"), executor)
-        return s
+        """Restore the newest restorable checkpoint, falling back to
+        older ones if the newest is corrupt/partial (e.g. the process
+        died mid-save). Returns the restored step, None when the
+        directory holds no checkpoints, and re-raises the newest error
+        when every candidate is unreadable."""
+        last_err: Optional[Exception] = None
+        for s in reversed(self._steps()):
+            try:
+                restore_checkpoint(os.path.join(self.directory, f"step_{s}"), executor)
+                return s
+            except Exception as e:  # corrupt/partial: try the previous one
+                if last_err is None:
+                    last_err = e
+        if last_err is not None:
+            raise last_err
+        return None

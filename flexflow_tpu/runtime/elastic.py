@@ -5,26 +5,38 @@ The reference has NO failure detection or elastic recovery (SURVEY.md §5
 module is a new capability layered on the orbax checkpoint subsystem
 (runtime/checkpoint.py): a training driver that
 
-  * checkpoints every ``checkpoint_every`` steps (counting from the last
-    restore, so a crash loses at most one interval);
+  * checkpoints every ``checkpoint_every`` steps through a rolling
+    :class:`CheckpointManager` (a save failure can therefore never
+    clobber the previous good checkpoint — each step saves into its own
+    ``step_N`` directory and partial saves are deleted);
   * on a step failure (preempted device, transport error, poisoned
-    input), restores the latest checkpoint and retries, up to
-    ``max_restarts`` times;
+    input), restores the latest restorable checkpoint and retries, up to
+    ``max_restarts`` times — waiting out an exponential backoff with
+    seeded jitter between attempts instead of hammering a dying device
+    with immediate retries;
   * detects non-finite losses (the practical TPU failure mode XLA won't
     raise on) and treats them as failures too, rolling back to the last
     good state instead of training onward from NaNs.
 
-On multi-host jobs every process runs the same loop; orbax coordinates
-the save across processes, and a restart re-enters through the same
-checkpoint directory.
+Chaos hook: each step passes through the ``elastic.step`` injection site
+(runtime/faults.py), so recovery paths are testable without real device
+loss. On multi-host jobs every process runs the same loop; orbax
+coordinates the save across processes, and a restart re-enters through
+the same checkpoint directory.
 """
 from __future__ import annotations
 
 import dataclasses
 import math
+import random
+import time
 from typing import Callable, Dict, List, Optional
 
 import jax
+
+from . import faults
+from .backoff import backoff_delay
+from .checkpoint import CheckpointManager
 
 
 @dataclasses.dataclass
@@ -35,15 +47,18 @@ class ElasticReport:
     restarts: int = 0
     checkpoints_saved: int = 0
     failures: List[str] = dataclasses.field(default_factory=list)
+    backoffs: List[float] = dataclasses.field(default_factory=list)  # seconds slept per failure
     final_loss: float = float("nan")
 
 
 class ElasticTrainer:
     """Failure-tolerant training loop around a compiled FFModel.
 
-    ``model`` must be compiled; ``path`` is the checkpoint directory.
-    ``fail_on_nonfinite`` converts NaN/Inf losses into recoverable
-    failures (restore + retry) instead of silent divergence.
+    ``model`` must be compiled; ``path`` is the checkpoint directory
+    (managed as rolling ``step_N`` subdirectories, ``max_to_keep`` most
+    recent kept). ``fail_on_nonfinite`` converts NaN/Inf losses into
+    recoverable failures (restore + retry) instead of silent divergence.
+    ``sleep`` is injectable so tests observe backoffs without waiting.
     """
 
     def __init__(
@@ -53,6 +68,12 @@ class ElasticTrainer:
         checkpoint_every: int = 50,
         max_restarts: int = 3,
         fail_on_nonfinite: bool = True,
+        max_to_keep: int = 2,
+        backoff_base_s: float = 0.1,
+        backoff_max_s: float = 5.0,
+        backoff_jitter: float = 0.25,
+        seed: int = 0,
+        sleep: Callable[[float], None] = time.sleep,
     ):
         if model.executor is None:
             raise ValueError("compile() the model before elastic training")
@@ -61,13 +82,35 @@ class ElasticTrainer:
         self.checkpoint_every = max(1, checkpoint_every)
         self.max_restarts = max_restarts
         self.fail_on_nonfinite = fail_on_nonfinite
+        self.manager = CheckpointManager(path, max_to_keep=max_to_keep)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.backoff_jitter = backoff_jitter
+        self._rng = random.Random(f"elastic|{seed}")
+        self._sleep = sleep
+        self._consecutive_failures = 0
 
     # ----------------------------------------------------------- plumbing
     def _save(self, step: int) -> None:
-        self.model.save_checkpoint(self.path, step=step)
+        self.manager.save(self.model.executor, step, strategy=self.model.strategy)
 
-    def _restore(self) -> int:
-        return self.model.load_checkpoint(self.path)
+    def _restore(self) -> Optional[int]:
+        """Latest restorable step, or None when nothing is saved yet."""
+        return self.manager.restore_latest(self.model.executor)
+
+    def _backoff(self, report: ElasticReport) -> None:
+        """Exponential backoff with jitter between restarts; resets after
+        any successful step. Recorded per-failure in the report."""
+        self._consecutive_failures += 1
+        delay = backoff_delay(
+            self._consecutive_failures,
+            base_s=self.backoff_base_s,
+            max_s=self.backoff_max_s,
+            jitter=self.backoff_jitter,
+            rng=self._rng,
+        )
+        report.backoffs.append(delay)
+        self._sleep(delay)
 
     # ---------------------------------------------------------------- run
     def run(
@@ -84,9 +127,9 @@ class ElasticTrainer:
         rng = rng if rng is not None else jax.random.key(0)
         report = ElasticReport()
         step = 0
-        last_saved = -1
         while step < num_steps:
             try:
+                faults.inject("elastic.step", step)
                 inputs, labels = batches(step)
                 # per-step rng (fit() splits per step the same way);
                 # folding the step index keeps replay deterministic
@@ -102,13 +145,16 @@ class ElasticTrainer:
                         f"elastic training exhausted {self.max_restarts} restarts"
                     ) from e
                 report.restarts += 1
-                if last_saved >= 0:
-                    step = self._restore()
+                self._backoff(report)
+                restored = self._restore()
+                if restored is not None:
+                    step = restored
                 else:
                     # nothing saved yet: re-initialize from scratch
                     self.model.executor.initialize(jax.random.key(self.model._seed))
                     step = 0
                 continue
+            self._consecutive_failures = 0
             report.final_loss = loss
             if on_step is not None:
                 on_step(step, mets)
@@ -117,7 +163,25 @@ class ElasticTrainer:
             # restore don't count twice
             report.steps_completed = step
             if step % self.checkpoint_every == 0 or step == num_steps:
-                self._save(step)
-                last_saved = step
-                report.checkpoints_saved += 1
+                try:
+                    self._save(step)
+                    report.checkpoints_saved += 1
+                except Exception as e:
+                    # a failed save must not kill the run NOR poison the
+                    # previous checkpoint (the manager deletes the partial
+                    # step dir); training state in memory is still good,
+                    # so keep going — bounded by the same restart budget
+                    report.failures.append(f"save at step {step}: {e!r}")
+                    if step >= num_steps:
+                        # training itself is complete: record the failure
+                        # and return the finished run rather than burning
+                        # a restart (or raising) over a checkpoint write
+                        # with nothing left to protect
+                        break
+                    if report.restarts >= self.max_restarts:
+                        raise RuntimeError(
+                            f"elastic training exhausted {self.max_restarts} restarts"
+                        ) from e
+                    report.restarts += 1
+                    self._backoff(report)
         return report

@@ -27,7 +27,7 @@ from ..core.types import CompMode, LossType, MetricsType, OpType
 from ..ops.base import LowerCtx, get_op_def
 from ..parallel.propagation import infer_all_specs
 from ..parallel.strategy import ParallelStrategy, to_partition_spec
-from . import initializers, losses, metrics as metrics_mod
+from . import faults, initializers, losses, metrics as metrics_mod
 from .optimizers import Optimizer
 
 
@@ -820,6 +820,9 @@ class CompiledExecutor:
             self.opt_state["lr"] = jnp.asarray(lr, jnp.float32)
 
     def train_batch(self, inputs: Sequence[jax.Array], label: jax.Array, rng: jax.Array) -> Dict[str, Any]:
+        # chaos hook (no-op unless a FaultPlan is installed): rules can
+        # raise a device error, stall, or NaN-poison the batch
+        inputs = faults.inject("executor.train_batch", inputs)
         inputs = self._shard_inputs(inputs)
         if jax.process_count() > 1:
             label = self.shard_label(label)
@@ -945,7 +948,9 @@ class CompiledExecutor:
         inputs = self._shard_inputs(inputs)
         if rng is None:
             rng = jax.random.key(0)
-        return self._forward(self.params, self.state, tuple(inputs), rng)
+        outs = self._forward(self.params, self.state, tuple(inputs), rng)
+        # chaos hook: error / stall / NaN-poisoned outputs
+        return faults.inject("executor.predict", outs)
 
     def input_shardings(self):
         """(per-input NamedShardings, label sharding). Labels share the

@@ -1,0 +1,237 @@
+"""Deterministic fault injection for chaos testing the runtime + serving
+stack.
+
+The reference FlexFlow has no failure handling at all (SURVEY.md §5) and
+therefore nothing to test failures *with*. This module provides the
+missing half: named injection sites threaded through the hot paths, and
+a seedable :class:`FaultPlan` that decides — deterministically — which
+calls to a site fail, stall, or get poisoned.
+
+Design constraints:
+
+* **Zero cost when disabled.** ``inject(site, value)`` is a single
+  function call guarded by a module-global ``None`` check; no dict
+  lookups, no locks, no allocation on the hot path unless a plan is
+  installed.
+* **Deterministic under a fixed seed.** Probability triggers draw from a
+  per-rule ``random.Random`` seeded from ``(plan seed, site, rule
+  index)`` via the string-seeding path (stable across processes, unlike
+  ``hash()``). Call counting is per-site and lock-protected, so a given
+  single-threaded call sequence always fires the same faults.
+
+Injection sites currently threaded through the codebase:
+
+  ``executor.train_batch``      before each train dispatch (value = inputs)
+  ``executor.predict``          around the forward outputs (value = outputs)
+  ``elastic.step``              top of each ElasticTrainer step
+  ``serving.model.infer``       before a served model's device call (value = inputs)
+  ``serving.batcher.dispatch``  before the batcher runs a device batch (value = requests)
+  ``serving.repository.load``   before a repository model load
+  ``checkpoint.save``           top of save_checkpoint
+
+Usage::
+
+    plan = FaultPlan(seed=0)
+    plan.on("serving.model.infer", mode="error",
+            error=TransientDeviceError("preempted"), nth=(0,))
+    with plan.active():
+        ...  # first device call raises, later ones succeed
+    assert plan.fired("serving.model.infer") == 1
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class FaultInjected(RuntimeError):
+    """Generic injected failure (non-retryable poison)."""
+
+
+class TransientDeviceError(RuntimeError):
+    """Injected analog of a recoverable device fault (preemption,
+    transport hiccup); serving retry policies treat this as retryable."""
+
+
+# Module-global active plan. ``inject`` reads this exactly once per call;
+# when no plan is installed the call is a no-op returning its value.
+_PLAN: Optional["FaultPlan"] = None
+
+
+def inject(site: str, value: Any = None) -> Any:
+    """Injection-site hook. Returns ``value`` (possibly poisoned), or
+    raises / stalls per the active plan's rules for ``site``."""
+    plan = _PLAN
+    if plan is None:  # zero-cost no-op guard (hot path)
+        return value
+    return plan._fire(site, value)
+
+
+def active_plan() -> Optional["FaultPlan"]:
+    return _PLAN
+
+
+def _poison(value: Any) -> Any:
+    """NaN-poison array-like leaves of ``value`` (lists/tuples of arrays,
+    single arrays, dicts); non-float leaves pass through unchanged."""
+    if isinstance(value, (list, tuple)):
+        return type(value)(_poison(v) for v in value)
+    if isinstance(value, dict):
+        return {k: _poison(v) for k, v in value.items()}
+    try:
+        arr = np.asarray(value)
+    except Exception:
+        return value
+    if arr.dtype.kind != "f":
+        return value
+    return np.full_like(arr, np.nan)
+
+
+@dataclasses.dataclass(eq=False)  # identity equality: two identically
+# configured rules must stay DISTINCT so each gets its own rng seed
+class FaultRule:
+    """One trigger at one site. All specified conditions must hold for
+    the rule to fire on a given call."""
+
+    site: str
+    mode: str = "error"  # error | latency | nan | stall
+    error: Any = None  # exception instance or class (error mode)
+    latency_s: float = 0.01  # latency mode
+    gate: Optional[threading.Event] = None  # stall mode: wait for this
+    nth: Optional[Tuple[int, ...]] = None  # fire on these 0-based calls
+    every: Optional[int] = None  # fire on every k-th call (1-based)
+    probability: Optional[float] = None  # seeded coin flip
+    when: Optional[Callable[[Any], bool]] = None  # predicate on value
+    max_fires: Optional[int] = None
+    fires: int = 0
+
+
+class FaultPlan:
+    """A seedable registry of fault rules, installable as the process'
+    active plan. Thread-safe: sites may be hit from collector/server
+    threads concurrently."""
+
+    def __init__(self, seed: int = 0, sleep: Callable[[float], None] = time.sleep):
+        self.seed = seed
+        self._sleep = sleep
+        self._rules: Dict[str, List[FaultRule]] = {}
+        self._counts: Dict[str, int] = {}
+        self._rngs: Dict[int, random.Random] = {}
+        self._lock = threading.Lock()
+        self.events: List[Tuple[str, int, str]] = []  # (site, call, mode)
+
+    # ------------------------------------------------------------- config
+    def on(
+        self,
+        site: str,
+        mode: str = "error",
+        *,
+        error: Any = None,
+        latency_s: float = 0.01,
+        gate: Optional[threading.Event] = None,
+        nth=None,
+        every: Optional[int] = None,
+        probability: Optional[float] = None,
+        when: Optional[Callable[[Any], bool]] = None,
+        max_fires: Optional[int] = None,
+    ) -> "FaultPlan":
+        if mode not in ("error", "latency", "nan", "stall"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        if mode == "stall" and gate is None:
+            raise ValueError("stall mode requires a gate Event")
+        rule = FaultRule(
+            site=site, mode=mode, error=error, latency_s=latency_s, gate=gate,
+            nth=tuple(nth) if nth is not None else None, every=every,
+            probability=probability, when=when, max_fires=max_fires,
+        )
+        self._rules.setdefault(site, []).append(rule)
+        return self
+
+    # ---------------------------------------------------------- lifecycle
+    def install(self) -> "FaultPlan":
+        global _PLAN
+        _PLAN = self
+        return self
+
+    def remove(self) -> None:
+        global _PLAN
+        if _PLAN is self:
+            _PLAN = None
+
+    @contextlib.contextmanager
+    def active(self):
+        global _PLAN
+        prev = _PLAN
+        _PLAN = self
+        try:
+            yield self
+        finally:
+            _PLAN = prev
+
+    # ------------------------------------------------------ observability
+    def calls(self, site: str) -> int:
+        """How many times ``site`` was reached (fired or not)."""
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def fired(self, site: str) -> int:
+        with self._lock:
+            return sum(1 for s, _, _ in self.events if s == site)
+
+    # ------------------------------------------------------------- firing
+    def _rng_for(self, rule: FaultRule) -> random.Random:
+        key = id(rule)
+        rng = self._rngs.get(key)
+        if rng is None:
+            # string seeding goes through the stable sha512 path
+            rng = random.Random(f"{self.seed}|{rule.site}|{self._rules[rule.site].index(rule)}")
+            self._rngs[key] = rng
+        return rng
+
+    def _matches(self, rule: FaultRule, call: int, value: Any) -> bool:
+        if rule.max_fires is not None and rule.fires >= rule.max_fires:
+            return False
+        if rule.nth is not None and call not in rule.nth:
+            return False
+        if rule.every is not None and (call + 1) % rule.every != 0:
+            return False
+        if rule.probability is not None and not (
+            self._rng_for(rule).random() < rule.probability
+        ):
+            return False
+        if rule.when is not None and not rule.when(value):
+            return False
+        return True
+
+    def _fire(self, site: str, value: Any) -> Any:
+        with self._lock:
+            call = self._counts.get(site, 0)
+            self._counts[site] = call + 1
+            hits = [
+                r for r in self._rules.get(site, ()) if self._matches(r, call, value)
+            ]
+            for r in hits:
+                r.fires += 1
+                self.events.append((site, call, r.mode))
+        # apply OUTSIDE the lock: latency/stall must not serialize other sites
+        for r in hits:
+            if r.mode == "error":
+                err = r.error
+                if err is None:
+                    err = FaultInjected(f"injected fault at {site} (call {call})")
+                elif isinstance(err, type):
+                    err = err(f"injected {err.__name__} at {site} (call {call})")
+                raise err
+            if r.mode == "latency":
+                self._sleep(r.latency_s)
+            elif r.mode == "stall":
+                r.gate.wait(timeout=30.0)  # bounded: a leaked gate must not hang tests
+            elif r.mode == "nan":
+                value = _poison(value)
+        return value

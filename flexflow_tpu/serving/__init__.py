@@ -6,14 +6,30 @@ Reference: triton/ (16k LoC Legion-based Triton backend, SURVEY §2.9).
 from .batcher import DynamicBatcher
 from .model import InferenceModel, TensorMeta
 from .repository import ModelRepository, load_model, save_model
+from .resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    QueueFullError,
+    ResilienceError,
+    RetryPolicy,
+    ShuttingDownError,
+)
 from .server import InferenceServer
 
 __all__ = [
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DeadlineExceededError",
     "DynamicBatcher",
     "GrpcInferenceServer",
     "InferenceModel",
     "InferenceServer",
     "ModelRepository",
+    "QueueFullError",
+    "ResilienceError",
+    "RetryPolicy",
+    "ShuttingDownError",
     "TensorMeta",
     "load_model",
     "save_model",

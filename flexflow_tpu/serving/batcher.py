@@ -7,37 +7,105 @@ collector thread drains up to ``max_batch`` samples (waiting at most
 ``max_delay_s`` after the first), runs ONE padded jitted call, and
 scatters results back to per-request futures — on TPU a single large
 batch is vastly cheaper than many small dispatches.
+
+Resilience (serving/resilience.py + runtime/faults.py):
+
+* bounded queue — ``submit`` rejects with :class:`QueueFullError` when
+  ``max_queue`` requests are waiting (explicit backpressure instead of
+  unbounded memory growth and silent latency collapse);
+* per-request deadlines — an expired or client-abandoned request is
+  dropped at collect/dispatch time so it never wastes device batch
+  space (``infer(timeout=...)`` cancels its request on timeout);
+* retry with exponential backoff for :class:`TransientDeviceError`
+  (preemption/transport), via an injectable :class:`RetryPolicy`;
+* batch bisection — a device failure on a multi-request batch splits it
+  in half and retries each side, so one poisoned request fails alone
+  instead of failing its co-batched neighbors;
+* per-model circuit breaker — consecutive device failures open the
+  circuit (submit rejects with :class:`CircuitOpenError`); after the
+  recovery window one probe request is admitted and its success closes
+  the circuit again. Health endpoints read ``batcher.breaker``;
+* graceful drain — ``stop(drain=True)`` completes queued + in-flight
+  requests before the collector exits; new submits are rejected with
+  :class:`ShuttingDownError` while draining.
 """
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
-from typing import List, Optional, Sequence
+from concurrent.futures import TimeoutError as _FuturesTimeout
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..runtime import faults
 from .model import InferenceModel
+from .resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    QueueFullError,
+    RetryPolicy,
+    ShuttingDownError,
+)
 
 
 class _Request:
-    __slots__ = ("inputs", "future", "n")
+    __slots__ = ("inputs", "future", "n", "deadline")
 
-    def __init__(self, inputs: Sequence[np.ndarray]):
+    def __init__(self, inputs: Sequence[np.ndarray], deadline: Optional[float] = None):
         self.inputs = inputs
         self.future: Future = Future()
         self.n = inputs[0].shape[0]
+        self.deadline = deadline  # absolute, on the batcher's clock
+
+
+def make_batcher(model: InferenceModel, kwargs: dict) -> "DynamicBatcher":
+    """Build a batcher from server-level kwargs. ``breaker``/``retry``
+    may be zero-arg factories (callables) — invoked here so each model
+    gets its OWN instance; passing bare instances shares them across
+    every model the server registers (fine for single-model servers,
+    wrong for multi-model: one model's failures would open every
+    model's circuit)."""
+    kw = dict(kwargs)
+    for key in ("breaker", "retry"):
+        v = kw.get(key)
+        if callable(v):
+            kw[key] = v()
+    return DynamicBatcher(model, **kw)
 
 
 class DynamicBatcher:
-    """Queue + collector thread around one InferenceModel."""
+    """Queue + collector thread around one InferenceModel.
 
-    def __init__(self, model: InferenceModel, max_delay_s: float = 0.005):
+    ``clock`` drives deadlines and the circuit breaker (injectable for
+    deterministic chaos tests); the collect window itself always uses
+    real ``time.monotonic`` so batching latency stays physical.
+    """
+
+    def __init__(
+        self,
+        model: InferenceModel,
+        max_delay_s: float = 0.005,
+        max_queue: int = 256,
+        breaker: Optional[CircuitBreaker] = None,
+        retry: Optional[RetryPolicy] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.model = model
         self.max_delay_s = max_delay_s
+        self.max_queue = max_queue
+        self.clock = clock
+        self.breaker = breaker or CircuitBreaker(clock=clock)
+        self.retry = retry or RetryPolicy()
+        # unbounded Queue; the bound is enforced in submit() via qsize so
+        # control sentinels can never block behind a full queue
         self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        self._draining = False
         # one-slot holdover for a request that didn't fit the last batch:
         # it leads the NEXT batch instead of re-queueing behind newer
         # arrivals (FIFO re-queue starved large requests under sustained
@@ -48,23 +116,58 @@ class DynamicBatcher:
     def start(self):
         if self._running:
             return
+        if self._thread is not None and self._thread.is_alive():
+            # a previous stop() timed out mid-drain; two collectors on one
+            # queue would race over requests and sentinels
+            raise RuntimeError("previous collector still draining; cannot restart")
         self._running = True
+        self._draining = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    def stop(self):
+    def stop(self, drain: bool = True, timeout: float = 10.0):
+        """Stop the collector. ``drain=True`` (default) first completes
+        every queued and in-flight request; ``drain=False`` stops after
+        the current batch and errors the rest. A drain that outlives
+        ``timeout`` degrades to a hard stop."""
         if not self._running:
             return
-        self._running = False
-        self._q.put(None)
+        if drain:
+            # collector keeps running until it eats the sentinel, so the
+            # whole queue (and any holdover) is served first; submit()
+            # rejects new work while draining
+            self._draining = True
+            self._q.put(None)
+            if self._thread:
+                self._thread.join(timeout=timeout)
+                if self._thread.is_alive():
+                    # wedged drain (e.g. a hung device call): stop
+                    # accepting work but KEEP _draining set so submits
+                    # surface as 503 ShuttingDownError, and leave the
+                    # collector's state (_pending, queue) alone — touching
+                    # it here would race the live thread. The daemon
+                    # thread exits with the process; start() refuses to
+                    # run until it actually dies.
+                    self._running = False
+                    return
+            self._running = False
+            self._draining = False
+        else:
+            self._running = False
+            self._q.put(None)
         if self._thread:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # wedged hard stop: leave the collector's state alone (as
+                # above); subsequent submits get the plain stopped-batcher
+                # RuntimeError, matching any other drain=False stop
+                return
             self._thread = None
         # drain stale sentinels/requests so a later start() gets a clean
         # queue (a re-queued None would kill the new collector instantly)
         if self._pending is not None:
             if not self._pending.future.done():
-                self._pending.future.set_exception(RuntimeError("batcher stopped"))
+                self._pending.future.set_exception(ShuttingDownError("batcher stopped"))
             self._pending = None
         while True:
             try:
@@ -72,12 +175,23 @@ class DynamicBatcher:
             except queue.Empty:
                 break
             if isinstance(item, _Request) and not item.future.done():
-                item.future.set_exception(RuntimeError("batcher stopped"))
+                item.future.set_exception(ShuttingDownError("batcher stopped"))
+
+    def ready(self) -> bool:
+        """Health-endpoint view, shared by the HTTP and gRPC front ends:
+        accepting work and the breaker is not holding traffic."""
+        return self._running and not self._draining and self.breaker.ready()
 
     # ------------------------------------------------------------- submit
-    def submit(self, inputs: Sequence[np.ndarray]) -> Future:
+    def submit(self, inputs: Sequence[np.ndarray], deadline_s: Optional[float] = None) -> Future:
         """Enqueue one request (batch <= max_batch); returns a Future of
-        the output list."""
+        the output list. ``deadline_s`` is this request's latency budget:
+        if it expires before the request reaches the device, the request
+        fails with DeadlineExceededError instead of wasting batch space."""
+        # draining outranks stopped: a wedged drain leaves _running False
+        # with _draining set, and those submits must stay 503, not 500
+        if self._draining:
+            raise ShuttingDownError("batcher draining")
         if not self._running:
             raise RuntimeError("batcher not started")
         if len(inputs) != len(self.model.inputs):
@@ -93,26 +207,80 @@ class DynamicBatcher:
                 raise ValueError(f"input {meta.name}: expected {meta.shape}, got {tuple(x.shape[1:])}")
             if x.shape[0] != n:
                 raise ValueError("all inputs in a request must share the batch dim")
-        req = _Request(arrays)
+        if deadline_s is not None and deadline_s <= 0:
+            raise DeadlineExceededError("deadline already expired at submit")
+        if self._q.qsize() >= self.max_queue:
+            raise QueueFullError(
+                f"model {self.model.name!r}: request queue full ({self.max_queue})"
+            )
+        # breaker LAST so a rejection on the cheap checks above can never
+        # consume (and leak) the HALF_OPEN probe slot
+        if not self.breaker.allow():
+            raise CircuitOpenError(f"model {self.model.name!r}: circuit open")
+        deadline = None if deadline_s is None else self.clock() + deadline_s
+        req = _Request(arrays, deadline=deadline)
         self._q.put(req)
+        # close the submit/stop race: if stop() ran to completion between
+        # the liveness checks above and the put, neither the collector nor
+        # stop()'s cleanup sweep will ever see this request — fail it here
+        # instead of leaving the caller to hit its own result timeout
+        if not self._running and not self._draining:
+            try:
+                req.future.set_exception(ShuttingDownError("batcher stopped"))
+            except Exception:
+                pass  # the cleanup sweep got to it first
+            raise ShuttingDownError("batcher stopped")
         return req.future
 
     def infer(self, inputs: Sequence[np.ndarray], timeout: Optional[float] = None) -> List[np.ndarray]:
-        return self.submit(inputs).result(timeout=timeout)
+        fut = self.submit(inputs, deadline_s=timeout)
+        try:
+            return fut.result(timeout=timeout)
+        except (TimeoutError, _FuturesTimeout):
+            # futures.TimeoutError only aliases the builtin from 3.11 on
+            # abandoned: cancel so the collector skips it instead of
+            # running it in a future device batch nobody waits for
+            fut.cancel()
+            raise
 
     # ------------------------------------------------------------ internals
-    def _collect(self) -> List[_Request]:
-        """Block for the first request, then drain until the batch is full
-        or max_delay_s has passed. A held-over request (one that didn't
-        fit the previous batch) always leads."""
-        import time
+    def _admit(self, req: _Request) -> bool:
+        """Called once when a request is pulled for batching. Drops
+        abandoned (cancelled/already-failed) requests and fails expired
+        ones — neither ever reaches the device."""
+        if req.future.done():
+            # already cancelled or failed (e.g. the submit/stop race check
+            # settled it while it sat in the queue); FINISHED futures must
+            # not reach set_running_or_notify_cancel, which would raise
+            # and kill the collector
+            return False
+        if req.deadline is not None and self.clock() >= req.deadline:
+            if not req.future.done():
+                req.future.set_exception(
+                    DeadlineExceededError("deadline expired before dispatch")
+                )
+            return False
+        # flips PENDING->RUNNING so infer()-timeout cancels can no longer
+        # race with result scatter; returns False if already cancelled
+        try:
+            return req.future.set_running_or_notify_cancel()
+        except RuntimeError:  # FINISHED in the window since the check above
+            return False
 
+    def _collect(self) -> List[_Request]:
+        """Block for the first live request, then drain until the batch
+        is full or max_delay_s has passed. A held-over request (one that
+        didn't fit the previous batch) always leads."""
         if self._pending is not None:
             first, self._pending = self._pending, None
         else:
-            first = self._q.get()
-            if first is None:
-                return []
+            first = None
+            while first is None:
+                item = self._q.get()
+                if item is None:
+                    return []
+                if self._admit(item):
+                    first = item
         batch = [first]
         total = first.n
         deadline = time.monotonic() + self.max_delay_s
@@ -127,6 +295,8 @@ class DynamicBatcher:
             if nxt is None:
                 self._q.put(None)  # keep the shutdown signal
                 break
+            if not self._admit(nxt):
+                continue
             if total + nxt.n > self.model.max_batch:
                 self._pending = nxt  # doesn't fit: leads the next batch
                 break
@@ -134,22 +304,79 @@ class DynamicBatcher:
             total += nxt.n
         return batch
 
+    def _device_infer(self, batch: List[_Request]) -> List[np.ndarray]:
+        stacked = [
+            np.concatenate([r.inputs[i] for r in batch], axis=0)
+            for i in range(len(batch[0].inputs))
+        ]
+        return self.model.infer(stacked)
+
+    def _run(self, batch: List[_Request], top_level: bool = True) -> None:
+        """Run one batch with retry; on persistent failure, bisect so the
+        poisoned request fails alone while its batch-mates succeed.
+        Transient errors get their full retry budget ONCE, at the top
+        level — bisection children run single-shot, so a device-wide
+        failure on a batch of k costs O(k) calls, not O(k * attempts)."""
+        try:
+            if top_level:
+                outs = self.retry.run(lambda: self._device_infer(batch))
+            else:
+                outs = self._device_infer(batch)
+        except Exception as e:
+            if len(batch) > 1:
+                mid = len(batch) // 2
+                self._run(batch[:mid], top_level=False)
+                self._run(batch[mid:], top_level=False)
+            else:
+                # leaf: failure definitively attributed to this request's
+                # device call — this is what trips the breaker
+                self.breaker.record_failure()
+                r = batch[0]
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        self.breaker.record_success()
+        off = 0
+        for r in batch:
+            if not r.future.done():
+                r.future.set_result([o[off : off + r.n] for o in outs])
+            off += r.n
+
     def _loop(self):
-        while self._running:
+        while True:
+            if not self._running and not self._draining:
+                break
             batch = self._collect()
             if not batch:
                 break
+            # final sweep: a deadline that expired while the request was
+            # held over / the window filled must still never dispatch
+            now = self.clock()
+            live = []
+            for r in batch:
+                if r.deadline is not None and now >= r.deadline:
+                    if not r.future.done():
+                        r.future.set_exception(
+                            DeadlineExceededError("deadline expired before dispatch")
+                        )
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            # the breaker opened while these requests sat in the backlog:
+            # fast-fail them instead of burning device calls on a known-bad
+            # device (state check only — must NOT consume the probe slot;
+            # an admitted HALF_OPEN probe sees state HALF_OPEN and runs)
+            if self.breaker.state == CircuitBreaker.OPEN:
+                err = CircuitOpenError(f"model {self.model.name!r}: circuit open")
+                for r in live:
+                    if not r.future.done():
+                        r.future.set_exception(err)
+                continue
             try:
-                stacked = [
-                    np.concatenate([r.inputs[i] for r in batch], axis=0)
-                    for i in range(len(batch[0].inputs))
-                ]
-                outs = self.model.infer(stacked)
-                off = 0
-                for r in batch:
-                    r.future.set_result([o[off : off + r.n] for o in outs])
-                    off += r.n
-            except Exception as e:
-                for r in batch:
+                live = faults.inject("serving.batcher.dispatch", live)
+                self._run(live)
+            except Exception as e:  # injected dispatch fault / scatter bug
+                for r in live:
                     if not r.future.done():
                         r.future.set_exception(e)

@@ -23,8 +23,9 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from .batcher import DynamicBatcher
+from .batcher import DynamicBatcher, make_batcher
 from .model import InferenceModel
+from .resilience import ResilienceError, grpc_code
 
 try:
     from . import kserve_v2_pb2 as pb
@@ -106,6 +107,8 @@ class GrpcInferenceServer:
         max_delay_s: float = 0.005,
         http_server=None,
         repository=None,
+        max_queue: int = 256,
+        batcher_kwargs: Optional[dict] = None,
     ):
         if pb is None:
             raise RuntimeError(
@@ -120,6 +123,12 @@ class GrpcInferenceServer:
         self.port = port
         self.max_workers = max_workers
         self.max_delay_s = max_delay_s
+        # standalone batcher knobs, same contract as InferenceServer
+        # (ignored when sharing an http_server's batchers)
+        self._batcher_kwargs = dict(batcher_kwargs or {})
+        self._batcher_kwargs.setdefault("max_delay_s", max_delay_s)
+        self._batcher_kwargs.setdefault("max_queue", max_queue)
+        self._draining = False
         self._shared = http_server
         if http_server is not None:
             self.models = http_server.models
@@ -138,7 +147,7 @@ class GrpcInferenceServer:
         if self._shared is not None:
             return self._shared.register(model)
         self.models[model.name] = model
-        b = DynamicBatcher(model, max_delay_s=self.max_delay_s)
+        b = make_batcher(model, self._batcher_kwargs)
         self.batchers[model.name] = b
         if self._started:
             b.start()
@@ -184,13 +193,19 @@ class GrpcInferenceServer:
         self._started = True
         self._server.start()
 
-    def stop(self, grace: float = 2.0):
-        if self._server is not None:
-            self._server.stop(grace).wait()
-            self._server = None
-        if self._shared is None:
-            for b in self.batchers.values():
-                b.stop()
+    def stop(self, grace: float = 2.0, drain: bool = True):
+        """Graceful by default: ServerReady flips false, in-flight RPCs
+        get ``grace`` seconds, and the batchers drain their queues."""
+        self._draining = True
+        try:
+            if self._server is not None:
+                self._server.stop(grace).wait()
+                self._server = None
+            if self._shared is None:
+                for b in self.batchers.values():
+                    b.stop(drain=drain)
+        finally:
+            self._draining = False
         self._started = False
 
     def __enter__(self):
@@ -200,15 +215,30 @@ class GrpcInferenceServer:
     def __exit__(self, *exc):
         self.stop()
 
+    # ------------------------------------------------------------- health
+    def _is_ready(self) -> bool:
+        """Real readiness (not a constant): started, not draining (here
+        or on the shared HTTP server), and no model breaker open."""
+        if not self._started or self._draining:
+            return False
+        if self._shared is not None and self._shared._draining:
+            return False
+        # snapshot: repository load/unload mutates the dict concurrently
+        return all(b.breaker.ready() for b in list(self.batchers.values()))
+
+    def _is_model_ready(self, name: str) -> bool:
+        b = self.batchers.get(name)
+        return b is not None and b.ready()
+
     # ------------------------------------------------------------ handlers
     def _server_live(self, request, context):
         return pb.ServerLiveResponse(live=True)
 
     def _server_ready(self, request, context):
-        return pb.ServerReadyResponse(ready=True)
+        return pb.ServerReadyResponse(ready=self._is_ready())
 
     def _model_ready(self, request, context):
-        return pb.ModelReadyResponse(ready=request.name in self.models)
+        return pb.ModelReadyResponse(ready=self._is_model_ready(request.name))
 
     def _abort(self, context, code, msg):
         context.abort(code, msg)
@@ -260,15 +290,26 @@ class GrpcInferenceServer:
                 if a is None:
                     raise ValueError(f"missing input {meta.name}")
                 arrays.append(a)
-            fut = batcher.submit(arrays)
+            # propagate the client's gRPC deadline into the batcher so a
+            # request that expires while queued never reaches the device
+            remaining = context.time_remaining()
+            fut = batcher.submit(arrays, deadline_s=remaining)
+        except ResilienceError as e:  # backpressure/deadline/breaker/drain
+            self._abort(context, grpc_code(e, grpc), str(e))
         except RuntimeError as e:  # batcher stopped
             self._abort(context, grpc.StatusCode.UNAVAILABLE, str(e))
         except Exception as e:
             self._abort(context, grpc.StatusCode.INVALID_ARGUMENT, str(e))
         try:
-            outs = fut.result(timeout=60.0)
+            # a client deadline owns the wait; 60s only for budget-less calls
+            outs = fut.result(timeout=remaining if remaining is not None else 60.0)
+        except ResilienceError as e:
+            self._abort(context, grpc_code(e, grpc), str(e))
         except (TimeoutError, futures.TimeoutError):
-            # futures.TimeoutError only aliases the builtin from 3.11 on
+            # futures.TimeoutError only aliases the builtin from 3.11 on;
+            # cancel so the abandoned request never occupies device batch
+            # space later
+            fut.cancel()
             self._abort(context, grpc.StatusCode.DEADLINE_EXCEEDED, "inference timed out")
         except Exception as e:
             self._abort(context, grpc.StatusCode.INTERNAL, str(e))

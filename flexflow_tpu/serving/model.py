@@ -109,6 +109,12 @@ class InferenceModel:
     def infer(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
         """Run a batch (any size <= max_batch); pads to the compiled batch
         and slices the padding back off."""
+        from ..runtime import faults
+
+        # chaos hook: rules can raise (device loss), stall, or poison;
+        # `when` predicates see the stacked inputs, so a fault can track a
+        # specific poisoned request through batch bisection
+        inputs = faults.inject("serving.model.infer", inputs)
         if len(inputs) != len(self.inputs):
             raise ValueError(f"model takes {len(self.inputs)} inputs, got {len(inputs)}")
         n = inputs[0].shape[0]

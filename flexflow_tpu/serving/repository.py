@@ -49,13 +49,21 @@ def save_model(im: InferenceModel, root: str) -> str:
         for nkey, ws in store.items():
             for wname, arr in ws.items():
                 flat[f"{prefix}::{nkey}::{wname}"] = np.asarray(arr)
-    np.savez(d / "weights.npz", **flat)
+    # write-then-rename: a crash mid-save never leaves a truncated
+    # weights.npz that a later load() would read as a corrupt model
+    tmp = d / "weights.npz.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(tmp, d / "weights.npz")
     return str(d)
 
 
 def load_model(root: str, name: str) -> InferenceModel:
     """Rebuild a servable model from the repository (graph + strategy +
     weights); compiles for inference on the current mesh."""
+    from ..runtime import faults
+
+    faults.inject("serving.repository.load", name)
     from ..config import FFConfig
     from ..model import FFModel, Tensor
     from ..parallel.propagation import infer_all_specs

@@ -4,24 +4,36 @@ Reference: triton/ (SURVEY §2.9) — the reference serves its Legion op
 graph as a Triton backend; its wire protocol is Triton's v2 inference
 API. This server implements the same surface directly (stdlib only):
 
-  GET  /v2/health/ready                    -> 200 when serving
+  GET  /v2/health/live                     -> 200 while the process runs
+  GET  /v2/health/ready                    -> 200 only when actually able
+                                              to serve (not draining, no
+                                              model breaker open)
   GET  /v2/models/{name}                   -> model metadata
+  GET  /v2/models/{name}/ready             -> per-model readiness
   POST /v2/models/{name}/infer             -> run inference
 
 Infer request JSON: {"inputs": [{"name", "shape", "datatype", "data"}]},
-response mirrors it — the v2 tensor format with row-major flat data.
+response mirrors it — the v2 tensor format with row-major flat data. A
+per-request deadline may ride along as ``{"parameters": {"timeout_ms":
+N}}`` or the ``X-Request-Timeout-Ms`` header; expired requests are
+rejected with 504 before they reach the device.
+
+Status mapping for resilience rejections: queue full / circuit open /
+draining -> 503, expired deadline -> 504, backend death -> 500.
 """
 from __future__ import annotations
 
 import json
 import threading
+from concurrent.futures import TimeoutError as _FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 import numpy as np
 
-from .batcher import DynamicBatcher
+from .batcher import DynamicBatcher, make_batcher
 from .model import InferenceModel
+from .resilience import ResilienceError, http_status
 
 _V2_DTYPES = {
     "FP32": np.float32, "FP64": np.float64, "FP16": np.float16,
@@ -52,6 +64,8 @@ class InferenceServer:
         port: int = 8000,
         max_delay_s: float = 0.005,
         repository=None,
+        max_queue: int = 256,
+        batcher_kwargs: Optional[dict] = None,
     ):
         self.host = host
         self.port = port
@@ -59,12 +73,20 @@ class InferenceServer:
         self.batchers: Dict[str, DynamicBatcher] = {}
         self.max_delay_s = max_delay_s
         self.repository = repository
+        # per-model batcher construction knobs (breaker/retry/clock are
+        # injectable here so chaos tests run on virtual time); pass
+        # breaker/retry as zero-arg FACTORIES on multi-model servers so
+        # each model gets its own instance (see make_batcher)
+        self._batcher_kwargs = dict(batcher_kwargs or {})
+        self._batcher_kwargs.setdefault("max_delay_s", max_delay_s)
+        self._batcher_kwargs.setdefault("max_queue", max_queue)
+        self._draining = False
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def register(self, model: InferenceModel):
         self.models[model.name] = model
-        b = DynamicBatcher(model, max_delay_s=self.max_delay_s)
+        b = make_batcher(model, self._batcher_kwargs)
         self.batchers[model.name] = b
         if self._httpd is not None:
             b.start()
@@ -74,6 +96,22 @@ class InferenceServer:
         if b is not None:
             b.stop()
         return self.models.pop(name, None) is not None
+
+    # ------------------------------------------------------------- health
+    def live(self) -> bool:
+        return True
+
+    def ready(self) -> bool:
+        """Real readiness, not a constant: serving, not draining, and no
+        model's circuit breaker holding traffic."""
+        if self._httpd is None or self._draining:
+            return False
+        # snapshot: repository load/unload mutates the dict concurrently
+        return all(b.breaker.ready() for b in list(self.batchers.values()))
+
+    def model_ready(self, name: str) -> bool:
+        b = self.batchers.get(name)
+        return b is not None and b.ready()
 
     # ------------------------------------------------------------ control
     def start(self):
@@ -119,15 +157,22 @@ class InferenceServer:
                 return self._json(404, {"error": "not found"})
 
             def do_GET(self):
+                if self.path == "/v2/health/live":
+                    return self._json(200, {"live": server.live()})
                 if self.path == "/v2/health/ready":
-                    return self._json(200, {"ready": True})
+                    ok = server.ready()
+                    return self._json(200 if ok else 503, {"ready": ok})
                 if self.path == "/v2/models":
                     return self._json(200, {"models": sorted(server.models)})
                 if self.path.startswith("/v2/models/"):
-                    name = self.path.split("/")[3]
+                    parts = self.path.split("/")
+                    name = parts[3]
                     m = server.models.get(name)
                     if m is None:
                         return self._json(404, {"error": f"unknown model {name}"})
+                    if len(parts) == 5 and parts[4] == "ready":
+                        ok = server.model_ready(name)
+                        return self._json(200 if ok else 503, {"name": name, "ready": ok})
                     return self._json(200, m.metadata())
                 return self._json(404, {"error": "not found"})
 
@@ -142,12 +187,17 @@ class InferenceServer:
                 model = server.models.get(name)
                 if batcher is None or model is None:
                     return self._json(404, {"error": f"unknown model {name}"})
-                # request parsing/validation errors -> 400; server-side
-                # inference failures -> 500; timeout -> 504 (round-1
-                # conflated them all into 400)
+                # request parsing/validation errors -> 400; backpressure /
+                # breaker / drain -> 503; expired deadline -> 504;
+                # server-side inference failures -> 500 (round-1 conflated
+                # them all into 400)
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length))
+                    timeout_ms = (req.get("parameters") or {}).get(
+                        "timeout_ms", self.headers.get("X-Request-Timeout-Ms")
+                    )
+                    deadline_s = None if timeout_ms is None else float(timeout_ms) / 1000.0
                     by_name = {t["name"]: t for t in req["inputs"]}
                     arrays = []
                     for meta in model.inputs:
@@ -156,14 +206,24 @@ class InferenceServer:
                             raise ValueError(f"missing input {meta.name}")
                         dt = _V2_DTYPES.get(t.get("datatype", "FP32"), np.float32)
                         arrays.append(np.asarray(t["data"], dtype=dt).reshape(t["shape"]))
-                    fut = batcher.submit(arrays)
+                    fut = batcher.submit(arrays, deadline_s=deadline_s)
+                except ResilienceError as e:  # backpressure/deadline/breaker/drain
+                    return self._json(http_status(e), {"error": str(e)})
                 except RuntimeError as e:  # batcher stopped: server-side
                     return self._json(500, {"error": str(e)})
                 except Exception as e:
                     return self._json(400, {"error": str(e)})
                 try:
-                    outs = fut.result(timeout=60.0)
-                except TimeoutError:
+                    # a request-supplied deadline owns the wait; 60s is
+                    # only the default for budget-less requests
+                    outs = fut.result(timeout=deadline_s if deadline_s is not None else 60.0)
+                except ResilienceError as e:
+                    return self._json(http_status(e), {"error": str(e)})
+                except (TimeoutError, _FuturesTimeout):
+                    # futures.TimeoutError only aliases the builtin from
+                    # 3.11 on; cancel so the abandoned request never
+                    # occupies space in a later device batch
+                    fut.cancel()
                     return self._json(504, {"error": "inference timed out"})
                 except Exception as e:
                     return self._json(500, {"error": str(e)})
@@ -188,16 +248,23 @@ class InferenceServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
 
-    def stop(self):
-        if self._httpd:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-        for b in self.batchers.values():
-            b.stop()
-        if self._thread:
-            self._thread.join(timeout=5)
-            self._thread = None
+    def stop(self, drain: bool = True):
+        """Graceful by default: readiness flips to 503 first (so load
+        balancers stop routing here), queued + in-flight requests finish,
+        then the listener closes. ``drain=False`` errors queued work."""
+        self._draining = True
+        try:
+            for b in self.batchers.values():
+                b.stop(drain=drain)
+            if self._httpd:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+                self._httpd = None
+            if self._thread:
+                self._thread.join(timeout=5)
+                self._thread = None
+        finally:
+            self._draining = False
 
     def __enter__(self):
         self.start()

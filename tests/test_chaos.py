@@ -1,0 +1,590 @@
+"""Chaos suite: every serving-resilience behavior proven through the
+deterministic fault-injection layer (runtime/faults.py).
+
+Covered, per ISSUE acceptance criteria:
+  * queue-full -> QueueFullError / HTTP 503 / gRPC RESOURCE_EXHAUSTED
+  * an expired deadline never reaches the device
+  * a transient device error is retried (exponential backoff) and succeeds
+  * a poisoned request fails alone; co-batched neighbors succeed (bisection)
+  * consecutive failures open the breaker and flip ModelReady +
+    /v2/health/ready to not-ready; a HALF_OPEN probe restores them
+  * stop() drains in-flight requests instead of erroring them
+  * abandoned requests (client infer() timeout) are skipped at collect time
+  * ElasticTrainer restarts wait out exponential backoff with jitter
+
+Determinism rules: virtual clocks for deadlines/breakers, injectable
+sleeps for retry/elastic backoff, threading.Event gates (fault mode
+"stall", bounded wait) instead of timing races, no real sleep > 50ms.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import CompMode, FFConfig, FFModel
+from flexflow_tpu.runtime import faults
+from flexflow_tpu.runtime.faults import (
+    FaultInjected,
+    FaultPlan,
+    TransientDeviceError,
+)
+from flexflow_tpu.serving import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    DynamicBatcher,
+    InferenceModel,
+    InferenceServer,
+    QueueFullError,
+    RetryPolicy,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    """Virtual time for deadlines and breaker recovery windows."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    cfg = FFConfig(batch_size=8)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 16], name="x")
+    t = ff.dense(x, 32, activation="relu")
+    out = ff.softmax(ff.dense(t, 4))
+    ff.compile(comp_mode=CompMode.INFERENCE, outputs=[out])
+    return InferenceModel(ff, name="mlp", max_batch=8)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    assert faults.active_plan() is None, "a test leaked an installed FaultPlan"
+
+
+def _no_sleep(_s):
+    pass
+
+
+def _fast_retry(**kw):
+    kw.setdefault("max_attempts", 3)
+    kw.setdefault("sleep", _no_sleep)
+    return RetryPolicy(**kw)
+
+
+def _batcher(model, **kw):
+    kw.setdefault("retry", _fast_retry())
+    b = DynamicBatcher(model, **kw)
+    b.start()
+    return b
+
+
+def _x(n=1, seed=0):
+    return np.random.RandomState(seed).randn(n, 16).astype(np.float32)
+
+
+# ---------------------------------------------------------------- framework
+def test_fault_plan_nth_trigger_and_events():
+    plan = FaultPlan(seed=0).on("site.a", mode="error", nth=(1,))
+    with plan.active():
+        assert faults.inject("site.a", "v") == "v"  # call 0: no fire
+        with pytest.raises(FaultInjected):
+            faults.inject("site.a", "v")  # call 1: fires
+        assert faults.inject("site.a", "v") == "v"  # call 2: no fire
+    assert plan.calls("site.a") == 3
+    assert plan.fired("site.a") == 1
+    assert plan.events == [("site.a", 1, "error")]
+
+
+def test_fault_plan_probability_deterministic_under_seed():
+    def pattern(seed):
+        plan = FaultPlan(seed=seed).on("p", mode="error", probability=0.3)
+        fired = []
+        with plan.active():
+            for i in range(60):
+                try:
+                    faults.inject("p")
+                    fired.append(0)
+                except FaultInjected:
+                    fired.append(1)
+        return fired
+
+    a, b, c = pattern(7), pattern(7), pattern(8)
+    assert a == b, "same seed must fire the same calls"
+    assert a != c, "different seeds should differ"
+    assert 5 < sum(a) < 40  # p=0.3 over 60 calls, loose sanity bounds
+
+
+def test_fault_modes_latency_nan_every_and_max_fires():
+    slept = []
+    plan = FaultPlan(seed=0, sleep=slept.append)
+    plan.on("lat", mode="latency", latency_s=0.02)
+    plan.on("poison", mode="nan", every=2, max_fires=1)
+    with plan.active():
+        faults.inject("lat")
+        assert slept == [0.02]
+        clean = [np.ones(3, np.float32), np.arange(3)]  # int leaf untouched
+        assert faults.inject("poison", "x") == "x"  # call 0: every=2 skips
+        out = faults.inject("poison", clean)  # call 1: fires
+        assert np.isnan(out[0]).all()
+        np.testing.assert_array_equal(out[1], np.arange(3))
+        again = faults.inject("poison", clean)  # call 3... max_fires hit
+        assert not np.isnan(again[0]).any()
+
+
+def test_inject_disabled_is_total_noop():
+    sentinel = object()
+    assert faults.active_plan() is None
+    assert faults.inject("anything", sentinel) is sentinel
+    assert faults.inject("anything") is None
+
+
+# ------------------------------------------------------------- backpressure
+def test_queue_full_rejects_with_backpressure(served_model):
+    gate = threading.Event()
+    plan = FaultPlan().on("serving.batcher.dispatch", mode="stall", gate=gate)
+    b = _batcher(served_model, max_queue=2, max_delay_s=0.001)
+    try:
+        with plan.active():
+            first = b.submit([_x()])
+            # wait until the collector is stalled holding the first batch
+            deadline = time.monotonic() + 5
+            while plan.fired("serving.batcher.dispatch") < 1:
+                assert time.monotonic() < deadline, "collector never dispatched"
+                time.sleep(0.001)
+            q1 = b.submit([_x(seed=1)])
+            q2 = b.submit([_x(seed=2)])
+            with pytest.raises(QueueFullError):
+                b.submit([_x(seed=3)])
+            gate.set()
+            for f in (first, q1, q2):
+                (out,) = f.result(timeout=30)
+                assert out.shape[-1] == 4
+    finally:
+        gate.set()
+        b.stop()
+
+
+def test_queue_full_maps_to_http_503(served_model):
+    gate = threading.Event()
+    plan = FaultPlan().on("serving.batcher.dispatch", mode="stall", gate=gate)
+    server = InferenceServer(port=0, batcher_kwargs={"max_queue": 1, "max_delay_s": 0.001})
+    server.register(served_model)
+    body = json.dumps({
+        "inputs": [{"name": "x", "shape": [1, 16], "datatype": "FP32",
+                    "data": _x().reshape(-1).tolist()}]
+    }).encode()
+
+    def post():
+        return urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v2/models/mlp/infer", data=body), timeout=30)
+
+    with server:
+        with plan.active():
+            t1 = threading.Thread(target=post)  # stalls on the device
+            t1.start()
+            deadline = time.monotonic() + 5
+            while plan.fired("serving.batcher.dispatch") < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.001)
+            t2 = threading.Thread(target=post)  # occupies the queue slot
+            t2.start()
+            deadline = time.monotonic() + 5
+            while server.batchers["mlp"]._q.qsize() < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.001)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post()
+            assert ei.value.code == 503
+            gate.set()
+            t1.join(timeout=30)
+            t2.join(timeout=30)
+    gate.set()
+
+
+# ----------------------------------------------------------------- deadlines
+def test_expired_deadline_never_reaches_device(served_model):
+    clk = FakeClock()
+    gate = threading.Event()
+    plan = FaultPlan().on("serving.batcher.dispatch", mode="stall", gate=gate)
+    b = _batcher(served_model, clock=clk, max_delay_s=0.001)
+    try:
+        with plan.active():
+            first = b.submit([_x()])
+            deadline = time.monotonic() + 5
+            while plan.fired("serving.batcher.dispatch") < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.001)
+            doomed = b.submit([_x(seed=1)], deadline_s=1.0)  # expires at t=1
+            clk.advance(2.0)  # ...and the clock blows past it while queued
+            gate.set()
+            (out,) = first.result(timeout=30)
+            assert out.shape == (1, 4)
+            with pytest.raises(DeadlineExceededError):
+                doomed.result(timeout=30)
+            # the expired request never became part of a device batch
+            assert plan.calls("serving.model.infer") == 1
+            # an already-expired budget is rejected synchronously
+            with pytest.raises(DeadlineExceededError):
+                b.submit([_x()], deadline_s=0)
+    finally:
+        gate.set()
+        b.stop()
+
+
+def test_abandoned_request_skipped_at_collect(served_model):
+    """A client that gave up (infer timeout -> cancelled future) must not
+    occupy space in the next device batch."""
+    gate = threading.Event()
+    plan = FaultPlan().on("serving.batcher.dispatch", mode="stall", gate=gate)
+    b = _batcher(served_model, max_delay_s=0.001)
+    try:
+        with plan.active():
+            first = b.submit([_x()])
+            deadline = time.monotonic() + 5
+            while plan.fired("serving.batcher.dispatch") < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.001)
+            abandoned = b.submit([_x(seed=1)])
+            abandoned.cancel()  # what infer(timeout=...) does on timeout
+            gate.set()
+            first.result(timeout=30)
+            (out,) = b.infer([_x(seed=2)], timeout=30)
+            assert out.shape == (1, 4)
+            # device ran first + the live follow-up; never the abandoned one
+            assert plan.calls("serving.model.infer") == 2
+            assert abandoned.cancelled()
+    finally:
+        gate.set()
+        b.stop()
+
+
+# -------------------------------------------------------------------- retry
+def test_transient_device_error_retried_and_succeeds(served_model):
+    plan = FaultPlan().on(
+        "serving.model.infer", mode="error", error=TransientDeviceError, nth=(0, 1)
+    )
+    slept = []
+    b = _batcher(served_model, retry=_fast_retry(max_attempts=3, sleep=slept.append,
+                                                 base_delay_s=0.01, jitter=0.0))
+    try:
+        with plan.active():
+            (out,) = b.infer([_x()], timeout=30)
+        assert out.shape == (1, 4)
+        assert plan.fired("serving.model.infer") == 2
+        assert b.retry.last_attempts == 3
+        assert slept == [0.01, 0.02]  # exponential, no jitter
+        assert b.breaker.state == CircuitBreaker.CLOSED
+    finally:
+        b.stop()
+
+
+def test_transient_error_exhausting_retries_fails_request(served_model):
+    plan = FaultPlan().on("serving.model.infer", mode="error", error=TransientDeviceError)
+    b = _batcher(served_model, retry=_fast_retry(max_attempts=2))
+    try:
+        with plan.active():
+            fut = b.submit([_x()])
+            with pytest.raises(TransientDeviceError):
+                fut.result(timeout=30)
+        assert plan.fired("serving.model.infer") == 2
+    finally:
+        b.stop()
+
+
+# ---------------------------------------------------------------- bisection
+def test_poisoned_request_fails_alone_batchmates_succeed(served_model):
+    """One NaN-poisoned request in a coalesced batch: bisection isolates
+    it; its neighbors get correct results, it alone gets the error."""
+    plan = FaultPlan().on(
+        "serving.model.infer", mode="error",
+        when=lambda xs: any(np.isnan(np.asarray(x)).any() for x in xs),
+    )
+    b = _batcher(served_model, max_delay_s=0.05)
+    try:
+        with plan.active():
+            good1 = _x(2, seed=1)
+            good2 = _x(1, seed=2)
+            poisoned = np.full((1, 16), np.nan, np.float32)
+            f1 = b.submit([good1])
+            f2 = b.submit([poisoned])
+            f3 = b.submit([good2])
+            (o1,) = f1.result(timeout=30)
+            (o3,) = f3.result(timeout=30)
+            with pytest.raises(FaultInjected):
+                f2.result(timeout=30)
+        (w1,) = served_model.infer([good1])
+        (w3,) = served_model.infer([good2])
+        np.testing.assert_allclose(o1, w1, rtol=1e-5)
+        np.testing.assert_allclose(o3, w3, rtol=1e-5)
+    finally:
+        b.stop()
+
+
+# ---------------------------------------------------------- circuit breaker
+def test_breaker_opens_flips_health_and_half_open_probe_recovers(served_model):
+    clk = FakeClock()
+    breaker = CircuitBreaker(failure_threshold=2, recovery_s=10.0, clock=clk)
+    server = InferenceServer(port=0, batcher_kwargs={
+        "breaker": breaker, "clock": clk, "max_delay_s": 0.001,
+        "retry": _fast_retry(max_attempts=1),
+    })
+    server.register(served_model)
+    plan = FaultPlan().on("serving.model.infer", mode="error", max_fires=2)
+    with server:
+        base = f"http://127.0.0.1:{server.port}"
+        # healthy to start
+        assert json.load(urllib.request.urlopen(f"{base}/v2/health/ready"))["ready"]
+        assert json.load(urllib.request.urlopen(f"{base}/v2/health/live"))["live"]
+        assert json.load(urllib.request.urlopen(f"{base}/v2/models/mlp/ready"))["ready"]
+        b = server.batchers["mlp"]
+        with plan.active():
+            for _ in range(2):  # consecutive device failures
+                with pytest.raises(FaultInjected):
+                    b.infer([_x()], timeout=30)
+        assert breaker.state == CircuitBreaker.OPEN
+        # health endpoints report not-ready with 503
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/v2/health/ready")
+        assert ei.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/v2/models/mlp/ready")
+        assert ei.value.code == 503
+        # liveness unaffected
+        assert json.load(urllib.request.urlopen(f"{base}/v2/health/live"))["live"]
+        # requests are rejected without touching the device
+        with pytest.raises(CircuitOpenError):
+            b.submit([_x()])
+        # recovery window elapses -> HALF_OPEN probe is admitted (fault
+        # plan exhausted its max_fires, so the probe succeeds)
+        clk.advance(11.0)
+        (out,) = b.infer([_x()], timeout=30)
+        assert out.shape == (1, 4)
+        assert breaker.state == CircuitBreaker.CLOSED
+        assert json.load(urllib.request.urlopen(f"{base}/v2/health/ready"))["ready"]
+        assert json.load(urllib.request.urlopen(f"{base}/v2/models/mlp/ready"))["ready"]
+
+
+def test_breaker_failed_probe_reopens():
+    clk = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, recovery_s=5.0, clock=clk)
+    assert br.allow()
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    clk.advance(6.0)
+    assert br.allow()  # probe admitted
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert not br.allow()  # single probe at a time
+    br.record_failure()  # probe failed -> fresh OPEN window
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    clk.advance(6.0)
+    assert br.allow()
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_grpc_health_and_backpressure_wiring(served_model):
+    pytest.importorskip("grpc")
+    import grpc as _grpc
+
+    from flexflow_tpu.serving.grpc_server import GrpcInferenceServer
+    from tests.test_serving import _grpc_stub
+
+    clk = FakeClock()
+    breaker = CircuitBreaker(failure_threshold=1, recovery_s=10.0, clock=clk)
+    srv = GrpcInferenceServer(port=0)
+    srv.register(served_model)
+    srv.batchers["mlp"].breaker = breaker
+    srv.batchers["mlp"].retry = _fast_retry(max_attempts=1)
+    plan = FaultPlan().on("serving.model.infer", mode="error", max_fires=1)
+    with srv:
+        channel, call, pb = _grpc_stub(srv.port)
+        assert call("ServerReady", pb.ServerReadyRequest(), pb.ServerReadyResponse).ready
+        with plan.active():
+            with pytest.raises(FaultInjected):
+                srv.batchers["mlp"].infer([_x()], timeout=30)
+            assert breaker.state == CircuitBreaker.OPEN
+            # breaker state surfaces through BOTH gRPC health rpcs
+            assert not call("ServerReady", pb.ServerReadyRequest(), pb.ServerReadyResponse).ready
+            assert not call(
+                "ModelReady", pb.ModelReadyRequest(name="mlp"), pb.ModelReadyResponse
+            ).ready
+            # and infer is rejected UNAVAILABLE while open
+            req = pb.ModelInferRequest(model_name="mlp")
+            t = req.inputs.add()
+            t.name = "x"
+            t.datatype = "FP32"
+            t.shape.extend([1, 16])
+            t.contents.fp32_contents.extend(_x().reshape(-1).tolist())
+            with pytest.raises(_grpc.RpcError) as ei:
+                call("ModelInfer", req, pb.ModelInferResponse)
+            assert ei.value.code() == _grpc.StatusCode.UNAVAILABLE
+            clk.advance(11.0)
+            (out,) = srv.batchers["mlp"].infer([_x()], timeout=30)  # probe
+            assert out.shape == (1, 4)
+        assert call("ServerReady", pb.ServerReadyRequest(), pb.ServerReadyResponse).ready
+        channel.close()
+
+
+# -------------------------------------------------------------------- drain
+def test_stop_drains_inflight_requests(served_model):
+    gate = threading.Event()
+    plan = FaultPlan().on("serving.batcher.dispatch", mode="stall", gate=gate)
+    b = _batcher(served_model, max_delay_s=0.001)
+    futs = []
+    try:
+        with plan.active():
+            futs.append(b.submit([_x(seed=0)]))
+            deadline = time.monotonic() + 5
+            while plan.fired("serving.batcher.dispatch") < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.001)
+            futs.append(b.submit([_x(seed=1)]))
+            futs.append(b.submit([_x(seed=2)]))
+            stopper = threading.Thread(target=lambda: b.stop(drain=True))
+            stopper.start()
+            time.sleep(0.01)
+            # draining rejects NEW work...
+            with pytest.raises(RuntimeError):
+                b.submit([_x(seed=3)])
+            # ...but queued work is not errored out
+            assert not any(f.done() and f.exception() for f in futs[1:])
+            gate.set()
+            stopper.join(timeout=30)
+            assert not stopper.is_alive()
+        # every queued request completed with a real result
+        for i, f in enumerate(futs):
+            (out,) = f.result(timeout=5)
+            (want,) = served_model.infer([_x(seed=i)])
+            np.testing.assert_allclose(out, want, rtol=1e-5)
+        assert not b._running
+    finally:
+        gate.set()
+        if b._running:
+            b.stop()
+
+
+# ------------------------------------------------------------------ elastic
+def _tiny_trainable():
+    from flexflow_tpu import LossType, SGDOptimizer
+
+    m = FFModel(FFConfig(batch_size=4))
+    x = m.create_tensor((4, 8), name="x")
+    m.dense(x, 8, name="f")
+    m.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=LossType.MEAN_SQUARED_ERROR)
+    return m
+
+
+def test_elastic_backoff_grows_exponentially_and_resets(tmp_path):
+    import jax.numpy as jnp
+
+    from flexflow_tpu.runtime.elastic import ElasticTrainer
+
+    m = _tiny_trainable()
+    rs = np.random.RandomState(0)
+    data = [(rs.randn(4, 8).astype(np.float32), rs.randn(4, 8).astype(np.float32))
+            for _ in range(6)]
+
+    def batches(step):
+        x, y = data[step]
+        return [jnp.asarray(x)], jnp.asarray(y)
+
+    # two CONSECUTIVE transient failures on elastic.step calls 2 and 3,
+    # then a clean run to the end
+    plan = FaultPlan().on(
+        "elastic.step", mode="error", error=TransientDeviceError, nth=(2, 3)
+    )
+    slept = []
+    t = ElasticTrainer(
+        m, str(tmp_path / "ck"), checkpoint_every=2, max_restarts=3,
+        backoff_base_s=0.05, backoff_jitter=0.0, sleep=slept.append,
+    )
+    with plan.active():
+        report = t.run(batches, num_steps=6)
+    assert report.restarts == 2
+    assert report.steps_completed == 6
+    assert report.backoffs == slept
+    # exponential while failing consecutively: base, then 2*base
+    assert slept == pytest.approx([0.05, 0.10])
+    assert len(report.failures) == 2
+    assert all("TransientDeviceError" in f for f in report.failures)
+
+
+def test_elastic_save_failure_keeps_training_and_previous_checkpoint(tmp_path):
+    import jax.numpy as jnp
+
+    from flexflow_tpu.runtime.elastic import ElasticTrainer
+
+    m = _tiny_trainable()
+    rs = np.random.RandomState(1)
+    data = [(rs.randn(4, 8).astype(np.float32), rs.randn(4, 8).astype(np.float32))
+            for _ in range(6)]
+
+    def batches(step):
+        x, y = data[step]
+        return [jnp.asarray(x)], jnp.asarray(y)
+
+    # second checkpoint save (call index 1) hits a storage fault
+    plan = FaultPlan().on("checkpoint.save", mode="error", nth=(1,))
+    t = ElasticTrainer(
+        m, str(tmp_path / "ck"), checkpoint_every=2, max_restarts=3,
+        backoff_base_s=0.001, backoff_jitter=0.0, sleep=_no_sleep,
+    )
+    with plan.active():
+        report = t.run(batches, num_steps=6)
+    assert report.steps_completed == 6  # the run survived the failed save
+    assert any("save at step 4" in f for f in report.failures)
+    # the failed save left no partial step_4 dir; step_2 stayed usable
+    # and the final save at step 6 landed
+    assert t.manager.latest_step() == 6
+    saved = sorted(p.name for p in (tmp_path / "ck").iterdir() if p.name.startswith("step_"))
+    assert "step_4" not in saved and "step_2" in saved
+    assert t.manager.restore_latest(m.executor) == 6
+
+
+def test_elastic_final_step_save_failure_returns_completed_run(tmp_path):
+    """A storage fault on the FINAL checkpoint must not throw away a
+    fully completed training run (nor burn a restart / backoff)."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.runtime.elastic import ElasticTrainer
+
+    m = _tiny_trainable()
+    rs = np.random.RandomState(2)
+    data = [(rs.randn(4, 8).astype(np.float32), rs.randn(4, 8).astype(np.float32))
+            for _ in range(4)]
+
+    def batches(step):
+        x, y = data[step]
+        return [jnp.asarray(x)], jnp.asarray(y)
+
+    # saves land at steps 2 (call 0) and 4 (call 1 == final); fail the final
+    plan = FaultPlan().on("checkpoint.save", mode="error", nth=(1,))
+    slept = []
+    t = ElasticTrainer(
+        m, str(tmp_path / "ck"), checkpoint_every=2, max_restarts=0,
+        sleep=slept.append,
+    )
+    with plan.active():
+        report = t.run(batches, num_steps=4)
+    assert report.steps_completed == 4
+    assert np.isfinite(report.final_loss)
+    assert any("save at step 4" in f for f in report.failures)
+    assert report.restarts == 0 and slept == []  # no restart burned, no backoff
+    assert t.manager.latest_step() == 2  # previous checkpoint still usable

@@ -1,0 +1,83 @@
+"""CheckpointManager retention + corruption behavior (ISSUE 1 satellite):
+max_to_keep GC order, restore_latest on empty/corrupt directories, and a
+failed save never poisoning the previous checkpoint.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.runtime.checkpoint import CheckpointManager
+from flexflow_tpu.runtime.faults import FaultInjected, FaultPlan
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture()
+def trained():
+    m = FFModel(FFConfig(batch_size=4))
+    x = m.create_tensor((4, 8), name="x")
+    m.dense(x, 8, name="f")
+    m.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=LossType.MEAN_SQUARED_ERROR)
+    return m
+
+
+def _step_dirs(root):
+    return sorted(
+        d for d in os.listdir(root) if d.startswith("step_")
+    )
+
+
+def test_max_to_keep_gc_removes_oldest_in_order(trained, tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+    for s in (1, 3, 7, 20, 100):
+        mgr.save(trained.executor, s)
+    # only the two NEWEST survive; GC is by numeric step order, so
+    # step_20/step_100 outlive step_7 even though "7" > "100" lexically
+    assert _step_dirs(mgr.directory) == ["step_100", "step_20"]
+    assert mgr.latest_step() == 100
+
+
+def test_restore_latest_on_empty_directory_returns_none(trained, tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "empty"), max_to_keep=3)
+    assert mgr.latest_step() is None
+    assert mgr.restore_latest(trained.executor) is None
+
+
+def test_restore_latest_falls_back_past_corrupt_newest(trained, tmp_path):
+    import jax
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=3)
+    mgr.save(trained.executor, 1)
+    want = [np.asarray(a) for a in jax.tree.leaves(trained.executor.params)]
+    # a later "checkpoint" that is really a half-written husk
+    corrupt = tmp_path / "ck" / "step_2"
+    corrupt.mkdir()
+    (corrupt / "train_state").write_bytes(b"not an orbax checkpoint")
+    assert mgr.latest_step() == 2  # it LOOKS newest...
+    assert mgr.restore_latest(trained.executor) == 1  # ...but 1 restores
+    got = [np.asarray(a) for a in jax.tree.leaves(trained.executor.params)]
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w)
+
+
+def test_restore_latest_raises_when_all_corrupt(trained, tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=3)
+    bad = tmp_path / "ck" / "step_5"
+    bad.mkdir()
+    (bad / "train_state").write_bytes(b"junk")
+    with pytest.raises(Exception):
+        mgr.restore_latest(trained.executor)
+
+
+def test_failed_save_leaves_previous_checkpoint_usable(trained, tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=3)
+    mgr.save(trained.executor, 1)
+    plan = FaultPlan().on("checkpoint.save", mode="error")
+    with plan.active():
+        with pytest.raises(FaultInjected):
+            mgr.save(trained.executor, 2)
+    # the partial step_2 dir was deleted, so it can't shadow step_1
+    assert _step_dirs(mgr.directory) == ["step_1"]
+    assert mgr.restore_latest(trained.executor) == 1

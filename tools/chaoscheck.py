@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""chaoscheck: run only the chaos (fault-injection) suite.
+
+The chaos tests exercise the serving-resilience layer through
+runtime/faults.py injection sites — backpressure, deadlines, retries,
+batch bisection, circuit breaking, graceful drain, elastic backoff, and
+checkpoint retention — on deterministic virtual clocks, so the whole
+sweep stays well inside the tier-1 time budget.
+
+Usage: python tools/chaoscheck.py [extra pytest args]
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":
+    cmd = [
+        sys.executable, "-m", "pytest", "tests", "-q",
+        "-m", "chaos",
+        "-p", "no:cacheprovider",
+        *sys.argv[1:],
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    sys.exit(subprocess.call(cmd, cwd=REPO, env=env))
