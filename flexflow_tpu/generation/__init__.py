@@ -1,0 +1,49 @@
+"""Autoregressive generation engine: KV-cache decode, prefill/decode
+split, and continuous batching.
+
+The reference's inference story is a one-shot compiled graph behind a
+Triton backend (SURVEY §2.9) — no token generation at all. This package
+is the TPU-native serving answer for decoder transformers:
+
+* :mod:`cache` — a preallocated, block-structured KV cache (vLLM /
+  PagedAttention-style block tables, SOSP'23) sized against a memory
+  budget, with a host-side block allocator;
+* :mod:`decoder` — a pure-JAX decoder-only transformer (pre-LN, causal)
+  whose full-context forward and incremental cached decode provably
+  produce the same logits;
+* :mod:`engine` — prefill/decode split with shape-bucketed, separately
+  jitted steps (steady-state decode never recompiles) and greedy /
+  temperature / top-k sampling;
+* :mod:`scheduler` — Orca-style iteration-level continuous batching
+  (OSDI'22): requests join the running batch at any decode step,
+  finished sequences free their cache blocks immediately, FCFS
+  admission is cache-capacity aware, and cache exhaustion preempts by
+  recompute.
+
+Serving integration lives in :mod:`flexflow_tpu.serving.generation`
+(`GenerationModel`), wired through the same deadline / backpressure /
+circuit-breaker paths as `InferenceModel`, with per-token streaming over
+HTTP (SSE) and gRPC.
+"""
+from .cache import BlockAllocator, CacheConfig, KVCache
+from .decoder import DecoderParams, forward_full, init_decoder_params
+from .engine import GenerationEngine, SamplingParams
+from .scheduler import (
+    ContinuousBatchingScheduler,
+    GenerationHandle,
+    Request,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "CacheConfig",
+    "ContinuousBatchingScheduler",
+    "DecoderParams",
+    "GenerationEngine",
+    "GenerationHandle",
+    "KVCache",
+    "Request",
+    "SamplingParams",
+    "forward_full",
+    "init_decoder_params",
+]

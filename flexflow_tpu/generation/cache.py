@@ -1,0 +1,193 @@
+"""Block-structured KV cache: preallocated device storage + host-side
+block accounting.
+
+vLLM/PagedAttention (SOSP'23) adapted to XLA's static-shape constraint:
+the cache is ONE preallocated array per K/V — ``[L, num_blocks,
+block_size, H, D]`` — and a sequence's cache is a *block table* (list of
+block ids) into it. Appending a token writes one ``(block, offset)``
+slot; nothing is ever moved or reallocated, so every jitted step sees
+the same cache shape regardless of how many sequences are live or how
+long they've grown. The reference has no KV cache at all (its attention
+is a one-shot cuDNN call, SURVEY §2.2).
+
+Block 0 is reserved as a **scratch block**: padded prompt positions and
+inactive decode slots scatter their (meaningless) K/V there, so the
+jitted steps never need dynamic shapes or masked scatters to avoid
+corrupting live sequences. The allocator simply never hands out
+block 0.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.types import DataType
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    """Geometry of the block-structured cache.
+
+    ``num_blocks`` INCLUDES the reserved scratch block 0, so the usable
+    capacity is ``(num_blocks - 1) * block_size`` token positions.
+    """
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    num_blocks: int
+    block_size: int = 16
+    dtype: DataType = DataType.FLOAT
+
+    def __post_init__(self):
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is scratch)")
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+
+    @property
+    def bytes_per_block(self) -> int:
+        """K + V bytes one block occupies across all layers."""
+        return (
+            2
+            * self.num_layers
+            * self.block_size
+            * self.num_heads
+            * self.head_dim
+            * self.dtype.size_bytes
+        )
+
+    @property
+    def total_bytes(self) -> int:
+        return self.num_blocks * self.bytes_per_block
+
+    @property
+    def usable_tokens(self) -> int:
+        return (self.num_blocks - 1) * self.block_size
+
+    def blocks_for(self, num_tokens: int) -> int:
+        """Blocks needed to hold ``num_tokens`` cache positions."""
+        return -(-max(0, num_tokens) // self.block_size)
+
+    @classmethod
+    def from_budget(
+        cls,
+        budget_bytes: int,
+        num_layers: int,
+        num_heads: int,
+        head_dim: int,
+        block_size: int = 16,
+        dtype: DataType = DataType.FLOAT,
+    ) -> "CacheConfig":
+        """Size the cache against a memory budget:
+
+            num_blocks = budget // (2 * L * block_size * H * D * dtype_bytes)
+
+        (the README's cache-budget sizing formula). Raises if the budget
+        cannot hold even scratch + one usable block.
+        """
+        per_block = 2 * num_layers * block_size * num_heads * head_dim * dtype.size_bytes
+        num_blocks = budget_bytes // per_block
+        if num_blocks < 2:
+            raise ValueError(
+                f"cache budget {budget_bytes}B holds {num_blocks} blocks of "
+                f"{per_block}B; need >= 2 (scratch + one usable)"
+            )
+        return cls(
+            num_layers=num_layers,
+            num_heads=num_heads,
+            head_dim=head_dim,
+            num_blocks=int(num_blocks),
+            block_size=block_size,
+            dtype=dtype,
+        )
+
+
+class KVCache:
+    """Device storage: ``k``/``v`` of shape [L, num_blocks, block_size,
+    H, D]. Functional updates — jitted steps take the arrays and return
+    replacements; this object just holds the current ones."""
+
+    def __init__(self, config: CacheConfig, k: jax.Array, v: jax.Array):
+        self.config = config
+        self.k = k
+        self.v = v
+
+    @classmethod
+    def create(cls, config: CacheConfig) -> "KVCache":
+        shape = (
+            config.num_layers,
+            config.num_blocks,
+            config.block_size,
+            config.num_heads,
+            config.head_dim,
+        )
+        zeros = jnp.zeros(shape, config.dtype.jnp)
+        return cls(config, zeros, zeros)
+
+    def update(self, k: jax.Array, v: jax.Array) -> None:
+        self.k = k
+        self.v = v
+
+
+class BlockAllocator:
+    """Host-side free list over the cache's blocks. Thread-safe: the
+    scheduler's admission path and the serving layer's cancellation path
+    may free concurrently. Block 0 (scratch) is never handed out."""
+
+    def __init__(self, config: CacheConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(config.num_blocks - 1, 0, -1))
+
+    @property
+    def num_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def num_total(self) -> int:
+        return self.config.num_blocks - 1
+
+    def can_allocate(self, n: int) -> bool:
+        return self.num_free >= n
+
+    def allocate(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` blocks, or None (atomically — no partial grabs)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            taken, self._free = self._free[:n], self._free[n:]
+            return taken
+
+    def free(self, blocks: List[int]) -> None:
+        with self._lock:
+            for b in blocks:
+                if b == 0:
+                    raise ValueError("block 0 is scratch; it is never allocated")
+                if b in self._free:
+                    raise ValueError(f"double free of block {b}")
+                self._free.append(b)
+
+
+def slot_mapping(
+    block_table: jnp.ndarray, positions: jnp.ndarray, block_size: int
+) -> jnp.ndarray:
+    """Flat cache slot (block * block_size + offset) for each position.
+
+    ``block_table``: [max_blocks] int32; ``positions``: [...] int32 of
+    cache positions. Positions past the table's coverage land in the
+    scratch block (block 0) instead of indexing out of bounds — callers
+    mask those positions out of attention anyway.
+    """
+    block_idx = positions // block_size
+    offset = positions % block_size
+    in_range = block_idx < block_table.shape[0]
+    block = jnp.where(in_range, block_table[jnp.clip(block_idx, 0, block_table.shape[0] - 1)], 0)
+    return block * block_size + jnp.where(in_range, offset, 0)

@@ -1,0 +1,195 @@
+"""Decoder-only transformer for the generation engine: a pure-JAX
+params pytree + three forward modes that provably agree.
+
+The graph-built transformers (models/transformer.py) lower to one-shot
+jitted programs with no state; generation needs a forward that can split
+into prefill (write the prompt's K/V into the cache) and decode (one
+token against cached K/V). This module keeps the same layer recipe as
+``attention_encoder_layer`` with ``causal=True`` — pre-LN residual
+blocks, GELU FFN, the ops/attention.py weight layouts ([E, H, D]
+projections, [H, D, E] output) — plus a learned absolute position
+embedding (cache positions index it directly) and a token-embedding
+front end with an LM head.
+
+Three forwards over one params pytree:
+
+* :func:`forward_full` — full-context causal forward, [B, S] -> logits
+  [B, S, V]. The parity oracle.
+* :func:`prefill` — forward_full that also returns every layer's K/V
+  ([L, B, S, H, D]) for the engine to scatter into the block cache,
+  with per-sequence length masking so padded prompt buckets match the
+  unpadded forward.
+* :func:`decode_step` — one token per sequence against the cache
+  (writes the token's K/V, then decode-mode attention), [B] -> logits
+  [B, V].
+
+``forward_full(tokens)[b, i] == decode logits after caching tokens[:i]``
+within fp32 tolerance — asserted by tests/test_generation.py.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import TransformerConfig
+from ..ops.attention import decode_attention_core, masked_attention
+from .cache import slot_mapping
+
+# a decoder is a plain pytree: jit-friendly, checkpoint-friendly
+DecoderParams = Dict[str, Any]
+
+
+def _glorot(rng, shape):
+    fan_in, fan_out = shape[0], shape[-1]
+    if len(shape) == 3:  # [E, H, D] / [H, D, E] projections
+        fan_in = shape[0] if shape[0] > shape[2] else shape[0] * shape[1]
+        fan_out = shape[1] * shape[2] if shape[0] > shape[2] else shape[2]
+    lim = (6.0 / (fan_in + fan_out)) ** 0.5
+    return jax.random.uniform(rng, shape, jnp.float32, -lim, lim)
+
+
+def init_decoder_params(
+    rng: jax.Array, cfg: TransformerConfig, max_positions: Optional[int] = None
+) -> DecoderParams:
+    """Initialize the decoder pytree for ``cfg`` (``vocab_size`` > 0)."""
+    if cfg.vocab_size <= 0:
+        raise ValueError("generation decoder needs cfg.vocab_size > 0")
+    e, h = cfg.hidden_size, cfg.num_heads
+    d = e // h
+    f, v = cfg.ff_size, cfg.vocab_size
+    p = max_positions or cfg.seq_length
+    keys = iter(jax.random.split(rng, 4 + 6 * cfg.num_layers))
+    params: DecoderParams = {
+        "tok_embed": _glorot(next(keys), (v, e)),
+        "pos_embed": 0.02 * jax.random.normal(next(keys), (p, e), jnp.float32),
+        "final_ln_g": jnp.ones((e,), jnp.float32),
+        "final_ln_b": jnp.zeros((e,), jnp.float32),
+        "lm_head": _glorot(next(keys), (e, v)),
+        "layers": [],
+    }
+    for _ in range(cfg.num_layers):
+        params["layers"].append(
+            {
+                "ln1_g": jnp.ones((e,), jnp.float32),
+                "ln1_b": jnp.zeros((e,), jnp.float32),
+                "wq": _glorot(next(keys), (e, h, d)),
+                "wk": _glorot(next(keys), (e, h, d)),
+                "wv": _glorot(next(keys), (e, h, d)),
+                "wo": _glorot(next(keys), (h, d, e)),
+                "ln2_g": jnp.ones((e,), jnp.float32),
+                "ln2_b": jnp.zeros((e,), jnp.float32),
+                "ff1": _glorot(next(keys), (e, f)),
+                "ff1_b": jnp.zeros((f,), jnp.float32),
+                "ff2": _glorot(next(keys), (f, e)),
+                "ff2_b": jnp.zeros((e,), jnp.float32),
+            }
+        )
+    return params
+
+
+def _ln(x, g, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _embed(params, tokens, positions):
+    return params["tok_embed"][tokens] + params["pos_embed"][positions]
+
+
+def _ffn(layer, x):
+    h = _ln(x, layer["ln2_g"], layer["ln2_b"])
+    h = jax.nn.gelu(h @ layer["ff1"] + layer["ff1_b"])
+    return x + h @ layer["ff2"] + layer["ff2_b"]
+
+
+def forward_full(
+    params: DecoderParams,
+    tokens: jax.Array,
+    lengths: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Full-context causal forward: [B, S] int32 -> logits [B, S, V].
+    ``lengths`` masks padded key positions (bucketed prompts)."""
+    b, s = tokens.shape
+    x = _embed(params, tokens, jnp.arange(s)[None, :])
+    lens = lengths if lengths is not None else jnp.full((b,), s, jnp.int32)
+    for layer in params["layers"]:
+        h = _ln(x, layer["ln1_g"], layer["ln1_b"])
+        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"])
+        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"])
+        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"])
+        ctx = masked_attention(q, k, v, lens, causal=True)
+        x = x + jnp.einsum("bshd,hde->bse", ctx, layer["wo"])
+        x = _ffn(layer, x)
+    x = _ln(x, params["final_ln_g"], params["final_ln_b"])
+    return x @ params["lm_head"]
+
+
+def prefill(
+    params: DecoderParams,
+    tokens: jax.Array,
+    lengths: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Prefill forward: logits [B, S, V] plus every layer's K/V
+    ([L, B, S, H, D] each) for the engine to write into the cache."""
+    b, s = tokens.shape
+    x = _embed(params, tokens, jnp.arange(s)[None, :])
+    ks, vs = [], []
+    for layer in params["layers"]:
+        h = _ln(x, layer["ln1_g"], layer["ln1_b"])
+        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"])
+        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"])
+        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"])
+        ks.append(k)
+        vs.append(v)
+        ctx = masked_attention(q, k, v, lengths, causal=True)
+        x = x + jnp.einsum("bshd,hde->bse", ctx, layer["wo"])
+        x = _ffn(layer, x)
+    x = _ln(x, params["final_ln_g"], params["final_ln_b"])
+    return x @ params["lm_head"], jnp.stack(ks), jnp.stack(vs)
+
+
+def decode_step(
+    params: DecoderParams,
+    tokens: jax.Array,
+    positions: jax.Array,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    block_tables: jax.Array,
+    context_lens: jax.Array,
+    backend: str = "cpu",
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for every batch slot.
+
+    tokens/positions: [B] int32 (the token being decoded and its cache
+    position); cache_k/cache_v: [L, num_blocks, block_size, H, D];
+    block_tables: [B, max_blocks]; context_lens: [B] — valid cache
+    positions INCLUDING this token (``positions + 1`` for live slots,
+    0 for inactive ones, whose writes land in scratch block 0).
+    Returns (logits [B, V], cache_k, cache_v) with the K/V written.
+    """
+    nb, bs = cache_k.shape[1], cache_k.shape[2]
+    x = _embed(params, tokens, positions)  # [B, E]
+    slots = jax.vmap(lambda bt, p: slot_mapping(bt, p, bs))(block_tables, positions)
+    for li, layer in enumerate(params["layers"]):
+        h = _ln(x, layer["ln1_g"], layer["ln1_b"])
+        q = jnp.einsum("be,ehd->bhd", h, layer["wq"])
+        k = jnp.einsum("be,ehd->bhd", h, layer["wk"])
+        v = jnp.einsum("be,ehd->bhd", h, layer["wv"])
+        # write this token's K/V, then attend over the updated cache so
+        # the token sees itself (context_lens includes it)
+        flat_k = cache_k[li].reshape(nb * bs, *cache_k.shape[3:])
+        flat_v = cache_v[li].reshape(nb * bs, *cache_v.shape[3:])
+        flat_k = flat_k.at[slots].set(k.astype(flat_k.dtype))
+        flat_v = flat_v.at[slots].set(v.astype(flat_v.dtype))
+        cache_k = cache_k.at[li].set(flat_k.reshape(cache_k.shape[1:]))
+        cache_v = cache_v.at[li].set(flat_v.reshape(cache_v.shape[1:]))
+        ctx = decode_attention_core(
+            q, cache_k[li], cache_v[li], block_tables, context_lens, backend=backend
+        )
+        x = x + jnp.einsum("bhd,hde->be", ctx, layer["wo"])
+        x = _ffn(layer, x)
+    x = _ln(x, params["final_ln_g"], params["final_ln_b"])
+    return x @ params["lm_head"], cache_k, cache_v

@@ -1,0 +1,269 @@
+"""Generation engine: prefill/decode split over the block KV cache.
+
+XLA has no dynamic shapes, so naive generation recompiles on every
+prompt length and batch size. The engine compiles a FIXED family of
+programs instead:
+
+* **prefill** — one jitted program per *prompt-length bucket* (prompt
+  padded up to the bucket; per-sequence length masking keeps logits
+  identical to the unpadded forward). A handful of buckets covers every
+  prompt, and a bucket compiles at most once.
+* **decode** — ONE jitted program, period: always ``max_batch_slots``
+  sequences (inactive slots masked to scratch block 0), always the same
+  block-table width. Steady-state decode NEVER recompiles, whatever
+  joins or leaves the batch — the property tools/genbench.py asserts.
+
+``trace_counts`` counts actual retraces (the Python body only runs at
+trace time), so tests and the bench can assert the compile behavior
+instead of trusting it.
+
+Sampling (greedy / temperature / top-k) runs inside the jitted steps —
+per-slot parameters are arrays, so mixed sampling configs share one
+program.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import TransformerConfig
+from ..runtime import faults
+from .cache import BlockAllocator, CacheConfig, KVCache, slot_mapping
+from .decoder import DecoderParams, decode_step, prefill
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling configuration.
+
+    ``temperature <= 0`` means greedy (argmax); ``top_k <= 0`` disables
+    the top-k filter. ``seed`` makes the request's sampling stream
+    deterministic — preemption-by-recompute replays the same stream.
+    """
+
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    eos_id: Optional[int] = None
+    seed: int = 0
+
+
+def default_buckets(max_seq_len: int, start: int = 16) -> Tuple[int, ...]:
+    """Doubling prompt-length buckets: start, 2*start, ... up to (and
+    including) max_seq_len."""
+    buckets: List[int] = []
+    b = min(start, max_seq_len)
+    while b < max_seq_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_seq_len)
+    return tuple(buckets)
+
+
+def _sample(logits, temps, top_ks, keys):
+    """Vectorized sampling: greedy where temp<=0, else temperature +
+    optional top-k. logits [B, V]; temps/top_ks [B]; keys [B] PRNG."""
+    v = logits.shape[-1]
+    greedy = temps <= 0.0
+    safe_t = jnp.where(greedy, 1.0, temps)
+    scaled = logits / safe_t[:, None]
+    k = jnp.where(top_ks <= 0, v, jnp.clip(top_ks, 1, v)).astype(jnp.int32)
+    sorted_desc = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
+    thresh = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled >= thresh, scaled, NEG_INF)
+    gumbel = jax.vmap(lambda key: jax.random.gumbel(key, (v,)))(keys)
+    sampled = jnp.argmax(masked + gumbel, axis=-1)
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1), sampled).astype(jnp.int32)
+
+
+class GenerationEngine:
+    """Owns the cache, the allocator, and the jitted step family. The
+    continuous-batching scheduler drives it; ``generate`` is a
+    convenience wrapper that spins up a private scheduler."""
+
+    def __init__(
+        self,
+        params: DecoderParams,
+        cfg: TransformerConfig,
+        cache_config: Optional[CacheConfig] = None,
+        *,
+        cache_budget_bytes: Optional[int] = None,
+        max_batch_slots: int = 4,
+        prompt_buckets: Optional[Sequence[int]] = None,
+        max_seq_len: Optional[int] = None,
+        block_size: int = 16,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.max_seq_len = max_seq_len or cfg.seq_length
+        self.max_batch_slots = max_batch_slots
+        if cache_config is None:
+            if cache_budget_bytes is not None:
+                cache_config = CacheConfig.from_budget(
+                    cache_budget_bytes,
+                    num_layers=cfg.num_layers,
+                    num_heads=cfg.num_heads,
+                    head_dim=cfg.hidden_size // cfg.num_heads,
+                    block_size=block_size,
+                )
+            else:
+                # enough for every slot to reach max_seq_len, plus scratch
+                per_seq = -(-self.max_seq_len // block_size)
+                cache_config = CacheConfig(
+                    num_layers=cfg.num_layers,
+                    num_heads=cfg.num_heads,
+                    head_dim=cfg.hidden_size // cfg.num_heads,
+                    num_blocks=1 + per_seq * max_batch_slots,
+                    block_size=block_size,
+                )
+        self.cache_config = cache_config
+        self.cache = KVCache.create(cache_config)
+        self.allocator = BlockAllocator(cache_config)
+        self.max_blocks_per_seq = cache_config.blocks_for(self.max_seq_len)
+        self.buckets = tuple(sorted(prompt_buckets or default_buckets(self.max_seq_len)))
+        if self.buckets[-1] > self.max_seq_len:
+            raise ValueError(
+                f"bucket {self.buckets[-1]} exceeds max_seq_len {self.max_seq_len}"
+            )
+        if self.buckets[-1] < self.max_seq_len:
+            # preemption-by-recompute re-prefills prompt + generated,
+            # which can reach max_seq_len - 1: there must be a bucket
+            # that holds it
+            self.buckets = self.buckets + (self.max_seq_len,)
+        self.backend = jax.default_backend()
+        # retrace counters: the Python body runs only when XLA traces, so
+        # these count compiles, not calls (genbench's recompile guard)
+        self.trace_counts: Dict[str, int] = {}
+        self._prefill_jit = jax.jit(self._prefill_impl)
+        self._decode_jit = jax.jit(self._decode_impl)
+
+    # ------------------------------------------------------------ geometry
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest bucket {self.buckets[-1]}"
+        )
+
+    # ------------------------------------------------------- jitted bodies
+    def _prefill_impl(self, params, tokens, length, cache_k, cache_v, block_table, temp, top_k, key):
+        s = tokens.shape[1]
+        self.trace_counts[f"prefill[{s}]"] = self.trace_counts.get(f"prefill[{s}]", 0) + 1
+        nb, bs = cache_k.shape[1], cache_k.shape[2]
+        logits, ks, vs = prefill(params, tokens, jnp.full((1,), length, jnp.int32))
+        positions = jnp.arange(s, dtype=jnp.int32)
+        slots = slot_mapping(block_table, positions, bs)
+        slots = jnp.where(positions < length, slots, 0)  # padding -> scratch
+
+        def write(cache, layer_kv):
+            flat = cache.reshape(nb * bs, *cache.shape[2:])
+            return flat.at[slots].set(layer_kv.astype(flat.dtype)).reshape(cache.shape)
+
+        cache_k = jax.vmap(write)(cache_k, ks[:, 0])
+        cache_v = jax.vmap(write)(cache_v, vs[:, 0])
+        last = logits[0, length - 1]
+        token = _sample(last[None], temp[None], top_k[None], key[None])[0]
+        return token, cache_k, cache_v
+
+    def _decode_impl(
+        self, params, tokens, positions, cache_k, cache_v, block_tables, context_lens, temps, top_ks, keys
+    ):
+        self.trace_counts["decode"] = self.trace_counts.get("decode", 0) + 1
+        logits, cache_k, cache_v = decode_step(
+            params, tokens, positions, cache_k, cache_v, block_tables,
+            context_lens, backend=self.backend,
+        )
+        return _sample(logits, temps, top_ks, keys), cache_k, cache_v
+
+    # ----------------------------------------------------------- host API
+    def prefill_one(
+        self,
+        prompt: Sequence[int],
+        block_table: Sequence[int],
+        sampling: SamplingParams,
+        key: jax.Array,
+    ) -> int:
+        """Prefill one sequence into its allocated blocks and sample its
+        first generated token. ``block_table`` is the sequence's block
+        ids (padded internally to the engine's fixed table width)."""
+        faults.inject("generation.prefill", prompt)
+        n = len(prompt)
+        bucket = self.bucket_for(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = prompt
+        table = np.zeros((self.max_blocks_per_seq,), np.int32)
+        table[: len(block_table)] = block_table
+        token, ck, cv = self._prefill_jit(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.int32(n),
+            self.cache.k,
+            self.cache.v,
+            jnp.asarray(table),
+            jnp.float32(sampling.temperature),
+            jnp.int32(sampling.top_k),
+            key,
+        )
+        self.cache.update(ck, cv)
+        return int(token)
+
+    def decode(
+        self,
+        tokens: np.ndarray,
+        positions: np.ndarray,
+        block_tables: np.ndarray,
+        active: np.ndarray,
+        temps: np.ndarray,
+        top_ks: np.ndarray,
+        keys: jax.Array,
+    ) -> np.ndarray:
+        """One decode step across all ``max_batch_slots`` slots. Arrays
+        are slot-indexed; inactive slots (active[i] False) write to
+        scratch and return garbage tokens the scheduler ignores."""
+        faults.inject("generation.decode_step", tokens)
+        context_lens = np.where(active, positions + 1, 0).astype(np.int32)
+        safe_pos = np.where(active, positions, 0).astype(np.int32)
+        out, ck, cv = self._decode_jit(
+            self.params,
+            jnp.asarray(np.where(active, tokens, 0).astype(np.int32)),
+            jnp.asarray(safe_pos),
+            self.cache.k,
+            self.cache.v,
+            jnp.asarray(block_tables.astype(np.int32)),
+            jnp.asarray(context_lens),
+            jnp.asarray(temps.astype(np.float32)),
+            jnp.asarray(top_ks.astype(np.int32)),
+            keys,
+        )
+        self.cache.update(ck, cv)
+        return np.asarray(out)
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        sampling: Optional[SamplingParams] = None,
+        **scheduler_kwargs,
+    ) -> List[List[int]]:
+        """Convenience: run ``prompts`` through a private continuous-
+        batching scheduler to completion; returns generated tokens per
+        prompt (prompt excluded)."""
+        from .scheduler import ContinuousBatchingScheduler
+
+        sampling = sampling or SamplingParams()
+        sched = ContinuousBatchingScheduler(self, **scheduler_kwargs)
+        handles = [sched.submit(list(p), sampling) for p in prompts]
+        while any(not h.done() for h in handles):
+            if not sched.step():
+                break
+        return [h.result(timeout=0) for h in handles]
+
+    def recompiles(self) -> Dict[str, int]:
+        """Retraces beyond the first compile, per program."""
+        return {k: v - 1 for k, v in self.trace_counts.items() if v > 1}

@@ -1,0 +1,538 @@
+"""Continuous batching: iteration-level scheduling of generation
+requests (Orca, OSDI'22) over the block KV cache.
+
+Unlike the request-level DynamicBatcher (serving/batcher.py), which
+holds a batch's composition fixed for a whole device call, generation
+is scheduled per *iteration*: every ``step()`` runs ONE decode across
+the engine's fixed batch slots, and between steps the batch recomposes
+freely —
+
+* **join-mid-flight**: a queued request is admitted (FCFS) the moment a
+  slot AND enough cache blocks are free; it prefils and decodes
+  alongside sequences that are hundreds of tokens in;
+* **free-on-finish**: a sequence hitting EOS / max-tokens / its
+  deadline releases its blocks in the same step, so capacity returns
+  immediately instead of at batch boundaries;
+* **preempt-by-recompute**: if the cache cannot grow a running
+  sequence, the youngest running sequence is evicted — blocks freed,
+  prompt + generated-so-far re-queued at the FRONT — and later
+  re-prefilled (vLLM's recompute preemption). Seeded sampling keys are
+  indexed by generated-token count, so a preempted request's token
+  stream continues exactly where it left off.
+
+Resilience mirrors PR 1's serving semantics: bounded queue
+(QueueFullError), per-request deadlines (DeadlineExceededError before
+OR during generation), retry-with-backoff for TransientDeviceError,
+and a circuit breaker around device steps — all on an injectable clock
+so chaos tests run on virtual time. Fault sites: ``generation.prefill``
+and ``generation.decode_step`` (runtime/faults.py).
+
+The scheduler is synchronous-by-design: ``step()`` does one iteration
+and returns, so property tests drive it deterministically; ``start()``
+wraps it in a background thread for serving.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..runtime import faults
+from ..serving.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    QueueFullError,
+    RetryPolicy,
+    ShuttingDownError,
+)
+from ..serving.stats import ServingStats, TokenRate
+from .engine import GenerationEngine, SamplingParams
+
+_END = object()  # token-stream sentinel
+
+
+class GenerationHandle:
+    """Caller's view of one request: a Future of the generated token
+    list plus a per-token stream."""
+
+    def __init__(self, request: "Request"):
+        self._request = request
+        self.future: Future = Future()
+        self._tokens: "queue.Queue" = queue.Queue()
+
+    # ----------------------------------------------------------- caller
+    def done(self) -> bool:
+        return self.future.done()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        return self.future.result(timeout=timeout)
+
+    def cancel(self) -> None:
+        """Ask the scheduler to drop this request at its next step."""
+        self._request.cancelled = True
+
+    def tokens(self, timeout: Optional[float] = None):
+        """Iterate generated tokens as they are produced. Raises the
+        request's failure if it errors mid-stream."""
+        while True:
+            item = self._tokens.get(timeout=timeout)
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    # -------------------------------------------------------- scheduler
+    def _emit(self, token: int) -> None:
+        self._tokens.put(token)
+
+    def _finish(self, tokens: List[int]) -> None:
+        self._tokens.put(_END)
+        if not self.future.done():
+            self.future.set_result(tokens)
+
+    def _fail(self, err: BaseException) -> None:
+        self._tokens.put(err)
+        self._tokens.put(_END)
+        if not self.future.done():
+            self.future.set_exception(err)
+
+
+class Request:
+    """One generation request. ``prompt`` may grow on preemption (the
+    generated prefix is folded in for recompute); ``n_generated`` is the
+    TOTAL generated count across preemptions, which also indexes the
+    per-request sampling key stream."""
+
+    _ids = itertools.count()
+
+    def __init__(
+        self,
+        prompt: List[int],
+        sampling: SamplingParams,
+        deadline: Optional[float] = None,
+    ):
+        self.id = next(Request._ids)
+        self.original_prompt = list(prompt)
+        self.prompt = list(prompt)  # prompt + recomputed prefix
+        self.sampling = sampling
+        self.deadline = deadline  # absolute, scheduler clock
+        self.submitted_at = 0.0  # stamped by the scheduler
+        # effective budget, possibly clamped to the cache room the
+        # scheduler can actually give this sequence
+        self.max_new = sampling.max_new_tokens
+        self.generated: List[int] = []  # tokens generated so far (total)
+        self.cancelled = False
+        self.preemptions = 0
+        self.handle = GenerationHandle(self)
+        # seed-only (no request-id mixing): the same seed + prompt +
+        # params must reproduce the same tokens, run to run
+        self.base_key = jax.random.key(sampling.seed)
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.generated)
+
+    def sample_key(self) -> jax.Array:
+        """Key for the NEXT token: indexed by generated count, so a
+        recomputed request continues its exact sampling stream."""
+        return jax.random.fold_in(self.base_key, self.n_generated)
+
+    def finished(self) -> bool:
+        if self.n_generated >= self.max_new:
+            return True
+        eos = self.sampling.eos_id
+        return eos is not None and bool(self.generated) and self.generated[-1] == eos
+
+
+class _Running:
+    """Slot-resident state for an admitted request."""
+
+    __slots__ = ("req", "slot", "blocks", "cached_len", "admitted_seq")
+
+    def __init__(self, req: Request, slot: int, blocks: List[int], cached_len: int, admitted_seq: int):
+        self.req = req
+        self.slot = slot
+        self.blocks = blocks
+        self.cached_len = cached_len  # cache positions written so far
+        self.admitted_seq = admitted_seq  # admission order, for LIFO preemption
+
+
+class ContinuousBatchingScheduler:
+    def __init__(
+        self,
+        engine: GenerationEngine,
+        *,
+        max_queue: int = 256,
+        clock: Callable[[], float] = time.monotonic,
+        breaker: Optional[CircuitBreaker] = None,
+        retry: Optional[RetryPolicy] = None,
+        idle_wait_s: float = 0.002,
+    ):
+        self.engine = engine
+        self.max_queue = max_queue
+        self.clock = clock
+        self.breaker = breaker or CircuitBreaker(clock=clock)
+        self.retry = retry or RetryPolicy()
+        self.idle_wait_s = idle_wait_s
+        self._queue: deque = deque()
+        self._running: Dict[int, _Running] = {}  # slot -> state
+        self._free_slots = list(range(engine.max_batch_slots - 1, -1, -1))
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._alive = False
+        self._draining = False
+        self._hard_stop = False
+        self._stopped = False  # a stopped (started-then-stopped) scheduler rejects submits
+        self._admitted_seq = itertools.count()
+        # observability (surfaced on /v2/stats via GenerationModel)
+        self.stats = ServingStats()
+        self.token_rate = TokenRate(clock=time.monotonic)
+        self.preemptions = 0
+        self.stats.add_gauge("queue_depth", lambda: len(self._queue))
+        self.stats.add_gauge("running", lambda: len(self._running))
+        self.stats.add_gauge("tokens_generated", lambda: self.token_rate.total)
+        self.stats.add_gauge("tokens_per_s", self.token_rate.rate)
+        self.stats.add_gauge("preemptions", lambda: self.preemptions)
+        self.stats.add_gauge(
+            "cache_blocks_used",
+            lambda: self.engine.allocator.num_total - self.engine.allocator.num_free,
+        )
+        self.stats.add_gauge("cache_blocks_total", lambda: self.engine.allocator.num_total)
+        self.stats.add_gauge(
+            "cache_occupancy",
+            lambda: 1.0 - self.engine.allocator.num_free / max(1, self.engine.allocator.num_total),
+        )
+        self.stats.add_gauge("recompiles", lambda: sum(self.engine.recompiles().values()))
+
+    # ------------------------------------------------------------- submit
+    def submit(
+        self,
+        prompt: Sequence[int],
+        sampling: Optional[SamplingParams] = None,
+        deadline_s: Optional[float] = None,
+    ) -> GenerationHandle:
+        """Enqueue one request (FCFS). Typed rejections mirror the
+        batcher: QueueFullError on backpressure, CircuitOpenError while
+        the breaker holds traffic, ShuttingDownError while draining,
+        DeadlineExceededError for an already-expired budget."""
+        if self._draining:
+            raise ShuttingDownError("generation scheduler draining")
+        if self._stopped:
+            raise ShuttingDownError("generation scheduler stopped")
+        sampling = sampling or SamplingParams()
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.engine.buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds max bucket {self.engine.buckets[-1]}"
+            )
+        room = self.engine.max_seq_len - len(prompt)
+        if room < 1:
+            raise ValueError(f"prompt fills max_seq_len {self.engine.max_seq_len}")
+        if (
+            self.engine.cache_config.blocks_for(len(prompt) + 1)
+            > self.engine.allocator.num_total
+        ):
+            raise ValueError("prompt exceeds total cache capacity; can never be admitted")
+        if deadline_s is not None and deadline_s <= 0:
+            self.stats.incr("expired")
+            raise DeadlineExceededError("deadline already expired at submit")
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                self.stats.incr("rejected")
+                raise QueueFullError(f"generation queue full ({self.max_queue})")
+            if not self.breaker.allow():
+                self.stats.incr("rejected")
+                raise CircuitOpenError("generation circuit open")
+            deadline = None if deadline_s is None else self.clock() + deadline_s
+            req = Request(list(prompt), sampling, deadline=deadline)
+            req.submitted_at = self.clock()
+            # the sequence can never outgrow max_seq_len (its last token
+            # would need a cache position past the block table) NOR the
+            # TOTAL cache: a sequence needing more blocks than exist
+            # would preempt-self forever at the head of the FCFS queue
+            cache_room = (
+                self.engine.allocator.num_total * self.engine.cache_config.block_size
+                - len(prompt)
+            )
+            req.max_new = min(sampling.max_new_tokens, room, cache_room)
+            self._queue.append(req)
+        self.stats.incr("admitted")
+        self._wake.set()
+        return req.handle
+
+    # ------------------------------------------------------------ control
+    def start(self) -> None:
+        if self._alive:
+            return
+        self._alive = True
+        self._draining = False
+        self._hard_stop = False
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Graceful by default: finish queued + running requests, then
+        exit. ``drain=False`` fails outstanding work immediately."""
+        if self._thread is None:
+            # never-started (manual-step) scheduler: honor the drain
+            # contract inline — queued futures must not hang forever
+            self._draining = True
+            if drain:
+                while self.has_work() and self.step():
+                    pass
+            self._abort_all(ShuttingDownError("scheduler stopped"))
+            self._draining = False
+            self._stopped = True
+            return
+        self._draining = True
+        self._alive = False
+        if not drain:
+            self._hard_stop = True  # loop exits after the current step
+        self._wake.set()
+        self._thread.join(timeout=timeout)
+        wedged = self._thread.is_alive()
+        self._thread = None
+        if wedged:
+            # a wedged step keeps ownership of the slot/allocator state;
+            # touching it here would race the live thread
+            return
+        if drain:
+            # the loop exited; anything still outstanding completes here
+            while self.has_work() and self.step():
+                pass
+        else:
+            # abort only AFTER the loop exited: _abort_all mutates
+            # _running/allocator state the stepping thread owns
+            self._abort_all(ShuttingDownError("scheduler stopped"))
+        self._draining = False
+        self._stopped = True
+
+    def _abort_all(self, err: BaseException) -> None:
+        with self._lock:
+            queued, self._queue = list(self._queue), deque()
+        for req in queued:
+            req.handle._fail(err)
+            self.stats.incr("failed")
+        for state in list(self._running.values()):
+            self._release(state)
+            state.req.handle._fail(err)
+            self.stats.incr("failed")
+
+    def ready(self) -> bool:
+        return not self._draining and self.breaker.ready()
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or bool(self._running)
+
+    def _loop(self) -> None:
+        while (self._alive or (self._draining and self.has_work())) and not self._hard_stop:
+            if not self.step():
+                self._wake.wait(timeout=self.idle_wait_s)
+                self._wake.clear()
+
+    # ---------------------------------------------------------- internals
+    def _release(self, state: _Running) -> None:
+        self.engine.allocator.free(state.blocks)
+        state.blocks = []
+        del self._running[state.slot]
+        self._free_slots.append(state.slot)
+
+    def _finish(self, state: _Running) -> None:
+        self._release(state)
+        req = state.req
+        self.stats.latency.record(max(0.0, self.clock() - req.submitted_at))
+        req.handle._finish(list(req.generated))
+        self.stats.incr("completed")
+
+    def _expire(self) -> None:
+        now = self.clock()
+        with self._lock:
+            keep: deque = deque()
+            for req in self._queue:
+                if req.cancelled:
+                    req.handle._fail(ShuttingDownError("request cancelled"))
+                    self.stats.incr("cancelled")
+                elif req.deadline is not None and now >= req.deadline:
+                    req.handle._fail(DeadlineExceededError("deadline expired while queued"))
+                    self.stats.incr("expired")
+                else:
+                    keep.append(req)
+            self._queue = keep
+        for state in list(self._running.values()):
+            req = state.req
+            if req.cancelled:
+                self._release(state)
+                req.handle._fail(ShuttingDownError("request cancelled"))
+                self.stats.incr("cancelled")
+            elif req.deadline is not None and now >= req.deadline:
+                self._release(state)
+                req.handle._fail(DeadlineExceededError("deadline expired mid-generation"))
+                self.stats.incr("expired")
+
+    def _device(self, fn):
+        """Run one device step under retry + breaker accounting."""
+        try:
+            out = self.retry.run(fn)
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return out
+
+    def _preempt_youngest(self, exclude: Optional[_Running] = None) -> bool:
+        """Evict the most recently admitted running sequence (vLLM's
+        LIFO recompute victim): free its blocks, fold its generated
+        tokens into the prompt, and requeue it at the FRONT."""
+        victims = [s for s in self._running.values() if s is not exclude]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda s: s.admitted_seq)
+        self._release(victim)
+        req = victim.req
+        req.prompt = req.original_prompt + list(req.generated)
+        req.preemptions += 1
+        self.preemptions += 1
+        with self._lock:
+            self._queue.appendleft(req)
+        return True
+
+    def _admit(self) -> bool:
+        """FCFS, cache-capacity-aware admission. Returns True if a
+        request was admitted (prefilled)."""
+        with self._lock:
+            if not self._queue or not self._free_slots:
+                return False
+            req = self._queue[0]
+            need = self.engine.cache_config.blocks_for(len(req.prompt) + 1)
+            blocks = self.engine.allocator.allocate(need)
+            if blocks is None:
+                return False
+            self._queue.popleft()
+            slot = self._free_slots.pop()
+        try:
+            token = self._device(
+                lambda: self.engine.prefill_one(
+                    req.prompt, blocks, req.sampling, req.sample_key()
+                )
+            )
+        except Exception as e:
+            self.engine.allocator.free(blocks)
+            self._free_slots.append(slot)
+            req.handle._fail(e)
+            self.stats.incr("failed")
+            return True  # did work (and must not spin on the same head)
+        state = _Running(req, slot, blocks, cached_len=len(req.prompt), admitted_seq=next(self._admitted_seq))
+        self._running[slot] = state
+        self._emit_token(state, token)
+        self.token_rate.record(1)
+        if req.finished():
+            self._finish(state)
+        return True
+
+    def _emit_token(self, state: _Running, token: int) -> None:
+        state.req.generated.append(int(token))
+        state.req.handle._emit(int(token))
+
+    def _grow(self) -> None:
+        """Ensure every running sequence has a cache slot for its next
+        token; preempt-by-recompute on exhaustion."""
+        for state in list(self._running.values()):
+            if self._running.get(state.slot) is not state:
+                continue  # preempted earlier in this sweep
+            need = self.engine.cache_config.blocks_for(state.cached_len + 1)
+            while len(state.blocks) < need:
+                got = self.engine.allocator.allocate(1)
+                if got is not None:
+                    state.blocks.extend(got)
+                    continue
+                if not self._preempt_youngest(exclude=state):
+                    # nothing left to evict but this sequence itself:
+                    # recompute it later when capacity returns
+                    self._preempt_self(state)
+                    break
+
+    def _preempt_self(self, state: _Running) -> None:
+        self._release(state)
+        req = state.req
+        req.prompt = req.original_prompt + list(req.generated)
+        req.preemptions += 1
+        self.preemptions += 1
+        with self._lock:
+            self._queue.appendleft(req)
+
+    def _decode_once(self) -> bool:
+        if not self._running:
+            return False
+        b = self.engine.max_batch_slots
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        tables = np.zeros((b, self.engine.max_blocks_per_seq), np.int32)
+        active = np.zeros((b,), bool)
+        temps = np.zeros((b,), np.float32)
+        top_ks = np.zeros((b,), np.int32)
+        keys = []
+        order = sorted(self._running.values(), key=lambda s: s.slot)
+        for state in order:
+            i = state.slot
+            req = state.req
+            tokens[i] = req.generated[-1] if req.generated else req.prompt[-1]
+            positions[i] = state.cached_len  # next cache position
+            tables[i, : len(state.blocks)] = state.blocks
+            active[i] = True
+            temps[i] = req.sampling.temperature
+            top_ks[i] = req.sampling.top_k
+        key_by_slot = {s.slot: s.req.sample_key() for s in order}
+        dummy = jax.random.key(0)
+        keys = jax.numpy.stack([key_by_slot.get(i, dummy) for i in range(b)])
+        try:
+            out = self._device(
+                lambda: self.engine.decode(
+                    tokens, positions, tables, active, temps, top_ks, keys
+                )
+            )
+        except Exception as e:
+            # a decode failure is batch-wide: fail every running request
+            # (leaf attribution like the batcher's bisection needs
+            # per-sequence device calls, which defeats batching here)
+            for state in list(self._running.values()):
+                self._release(state)
+                state.req.handle._fail(e)
+                self.stats.incr("failed")
+            return True
+        n_live = 0
+        for state in order:
+            if self._running.get(state.slot) is not state:
+                continue  # preempted/expired between collect and scatter
+            state.cached_len += 1
+            self._emit_token(state, int(out[state.slot]))
+            n_live += 1
+            if state.req.finished():
+                self._finish(state)
+        self.token_rate.record(n_live)
+        return True
+
+    # ---------------------------------------------------------------- step
+    def step(self) -> bool:
+        """One scheduling iteration: expire, admit (join-mid-flight),
+        grow/preempt, decode. Returns True if any work happened."""
+        self._expire()
+        did = False
+        # admit as many as fit THIS iteration — they decode together below
+        while self._admit():
+            did = True
+        self._grow()
+        if self._decode_once():
+            did = True
+        return did

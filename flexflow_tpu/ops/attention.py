@@ -201,6 +201,67 @@ def attention_core(
     return reference_attention(q, k, v, causal=causal, scale=scale)
 
 
+def decode_attention_core(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    context_lens: jax.Array,
+    backend: str = "tpu",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Decode-mode attention: one query token per sequence ([B, H, D])
+    over a block-structured KV cache with position masking, so
+    incremental decode reproduces full-context causal logits.
+
+    Dispatches to the Pallas paged-attention kernel on TPU backends
+    (kernels/decode_attention.py) and to the XLA gather + masked softmax
+    composition elsewhere (paged_decode_attention itself falls back on
+    pallas-less jax builds).
+    """
+    from .kernels.decode_attention import (
+        on_tpu,
+        paged_decode_attention,
+        reference_paged_attention,
+        supports_decode_shapes,
+    )
+
+    if (
+        backend == "tpu"
+        and on_tpu()
+        and supports_decode_shapes(q.shape[1], q.shape[2], k_cache.shape[1])
+    ):
+        return paged_decode_attention(
+            q, k_cache, v_cache, block_tables, context_lens, scale=scale
+        )
+    return reference_paged_attention(
+        q, k_cache, v_cache, block_tables, context_lens, scale=scale
+    )
+
+
+def masked_attention(q, k, v, lengths, causal=True, scale=None):
+    """Causal attention over [B, S, H, D] with a per-sequence valid
+    length: key positions >= lengths[b] are masked. The prefill side of
+    the decode split — bucketed (padded) prompts attend only over their
+    real tokens, so prefill logits match the unpadded forward."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    sq, sk = logits.shape[-2], logits.shape[-1]
+    mask = jnp.arange(sk)[None, :] < lengths[:, None]  # [B, Sk]
+    mask = mask[:, None, None, :]
+    if causal:
+        mask = jnp.logical_and(
+            mask, jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)[None, None]
+        )
+    logits = jnp.where(mask, logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    # fully-masked rows (padding queries) get uniform-zero probs, not NaN
+    p = jnp.where(mask, jnp.exp(logits - jnp.maximum(m, -1e30)), 0.0)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    return jnp.einsum("bhqk,bkhd->bqhd", (p / l).astype(v.dtype), v)
+
+
 def reference_attention(q, k, v, causal=False, scale=None):
     if scale is None:
         scale = q.shape[-1] ** -0.5

@@ -1,0 +1,195 @@
+"""Paged decode attention: one query token per sequence attending over a
+block-structured KV cache.
+
+The generation engine's decode step calls this once per layer: ``q`` is
+[B, H, D] (the token being decoded, one per batch slot), and the cached
+K/V live in the block-structured cache (generation/cache.py) as
+[num_blocks, block_size, H, D] per layer, indexed per sequence through a
+block table. Position masking keeps only cache positions
+``< context_len`` in the softmax, so incremental decode reproduces the
+full-context causal logits exactly.
+
+Two lowerings:
+
+* :func:`reference_paged_attention` — gather the table'd blocks and run
+  a masked softmax in plain XLA. This is the CPU/test path and the
+  parity oracle.
+* :func:`paged_decode_attention` — a Pallas TPU kernel gridded over
+  (batch, cache blocks) with the block tables scalar-prefetched
+  (``pltpu.PrefetchScalarGridSpec``), so each grid step DMAs exactly
+  one cache block into VMEM (the PagedAttention access pattern) and
+  accumulates online-softmax state in scratch across the sequential
+  grid. Out-of-range table entries point at the scratch block 0 and are
+  masked, never read out of bounds.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:  # the XLA reference path below must work without pallas at all
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover - pallas-less jax build
+    pl = pltpu = None
+
+NEG_INF = -1e30
+
+
+def on_tpu() -> bool:
+    """True on real TPU backends (incl. the tunneled 'axon' platform)."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def reference_paged_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    context_lens: jax.Array,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Masked attention over gathered cache blocks, in plain XLA.
+
+    q: [B, H, D]; k_cache/v_cache: [num_blocks, block_size, H, D];
+    block_tables: [B, max_blocks] int32; context_lens: [B] int32
+    (number of valid cache positions, INCLUDING the current token's
+    already-written K/V). Returns [B, H, D]. Sequences with
+    context_len == 0 (inactive slots) produce zeros, not NaN.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    bs = k_cache.shape[1]
+    b, max_blocks = block_tables.shape
+    # [B, max_blocks, bs, H, D] -> [B, S_max, H, D]
+    k = k_cache[block_tables].reshape(b, max_blocks * bs, *k_cache.shape[2:])
+    v = v_cache[block_tables].reshape(b, max_blocks * bs, *v_cache.shape[2:])
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_blocks * bs)[None, None, :]
+    valid = pos < context_lens[:, None, None]
+    s = jnp.where(valid, s, NEG_INF)
+    # max over an all-masked row is NEG_INF; subtracting keeps exp at 1
+    # on masked lanes, so zero the probabilities explicitly instead of
+    # relying on exp(-inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.where(valid, jnp.exp(s - m), 0.0)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bhk,bkhd->bhd", p / l, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    bt_ref,  # scalar-prefetch: [B, max_blocks] block tables
+    len_ref,  # scalar-prefetch: [B] context lens
+    q_ref,  # [H, D] this sequence's query
+    k_ref,  # [block_size, H, D] the grid step's cache block
+    v_ref,  # [block_size, H, D]
+    o_ref,  # [H, D]
+    m_ref,  # scratch [H, 1] running max
+    l_ref,  # scratch [H, 1] running denominator
+    acc_ref,  # scratch [H, D] running numerator
+    *,
+    scale,
+    block_size,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nblocks = pl.num_programs(1)
+    ctx = len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # whole block past the context: nothing to accumulate (its DMA read
+    # the scratch block; the data is ignored)
+    @pl.when(j * block_size < ctx)
+    def _accum():
+        q = q_ref[:].astype(jnp.float32) * scale  # [H, D]
+        k = k_ref[:].astype(jnp.float32)  # [bs, H, D]
+        v = v_ref[:].astype(jnp.float32)
+        # s[h, t] = sum_d q[h, d] * k[t, h, d] — batch over H on the MXU
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))), preferred_element_type=jnp.float32
+        )  # [H, bs]
+        pos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ctx, s, NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(pos < ctx, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # acc[h, d] += sum_t p[h, t] * v[t, h, d]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))), preferred_element_type=jnp.float32
+        )  # [H, D]
+        acc_ref[:] = acc_ref[:] * corr + pv
+
+    @pl.when(j == nblocks - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:], 1e-30)
+        # an inactive slot (ctx == 0) accumulated nothing: emit zeros
+        out = jnp.where(ctx > 0, acc_ref[:] / l, 0.0)
+        o_ref[:] = out.astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    context_lens: jax.Array,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Pallas paged decode attention (shapes as in
+    :func:`reference_paged_attention`). ``interpret=None`` auto-selects
+    interpret mode off-TPU so the kernel path is testable on CPU."""
+    if pl is None or pltpu is None:
+        return reference_paged_attention(q, k_cache, v_cache, block_tables, context_lens, scale)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = not on_tpu()
+    b, h, d = q.shape
+    _, block_size, _, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_blocks),
+        in_specs=[
+            pl.BlockSpec((None, h, d), lambda i, j, bt, ln: (i, 0, 0)),
+            pl.BlockSpec((None, block_size, h, d), lambda i, j, bt, ln: (bt[i, j], 0, 0, 0)),
+            pl.BlockSpec((None, block_size, h, d), lambda i, j, bt, ln: (bt[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, h, d), lambda i, j, bt, ln: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, scale=float(scale), block_size=block_size)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), q, k_cache, v_cache)
+
+
+def supports_decode_shapes(num_heads: int, head_dim: int, block_size: int) -> bool:
+    """Shapes the TPU kernel handles without falling back: lane-multiple
+    head_dim and a sublane-multiple block size."""
+    return head_dim in (64, 128, 256) and block_size % 8 == 0 and num_heads >= 1

@@ -177,7 +177,12 @@ def gpipe(
             # FRESH zeros (not zeros_like) so the bank starts invarying
             # and the pcast below can set its full variance explicitly.
             outs0 = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), xs_local)
-            aux0 = jnp.zeros((), jnp.float32)
+            # rank-1, not scalar: a 0-d aux residual crossing the
+            # shard_map fwd/bwd partial-eval split trips _check_names
+            # (jax 0.4.x promotes scalar residuals on only some paths —
+            # residual out_names {0: axes} is invalid for ndim-0), which
+            # surfaced as a _SpecError under jax.grad of pipelined MoE
+            aux0 = jnp.zeros((1,), jnp.float32)
             if hasattr(jax.lax, "pcast"):
                 # newer shard_map tracks varying manual axes: each carry
                 # leaf must enter the scan with the variance it will have
@@ -275,7 +280,7 @@ def gpipe(
         )
         if with_aux:
             y, aux = result
-            return unmb(y), aux
+            return unmb(y), aux.reshape(())  # callers see the scalar aux
         return unmb(result)
 
     return pipelined

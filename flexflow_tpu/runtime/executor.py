@@ -532,7 +532,10 @@ class CompiledExecutor:
                 )
                 return (act_out, aux_out), None
 
-            aux0 = jnp.zeros((), jnp.float32)
+            # rank-1 like gpipe's accumulator: scalar scan-carry residuals
+            # crossing the shard_map partial-eval split hit the jax 0.4.x
+            # _check_names scalar-residual hole (see parallel/pipeline.py)
+            aux0 = jnp.zeros((1,), jnp.float32)
             if hasattr(jax.lax, "pcast"):
                 # newer shard_map tracks varying manual axes: the aux
                 # accumulator picks up pipe (per-stage weights), data

@@ -28,6 +28,8 @@ Injection sites currently threaded through the codebase:
   ``serving.batcher.dispatch``  before the batcher runs a device batch (value = requests)
   ``serving.repository.load``   before a repository model load
   ``checkpoint.save``           top of save_checkpoint
+  ``generation.prefill``        before a generation prefill (value = prompt tokens)
+  ``generation.decode_step``    before each batched decode step (value = slot tokens)
 
 Usage::
 

@@ -16,30 +16,40 @@ from .resilience import (
     ShuttingDownError,
 )
 from .server import InferenceServer
+from .stats import LatencyWindow, ServingStats, TokenRate
 
 __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
     "DeadlineExceededError",
     "DynamicBatcher",
+    "GenerationModel",
     "GrpcInferenceServer",
     "InferenceModel",
     "InferenceServer",
+    "LatencyWindow",
     "ModelRepository",
     "QueueFullError",
     "ResilienceError",
     "RetryPolicy",
+    "ServingStats",
     "ShuttingDownError",
     "TensorMeta",
+    "TokenRate",
     "load_model",
     "save_model",
 ]
 
 
 def __getattr__(name):
-    # lazy: grpc_server pulls in grpcio + protobuf only when used
+    # lazy: grpc_server pulls in grpcio + protobuf only when used;
+    # GenerationModel pulls in the generation package (jax tracing)
     if name == "GrpcInferenceServer":
         from .grpc_server import GrpcInferenceServer
 
         return GrpcInferenceServer
+    if name == "GenerationModel":
+        from .generation import GenerationModel
+
+        return GenerationModel
     raise AttributeError(name)

@@ -53,13 +53,19 @@ from .resilience import (
 
 
 class _Request:
-    __slots__ = ("inputs", "future", "n", "deadline")
+    __slots__ = ("inputs", "future", "n", "deadline", "t_submit")
 
-    def __init__(self, inputs: Sequence[np.ndarray], deadline: Optional[float] = None):
+    def __init__(
+        self,
+        inputs: Sequence[np.ndarray],
+        deadline: Optional[float] = None,
+        t_submit: float = 0.0,
+    ):
         self.inputs = inputs
         self.future: Future = Future()
         self.n = inputs[0].shape[0]
         self.deadline = deadline  # absolute, on the batcher's clock
+        self.t_submit = t_submit  # for the latency stats
 
 
 def make_batcher(model: InferenceModel, kwargs: dict) -> "DynamicBatcher":
@@ -100,6 +106,11 @@ class DynamicBatcher:
         self.clock = clock
         self.breaker = breaker or CircuitBreaker(clock=clock)
         self.retry = retry or RetryPolicy()
+        # /v2/stats: admission counters + request latency + queue depth
+        from .stats import ServingStats
+
+        self.stats = ServingStats()
+        self.stats.add_gauge("queue_depth", lambda: self._q.qsize())
         # unbounded Queue; the bound is enforced in submit() via qsize so
         # control sentinels can never block behind a full queue
         self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
@@ -208,17 +219,21 @@ class DynamicBatcher:
             if x.shape[0] != n:
                 raise ValueError("all inputs in a request must share the batch dim")
         if deadline_s is not None and deadline_s <= 0:
+            self.stats.incr("expired")
             raise DeadlineExceededError("deadline already expired at submit")
         if self._q.qsize() >= self.max_queue:
+            self.stats.incr("rejected")
             raise QueueFullError(
                 f"model {self.model.name!r}: request queue full ({self.max_queue})"
             )
         # breaker LAST so a rejection on the cheap checks above can never
         # consume (and leak) the HALF_OPEN probe slot
         if not self.breaker.allow():
+            self.stats.incr("rejected")
             raise CircuitOpenError(f"model {self.model.name!r}: circuit open")
         deadline = None if deadline_s is None else self.clock() + deadline_s
-        req = _Request(arrays, deadline=deadline)
+        req = _Request(arrays, deadline=deadline, t_submit=self.clock())
+        self.stats.incr("admitted")
         self._q.put(req)
         # close the submit/stop race: if stop() ran to completion between
         # the liveness checks above and the put, neither the collector nor
@@ -256,6 +271,7 @@ class DynamicBatcher:
             return False
         if req.deadline is not None and self.clock() >= req.deadline:
             if not req.future.done():
+                self.stats.incr("expired")
                 req.future.set_exception(
                     DeadlineExceededError("deadline expired before dispatch")
                 )
@@ -333,13 +349,17 @@ class DynamicBatcher:
                 self.breaker.record_failure()
                 r = batch[0]
                 if not r.future.done():
+                    self.stats.incr("failed")
                     r.future.set_exception(e)
             return
         self.breaker.record_success()
         off = 0
+        now = self.clock()
         for r in batch:
             if not r.future.done():
                 r.future.set_result([o[off : off + r.n] for o in outs])
+                self.stats.incr("completed")
+                self.stats.latency.record(max(0.0, now - r.t_submit))
             off += r.n
 
     def _loop(self):
@@ -356,6 +376,7 @@ class DynamicBatcher:
             for r in batch:
                 if r.deadline is not None and now >= r.deadline:
                     if not r.future.done():
+                        self.stats.incr("expired")
                         r.future.set_exception(
                             DeadlineExceededError("deadline expired before dispatch")
                         )

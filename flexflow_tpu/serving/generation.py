@@ -1,0 +1,105 @@
+"""GenerationModel: a streaming-generation servable next to
+InferenceModel.
+
+Where `InferenceModel` is a one-shot compiled graph behind the
+request-level DynamicBatcher, a GenerationModel owns a
+ContinuousBatchingScheduler (generation/scheduler.py) — requests join
+the running decode batch at iteration granularity and stream tokens
+back as they are produced. The HTTP front end serves it on
+``POST /v2/models/{name}/generate`` (JSON, or SSE when streaming) and
+the gRPC front end on ``ModelStreamInfer``; both reuse PR 1's status
+mapping (backpressure 503/RESOURCE_EXHAUSTED, expired deadline
+504/DEADLINE_EXCEEDED, open breaker 503/UNAVAILABLE) because the
+scheduler raises the same typed ResilienceErrors as the batcher.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..generation.engine import GenerationEngine, SamplingParams
+from ..generation.scheduler import ContinuousBatchingScheduler, GenerationHandle
+
+
+class GenerationModel:
+    """One servable generation engine: name + scheduler + health view."""
+
+    def __init__(
+        self,
+        engine: GenerationEngine,
+        name: str = "generator",
+        **scheduler_kwargs,
+    ):
+        self.engine = engine
+        self.name = name
+        self.scheduler = ContinuousBatchingScheduler(engine, **scheduler_kwargs)
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        self.scheduler.start()
+
+    def stop(self, drain: bool = True) -> None:
+        self.scheduler.stop(drain=drain)
+
+    def ready(self) -> bool:
+        return self.scheduler.ready()
+
+    @property
+    def breaker(self):
+        return self.scheduler.breaker
+
+    @property
+    def stats(self):
+        return self.scheduler.stats
+
+    # --------------------------------------------------------------- run
+    def submit(
+        self,
+        prompt: Sequence[int],
+        sampling: Optional[SamplingParams] = None,
+        deadline_s: Optional[float] = None,
+    ) -> GenerationHandle:
+        return self.scheduler.submit(prompt, sampling, deadline_s=deadline_s)
+
+    def generate(
+        self,
+        prompt: Sequence[int],
+        sampling: Optional[SamplingParams] = None,
+        timeout: Optional[float] = None,
+    ) -> List[int]:
+        """Blocking single-request generation (deadline = timeout)."""
+        handle = self.submit(prompt, sampling, deadline_s=timeout)
+        return handle.result(timeout=timeout)
+
+    @staticmethod
+    def sampling_from(params: Dict) -> SamplingParams:
+        """Build SamplingParams from a request-level dict (HTTP JSON body
+        fields / gRPC parameters map), ignoring unknown keys."""
+        defaults = SamplingParams()
+        eos = params.get("eos_id")
+        return SamplingParams(
+            max_new_tokens=int(params.get("max_new_tokens", defaults.max_new_tokens)),
+            temperature=float(params.get("temperature", defaults.temperature)),
+            top_k=int(params.get("top_k", defaults.top_k)),
+            eos_id=None if eos is None else int(eos),
+            seed=int(params.get("seed", defaults.seed)),
+        )
+
+    def metadata(self) -> Dict:
+        cfg = self.engine.cfg
+        cc = self.engine.cache_config
+        return {
+            "name": self.name,
+            "platform": "flexflow_tpu_generation",
+            "max_batch_slots": self.engine.max_batch_slots,
+            "max_seq_len": self.engine.max_seq_len,
+            "prompt_buckets": list(self.engine.buckets),
+            "vocab_size": cfg.vocab_size,
+            "cache": {
+                "num_blocks": cc.num_blocks,
+                "block_size": cc.block_size,
+                "usable_tokens": cc.usable_tokens,
+                "bytes": cc.total_bytes,
+            },
+            "inputs": [{"name": "tokens", "shape": (-1,), "datatype": "INT32"}],
+            "outputs": [{"name": "tokens", "shape": (-1,), "datatype": "INT32"}],
+        }

@@ -133,10 +133,12 @@ class GrpcInferenceServer:
         if http_server is not None:
             self.models = http_server.models
             self.batchers = http_server.batchers
+            self.generators = http_server.generators
             self.repository = repository or http_server.repository
         else:
             self.models: Dict[str, InferenceModel] = {}
             self.batchers: Dict[str, DynamicBatcher] = {}
+            self.generators: Dict = {}
             self.repository = repository
         self._server = None
         self._started = False
@@ -160,6 +162,15 @@ class GrpcInferenceServer:
             b.stop()
         return self.models.pop(name, None) is not None
 
+    def register_generation(self, model):
+        """Serve a GenerationModel; its ModelStreamInfer RPC streams one
+        ModelInferResponse per generated token."""
+        if self._shared is not None:
+            return self._shared.register_generation(model)
+        self.generators[model.name] = model
+        if self._started:
+            model.start()
+
     def start(self):
         grpc = self._grpc
         handlers = {
@@ -181,6 +192,13 @@ class GrpcInferenceServer:
             )
             for meth, (req_cls, fn) in handlers.items()
         }
+        # per-token generation streaming: same ModelInfer messages, one
+        # response per token (Triton's ModelStreamInfer shape)
+        rpc_handlers["ModelStreamInfer"] = grpc.unary_stream_rpc_method_handler(
+            self._model_stream_infer,
+            request_deserializer=pb.ModelInferRequest.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
         generic = grpc.method_handlers_generic_handler(_SERVICE, rpc_handlers)
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=self.max_workers)
@@ -190,6 +208,8 @@ class GrpcInferenceServer:
         if self._shared is None:
             for b in self.batchers.values():
                 b.start()
+            for g in self.generators.values():
+                g.start()
         self._started = True
         self._server.start()
 
@@ -204,6 +224,8 @@ class GrpcInferenceServer:
             if self._shared is None:
                 for b in self.batchers.values():
                     b.stop(drain=drain)
+                for g in self.generators.values():
+                    g.stop(drain=drain)
         finally:
             self._draining = False
         self._started = False
@@ -224,9 +246,14 @@ class GrpcInferenceServer:
         if self._shared is not None and self._shared._draining:
             return False
         # snapshot: repository load/unload mutates the dict concurrently
-        return all(b.breaker.ready() for b in list(self.batchers.values()))
+        return all(b.breaker.ready() for b in list(self.batchers.values())) and all(
+            g.breaker.ready() for g in list(self.generators.values())
+        )
 
     def _is_model_ready(self, name: str) -> bool:
+        g = self.generators.get(name)
+        if g is not None:
+            return g.ready()
         b = self.batchers.get(name)
         return b is not None and b.ready()
 
@@ -245,6 +272,21 @@ class GrpcInferenceServer:
 
     def _model_metadata(self, request, context):
         grpc = self._grpc
+        g = self.generators.get(request.name)
+        if g is not None:
+            # generation servable: same discovery surface as the HTTP
+            # front end's GET /v2/models/{name}
+            md = g.metadata()
+            resp = pb.ModelMetadataResponse(
+                name=md["name"], versions=["1"], platform=md["platform"]
+            )
+            for io, dest in ((md["inputs"], resp.inputs), (md["outputs"], resp.outputs)):
+                for meta in io:
+                    t = dest.add()
+                    t.name = meta["name"]
+                    t.datatype = meta["datatype"]
+                    t.shape.extend(meta["shape"])
+            return resp
         m = self.models.get(request.name)
         if m is None:
             self._abort(context, grpc.StatusCode.NOT_FOUND, f"unknown model {request.name}")
@@ -327,6 +369,73 @@ class GrpcInferenceServer:
             else:
                 _array_to_tensor(resp.outputs.add(), meta.name, o)
         return resp
+
+    def _model_stream_infer(self, request, context):
+        """Streaming generation: request carries the prompt as an INT32
+        "tokens" input; sampling rides the parameters map
+        (max_new_tokens / top_k / eos_id / seed as int64_param,
+        temperature as string_param). Yields one response per generated
+        token, then a final summary response with the full sequence."""
+        grpc = self._grpc
+        gen = self.generators.get(request.model_name)
+        if gen is None:
+            self._abort(
+                context, grpc.StatusCode.NOT_FOUND,
+                f"unknown generation model {request.model_name}",
+            )
+        from .resilience import ResilienceError, grpc_code
+
+        try:
+            by_name = {t.name: t for t in request.inputs}
+            if request.raw_input_contents:
+                if len(request.raw_input_contents) != len(request.inputs):
+                    raise ValueError("raw_input_contents length must match inputs")
+                arrays = {
+                    t.name: _tensor_from_raw(t, raw)
+                    for t, raw in zip(request.inputs, request.raw_input_contents)
+                }
+                prompt = [int(x) for x in arrays["tokens"].reshape(-1)]
+            else:
+                if "tokens" not in by_name:
+                    raise ValueError("missing input 'tokens'")
+                prompt = [int(x) for x in _tensor_to_array(by_name["tokens"]).reshape(-1)]
+            params = {}
+            for key, p in request.parameters.items():
+                kind = p.WhichOneof("parameter_choice")
+                params[key] = getattr(p, kind) if kind else None
+            sampling = gen.sampling_from(params)
+            remaining = context.time_remaining()
+            handle = gen.submit(prompt, sampling, deadline_s=remaining)
+        except ResilienceError as e:
+            self._abort(context, grpc_code(e, grpc), str(e))
+        except Exception as e:
+            self._abort(context, grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        wait = remaining if remaining is not None else 300.0
+        try:
+            i = 0
+            for tok in handle.tokens(timeout=wait):
+                resp = pb.ModelInferResponse(model_name=request.model_name, id=request.id)
+                t = resp.outputs.add()
+                t.name = "token"
+                t.datatype = "INT32"
+                t.shape.extend([1])
+                t.contents.int_contents.append(int(tok))
+                yield resp
+                i += 1
+            final = pb.ModelInferResponse(model_name=request.model_name, id=request.id)
+            t = final.outputs.add()
+            t.name = "tokens"
+            t.datatype = "INT32"
+            toks = handle.result(timeout=wait)
+            t.shape.extend([len(toks)])
+            t.contents.int_contents.extend(int(x) for x in toks)
+            yield final
+        except ResilienceError as e:
+            handle.cancel()
+            self._abort(context, grpc_code(e, grpc), str(e))
+        except Exception as e:
+            handle.cancel()
+            self._abort(context, grpc.StatusCode.INTERNAL, str(e))
 
     # ---------------------------------------------------------- repository
     def _repo_index(self, request, context):

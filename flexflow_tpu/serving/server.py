@@ -8,9 +8,18 @@ API. This server implements the same surface directly (stdlib only):
   GET  /v2/health/ready                    -> 200 only when actually able
                                               to serve (not draining, no
                                               model breaker open)
+  GET  /v2/stats                           -> per-model serving stats
+                                              (queue depth, admission
+                                              counters, latency,
+                                              generation tokens/s +
+                                              cache occupancy)
   GET  /v2/models/{name}                   -> model metadata
   GET  /v2/models/{name}/ready             -> per-model readiness
   POST /v2/models/{name}/infer             -> run inference
+  POST /v2/models/{name}/generate          -> autoregressive generation
+                                              (GenerationModel); JSON
+                                              response, or SSE token
+                                              stream with "stream": true
 
 Infer request JSON: {"inputs": [{"name", "shape", "datatype", "data"}]},
 response mirrors it — the v2 tensor format with row-major flat data. A
@@ -71,6 +80,7 @@ class InferenceServer:
         self.port = port
         self.models: Dict[str, InferenceModel] = {}
         self.batchers: Dict[str, DynamicBatcher] = {}
+        self.generators: Dict[str, "GenerationModel"] = {}  # noqa: F821
         self.max_delay_s = max_delay_s
         self.repository = repository
         # per-model batcher construction knobs (breaker/retry/clock are
@@ -97,6 +107,19 @@ class InferenceServer:
             b.stop()
         return self.models.pop(name, None) is not None
 
+    def register_generation(self, model: "GenerationModel"):  # noqa: F821
+        """Serve a GenerationModel (serving/generation.py) next to the
+        batched InferenceModels."""
+        self.generators[model.name] = model
+        if self._httpd is not None:
+            model.start()
+
+    def unregister_generation(self, name: str) -> bool:
+        g = self.generators.pop(name, None)
+        if g is not None:
+            g.stop()
+        return g is not None
+
     # ------------------------------------------------------------- health
     def live(self) -> bool:
         return True
@@ -107,11 +130,26 @@ class InferenceServer:
         if self._httpd is None or self._draining:
             return False
         # snapshot: repository load/unload mutates the dict concurrently
-        return all(b.breaker.ready() for b in list(self.batchers.values()))
+        return all(b.breaker.ready() for b in list(self.batchers.values())) and all(
+            g.breaker.ready() for g in list(self.generators.values())
+        )
 
     def model_ready(self, name: str) -> bool:
+        g = self.generators.get(name)
+        if g is not None:
+            return g.ready()
         b = self.batchers.get(name)
         return b is not None and b.ready()
+
+    def stats(self) -> Dict:
+        """Aggregate /v2/stats payload: batcher counters + generation
+        engine throughput/occupancy, one entry per model."""
+        return {
+            "models": {n: b.stats.snapshot() for n, b in list(self.batchers.items())},
+            "generation": {
+                n: g.stats.snapshot() for n, g in list(self.generators.items())
+            },
+        }
 
     # ------------------------------------------------------------ control
     def start(self):
@@ -162,12 +200,17 @@ class InferenceServer:
                 if self.path == "/v2/health/ready":
                     ok = server.ready()
                     return self._json(200 if ok else 503, {"ready": ok})
+                if self.path == "/v2/stats":
+                    return self._json(200, server.stats())
                 if self.path == "/v2/models":
-                    return self._json(200, {"models": sorted(server.models)})
+                    return self._json(
+                        200,
+                        {"models": sorted(set(server.models) | set(server.generators))},
+                    )
                 if self.path.startswith("/v2/models/"):
                     parts = self.path.split("/")
                     name = parts[3]
-                    m = server.models.get(name)
+                    m = server.models.get(name) or server.generators.get(name)
                     if m is None:
                         return self._json(404, {"error": f"unknown model {name}"})
                     if len(parts) == 5 and parts[4] == "ready":
@@ -176,10 +219,75 @@ class InferenceServer:
                     return self._json(200, m.metadata())
                 return self._json(404, {"error": "not found"})
 
+            def _generate(self, name: str):
+                """POST /v2/models/{name}/generate — body: {"prompt":
+                [ids], "max_new_tokens", "temperature", "top_k",
+                "eos_id", "seed", "stream", "parameters": {"timeout_ms"}}.
+                Non-streaming: one JSON object. "stream": true: SSE — one
+                ``data:`` event per token, then a final done event."""
+                gen = server.generators.get(name)
+                if gen is None:
+                    return self._json(404, {"error": f"unknown generation model {name}"})
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length))
+                    prompt = [int(t) for t in req["prompt"]]
+                    sampling = gen.sampling_from(req)
+                    stream = bool(req.get("stream", False))
+                    timeout_ms = (req.get("parameters") or {}).get(
+                        "timeout_ms", self.headers.get("X-Request-Timeout-Ms")
+                    )
+                    deadline_s = None if timeout_ms is None else float(timeout_ms) / 1000.0
+                    handle = gen.submit(prompt, sampling, deadline_s=deadline_s)
+                except ResilienceError as e:
+                    return self._json(http_status(e), {"error": str(e)})
+                except Exception as e:
+                    return self._json(400, {"error": str(e)})
+                wait = deadline_s if deadline_s is not None else 300.0
+                if not stream:
+                    try:
+                        tokens = handle.result(timeout=wait)
+                    except ResilienceError as e:
+                        return self._json(http_status(e), {"error": str(e)})
+                    except (TimeoutError, _FuturesTimeout):
+                        handle.cancel()
+                        return self._json(504, {"error": "generation timed out"})
+                    except Exception as e:
+                        return self._json(500, {"error": str(e)})
+                    return self._json(
+                        200, {"model_name": name, "tokens": tokens, "num_generated": len(tokens)}
+                    )
+                # SSE stream: status/headers are already committed once the
+                # first token flushes, so mid-stream failures surface as an
+                # error event, not a status code
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+
+                def event(payload: dict):
+                    self.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
+                    self.wfile.flush()
+
+                count = 0
+                try:
+                    for tok in handle.tokens(timeout=wait):
+                        event({"token": int(tok), "index": count})
+                        count += 1
+                    event({"done": True, "tokens": handle.result(timeout=wait)})
+                except Exception as e:
+                    handle.cancel()
+                    try:
+                        event({"error": str(e), "done": True})
+                    except OSError:
+                        pass  # client went away mid-stream
+
             def do_POST(self):
                 parts = self.path.split("/")
                 if len(parts) >= 3 and parts[1] == "v2" and parts[2] == "repository":
                     return self._repository(parts)
+                if len(parts) == 5 and parts[1] == "v2" and parts[2] == "models" and parts[4] == "generate":
+                    return self._generate(parts[3])
                 if len(parts) < 5 or parts[1] != "v2" or parts[2] != "models" or parts[4] != "infer":
                     return self._json(404, {"error": "not found"})
                 name = parts[3]
@@ -245,6 +353,8 @@ class InferenceServer:
         self.port = self._httpd.server_address[1]  # resolve port 0
         for b in self.batchers.values():
             b.start()
+        for g in self.generators.values():
+            g.start()
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
 
@@ -256,6 +366,8 @@ class InferenceServer:
         try:
             for b in self.batchers.values():
                 b.stop(drain=drain)
+            for g in self.generators.values():
+                g.stop(drain=drain)
             if self._httpd:
                 self._httpd.shutdown()
                 self._httpd.server_close()

@@ -1,0 +1,126 @@
+"""Serving statistics: per-model counters, latency summaries, and
+gauges, surfaced on the HTTP server's ``/v2/stats`` endpoint.
+
+One struct serves both serving paths: the dynamic batcher counts
+admissions/rejections/expiries and per-request latency; the generation
+engine reports tokens/s and cache occupancy through the same struct via
+``gauges`` (zero-arg callables evaluated at snapshot time, so the
+endpoint always reads live values without the stats object holding
+references into hot-path state).
+
+Thread-safety: counters take a lock (collector threads, HTTP handler
+threads, and the generation scheduler all write concurrently);
+snapshots are consistent-enough reads for monitoring, not transactions.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, Optional
+
+
+class LatencyWindow:
+    """Rolling window of the last ``maxlen`` request latencies with
+    cheap summary stats (count is cumulative; percentiles are over the
+    window)."""
+
+    def __init__(self, maxlen: int = 512):
+        self._lock = threading.Lock()
+        self._window: deque = deque(maxlen=maxlen)
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_s += seconds
+            self.max_s = max(self.max_s, seconds)
+            self._window.append(seconds)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            window = sorted(self._window)
+            n = len(window)
+            pct = lambda p: window[min(n - 1, int(p * n))] if n else 0.0
+            return {
+                "count": self.count,
+                "mean_s": self.total_s / self.count if self.count else 0.0,
+                "max_s": self.max_s,
+                "p50_s": pct(0.50),
+                "p95_s": pct(0.95),
+                "p99_s": pct(0.99),
+            }
+
+
+class ServingStats:
+    """Counters + latency + live gauges for one served model."""
+
+    COUNTERS = ("admitted", "rejected", "expired", "completed", "failed", "cancelled")
+
+    def __init__(self, latency_window: int = 512):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {c: 0 for c in self.COUNTERS}
+        self.latency = LatencyWindow(latency_window)
+        # name -> zero-arg callable returning a number (queue depth,
+        # cache occupancy, tokens/s ...), evaluated at snapshot time
+        self.gauges: Dict[str, Callable[[], float]] = {}
+
+    def incr(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[counter] = self._counts.get(counter, 0) + n
+
+    def get(self, counter: str) -> int:
+        with self._lock:
+            return self._counts.get(counter, 0)
+
+    def add_gauge(self, name: str, fn: Callable[[], float]) -> None:
+        self.gauges[name] = fn
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            counts = dict(self._counts)
+        out: Dict = dict(counts)
+        out["latency"] = self.latency.snapshot()
+        for name, fn in self.gauges.items():
+            try:
+                out[name] = fn()
+            except Exception:  # a dying gauge must not kill /v2/stats
+                out[name] = None
+        return out
+
+
+class TokenRate:
+    """Windowed tokens/s gauge for the generation engine: record token
+    batches as they are emitted; ``rate()`` is tokens over the trailing
+    ``window_s`` seconds of the supplied clock."""
+
+    def __init__(self, clock: Callable[[], float], window_s: float = 10.0):
+        self._clock = clock
+        self._window_s = window_s
+        self._lock = threading.Lock()
+        self._events: deque = deque()  # (t, n_tokens)
+        self.total = 0
+
+    def record(self, n_tokens: int) -> None:
+        now = self._clock()
+        with self._lock:
+            self.total += n_tokens
+            self._events.append((now, n_tokens))
+            self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        while self._events and now - self._events[0][0] > self._window_s:
+            self._events.popleft()
+
+    def rate(self) -> float:
+        now = self._clock()
+        with self._lock:
+            self._trim(now)
+            if not self._events:
+                return 0.0
+            span = max(now - self._events[0][0], 1e-9)
+            n = sum(c for _, c in self._events)
+            # a single instantaneous burst has no measurable span; report
+            # it over the window instead of a 1e9 spike
+            return n / (span if span > 1e-6 else self._window_s)

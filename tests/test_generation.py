@@ -1,0 +1,495 @@
+"""Generation subsystem tests: KV-cache correctness, the prefill/decode
+split, continuous batching, and the serving surface.
+
+Acceptance criteria covered (ISSUE 2):
+  * incremental KV-cache decode logits == full-context forward logits
+    (fp32, ~1e-5) across prompt lengths straddling bucket boundaries
+  * scheduler property tests on a virtual clock: join-mid-flight,
+    free-on-finish, preempt-on-full (with exact stream continuity)
+  * steady-state decode never recompiles (trace counters)
+  * resilience parity with the batcher: queue-full, deadlines, retry,
+    breaker — through the generation.prefill / generation.decode_step
+    fault sites
+  * HTTP generate (JSON + SSE) and /v2/stats
+"""
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.generation import (
+    BlockAllocator,
+    CacheConfig,
+    ContinuousBatchingScheduler,
+    GenerationEngine,
+    KVCache,
+    SamplingParams,
+    forward_full,
+    init_decoder_params,
+)
+from flexflow_tpu.generation.decoder import decode_step, prefill
+from flexflow_tpu.generation.cache import slot_mapping
+from flexflow_tpu.models.transformer import TransformerConfig
+from flexflow_tpu.runtime import faults
+from flexflow_tpu.runtime.faults import FaultInjected, FaultPlan, TransientDeviceError
+from flexflow_tpu.serving import RetryPolicy
+from flexflow_tpu.serving.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    QueueFullError,
+)
+
+pytestmark = pytest.mark.generation
+
+CFG = TransformerConfig(
+    num_layers=2, hidden_size=32, num_heads=4, ff_size=64,
+    seq_length=64, vocab_size=50, causal=True,
+)
+BUCKETS = (8, 16, 32, 64)
+BLOCK = 8
+
+
+class FakeClock:
+    """Virtual time for deadlines and breaker recovery windows (same
+    idiom as tests/test_chaos.py)."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def decoder_params():
+    return init_decoder_params(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def engine(decoder_params):
+    """Shared engine: jit traces amortize across the module's tests."""
+    return GenerationEngine(
+        decoder_params, CFG, max_batch_slots=3, block_size=BLOCK, prompt_buckets=BUCKETS
+    )
+
+
+def make_engine(decoder_params, num_blocks, slots=3):
+    cc = CacheConfig(
+        num_layers=CFG.num_layers, num_heads=CFG.num_heads,
+        head_dim=CFG.hidden_size // CFG.num_heads,
+        num_blocks=num_blocks, block_size=BLOCK,
+    )
+    return GenerationEngine(
+        decoder_params, CFG, cache_config=cc, max_batch_slots=slots, prompt_buckets=BUCKETS
+    )
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    assert faults.active_plan() is None, "a test leaked an installed FaultPlan"
+
+
+def naive_greedy(params, prompt, n):
+    seq = list(prompt)
+    for _ in range(n):
+        logits = forward_full(params, jnp.asarray([seq], jnp.int32))
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    return seq[len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# cache + allocator
+# ---------------------------------------------------------------------------
+
+
+def test_block_allocator_roundtrip():
+    cc = CacheConfig(num_layers=1, num_heads=2, head_dim=8, num_blocks=5, block_size=4)
+    alloc = BlockAllocator(cc)
+    assert alloc.num_total == 4  # block 0 reserved as scratch
+    a = alloc.allocate(3)
+    assert a is not None and 0 not in a and len(set(a)) == 3
+    assert alloc.allocate(2) is None  # atomic: no partial grab
+    assert alloc.num_free == 1
+    alloc.free(a)
+    assert alloc.num_free == 4
+    with pytest.raises(ValueError):
+        alloc.free(a[:1])  # double free
+    with pytest.raises(ValueError):
+        alloc.free([0])  # scratch is never allocatable
+
+
+def test_cache_budget_sizing():
+    cc = CacheConfig.from_budget(
+        1 << 20, num_layers=2, num_heads=4, head_dim=8, block_size=16
+    )
+    assert cc.bytes_per_block == 2 * 2 * 16 * 4 * 8 * 4
+    assert cc.num_blocks == (1 << 20) // cc.bytes_per_block
+    assert cc.total_bytes <= 1 << 20
+    with pytest.raises(ValueError):
+        CacheConfig.from_budget(100, num_layers=2, num_heads=4, head_dim=8)
+
+
+def test_slot_mapping_out_of_table_hits_scratch():
+    table = jnp.asarray([3, 7], jnp.int32)
+    slots = slot_mapping(table, jnp.asarray([0, 5, 9, 100], jnp.int32), 4)
+    np.testing.assert_array_equal(np.asarray(slots), [12, 29, 0, 0])
+
+
+# ---------------------------------------------------------------------------
+# KV-cache correctness: incremental decode == full-context forward
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("prompt_len", [5, 8, 9, 15, 16, 17, 31])
+def test_decode_logits_match_full_forward(decoder_params, prompt_len):
+    """The acceptance criterion, at logits level: prefill a prompt into
+    the cache, decode step by step, and compare every decode logit
+    vector to the full-context forward at that position. Lengths
+    straddle the 8/16/32 bucket boundaries."""
+    rs = np.random.RandomState(prompt_len)
+    prompt = rs.randint(0, CFG.vocab_size, prompt_len).tolist()
+    n_new = 4
+    cc = CacheConfig(
+        num_layers=CFG.num_layers, num_heads=CFG.num_heads,
+        head_dim=CFG.hidden_size // CFG.num_heads, num_blocks=10, block_size=BLOCK,
+    )
+    cache = KVCache.create(cc)
+    blocks = list(range(1, 9))
+    table = jnp.asarray(blocks + [0] * 0, jnp.int32)
+
+    # prefill: bucketed/padded like the engine does it
+    bucket = next(b for b in BUCKETS if b >= prompt_len)
+    padded = np.zeros((1, bucket), np.int32)
+    padded[0, :prompt_len] = prompt
+    logits_pre, ks, vs = prefill(
+        decoder_params, jnp.asarray(padded), jnp.asarray([prompt_len], jnp.int32)
+    )
+    positions = jnp.arange(bucket, dtype=jnp.int32)
+    slots = slot_mapping(table, positions, BLOCK)
+    slots = jnp.where(positions < prompt_len, slots, 0)
+    nb, bs = cc.num_blocks, cc.block_size
+
+    def write(cache_arr, layer_kv):
+        flat = cache_arr.reshape(nb * bs, *cache_arr.shape[2:])
+        return flat.at[slots].set(layer_kv).reshape(cache_arr.shape)
+
+    ck = jax.vmap(write)(cache.k, ks[:, 0])
+    cv = jax.vmap(write)(cache.v, vs[:, 0])
+
+    seq = list(prompt)
+    full = forward_full(decoder_params, jnp.asarray([seq], jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(logits_pre[0, prompt_len - 1]),
+        np.asarray(full[0, -1]),
+        atol=1e-5,
+        err_msg="padded prefill logits != unpadded forward",
+    )
+    tables = jnp.asarray([blocks], jnp.int32)
+    for step in range(n_new):
+        tok = int(jnp.argmax(full[0, -1]))
+        seq.append(tok)
+        pos = len(seq) - 1
+        logits, ck, cv = decode_step(
+            decoder_params,
+            jnp.asarray([tok], jnp.int32),
+            jnp.asarray([pos], jnp.int32),
+            ck, cv, tables,
+            jnp.asarray([pos + 1], jnp.int32),
+            backend="cpu",
+        )
+        full = forward_full(decoder_params, jnp.asarray([seq], jnp.int32))
+        np.testing.assert_allclose(
+            np.asarray(logits[0]), np.asarray(full[0, -1]), atol=1e-5,
+            err_msg=f"decode logits diverged at step {step} (prompt_len {prompt_len})",
+        )
+
+
+@pytest.mark.parametrize("prompt_len", [7, 8, 9, 16, 17])
+def test_engine_greedy_matches_naive(engine, decoder_params, prompt_len):
+    """End-to-end through the engine + scheduler: greedy generation
+    equals argmax-over-full-recompute, across bucket boundaries."""
+    rs = np.random.RandomState(100 + prompt_len)
+    prompt = rs.randint(0, CFG.vocab_size, prompt_len).tolist()
+    (out,) = engine.generate([prompt], SamplingParams(max_new_tokens=5))
+    assert out == naive_greedy(decoder_params, prompt, 5)
+
+
+def test_eos_stops_generation(engine, decoder_params):
+    prompt = [1, 2, 3]
+    ref = naive_greedy(decoder_params, prompt, 8)
+    eos = ref[2]
+    (out,) = engine.generate([prompt], SamplingParams(max_new_tokens=8, eos_id=eos))
+    assert out == ref[:3] and out[-1] == eos
+
+
+def test_pallas_decode_kernel_matches_reference():
+    """The TPU lowering, in interpret mode, against the XLA path."""
+    from flexflow_tpu.ops.kernels.decode_attention import (
+        paged_decode_attention,
+        reference_paged_attention,
+    )
+
+    rs = np.random.RandomState(0)
+    b, h, d, nb, bs, mb = 3, 4, 64, 10, 8, 4
+    q = jnp.asarray(rs.randn(b, h, d).astype(np.float32))
+    kc = jnp.asarray(rs.randn(nb, bs, h, d).astype(np.float32))
+    vc = jnp.asarray(rs.randn(nb, bs, h, d).astype(np.float32))
+    bt = jnp.asarray(rs.randint(0, nb, (b, mb)).astype(np.int32))
+    cl = jnp.asarray(np.array([5, 17, 0], np.int32))  # incl. inactive slot
+    ref = reference_paged_attention(q, kc, vc, bt, cl)
+    ker = paged_decode_attention(q, kc, vc, bt, cl, interpret=True)
+    np.testing.assert_allclose(np.asarray(ker), np.asarray(ref), atol=1e-5)
+    assert float(jnp.max(jnp.abs(ref[2]))) == 0.0  # inactive -> zeros, not NaN
+
+
+# ---------------------------------------------------------------------------
+# recompilation discipline
+# ---------------------------------------------------------------------------
+
+
+def test_steady_state_decode_never_recompiles(decoder_params):
+    eng = make_engine(decoder_params, num_blocks=30, slots=3)
+    prompts = [[1, 2, 3], list(range(10)), [7] * 17, [4, 5], list(range(30))]
+    eng.generate(prompts, SamplingParams(max_new_tokens=6))
+    assert eng.trace_counts.get("decode") == 1, eng.trace_counts
+    assert eng.recompiles() == {}, eng.trace_counts
+    # a second wave of different lengths/batch compositions: still no
+    # new traces for warm buckets
+    eng.generate([[9] * 5, [8] * 12], SamplingParams(max_new_tokens=3))
+    assert eng.trace_counts.get("decode") == 1, eng.trace_counts
+    assert eng.recompiles() == {}, eng.trace_counts
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching scheduler properties (virtual clock, manual step)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_join_mid_flight(decoder_params):
+    """A request submitted while another is decoding joins the running
+    batch at the next step, not at a batch boundary — and both outputs
+    match solo runs."""
+    eng = make_engine(decoder_params, num_blocks=30, slots=3)
+    solo_a = naive_greedy(decoder_params, [1, 2, 3], 8)
+    solo_b = naive_greedy(decoder_params, [9, 8, 7, 6], 4)
+    sched = ContinuousBatchingScheduler(eng, clock=FakeClock())
+    ha = sched.submit([1, 2, 3], SamplingParams(max_new_tokens=8))
+    for _ in range(3):
+        sched.step()
+    a_progress = len(ha._request.generated)
+    assert 0 < a_progress < 8
+    hb = sched.submit([9, 8, 7, 6], SamplingParams(max_new_tokens=4))
+    sched.step()  # B admitted mid-flight...
+    assert len(hb._request.generated) >= 1  # ...and already producing
+    assert not ha.done()
+    for _ in range(20):
+        if ha.done() and hb.done():
+            break
+        sched.step()
+    assert ha.result(0) == solo_a
+    assert hb.result(0) == solo_b
+
+
+def test_scheduler_free_on_finish(decoder_params):
+    """Blocks return to the allocator the step a sequence finishes."""
+    eng = make_engine(decoder_params, num_blocks=30, slots=2)
+    sched = ContinuousBatchingScheduler(eng, clock=FakeClock())
+    free0 = eng.allocator.num_free
+    h = sched.submit([1, 2, 3, 4, 5], SamplingParams(max_new_tokens=3))
+    sched.step()
+    assert eng.allocator.num_free < free0
+    for _ in range(10):
+        if h.done():
+            break
+        sched.step()
+    assert h.done()
+    assert eng.allocator.num_free == free0
+
+
+def test_scheduler_preempt_on_full_recomputes_exactly(decoder_params):
+    """Cache exhaustion preempts the youngest sequence by recompute;
+    sampled token streams continue exactly where they left off."""
+    sp1 = SamplingParams(max_new_tokens=10, temperature=0.8, top_k=10, seed=42)
+    sp2 = SamplingParams(max_new_tokens=10, temperature=0.7, top_k=8, seed=7)
+    big = make_engine(decoder_params, num_blocks=40)
+    ref1 = big.generate([[1, 2, 3, 4, 5]], sp1)[0]
+    ref2 = big.generate([[9, 8, 7]], sp2)[0]
+
+    small = make_engine(decoder_params, num_blocks=4)  # 24 usable positions
+    sched = ContinuousBatchingScheduler(small, clock=FakeClock())
+    h1 = sched.submit([1, 2, 3, 4, 5], sp1)
+    h2 = sched.submit([9, 8, 7], sp2)
+    for _ in range(200):
+        if h1.done() and h2.done():
+            break
+        sched.step()
+    assert sched.preemptions > 0
+    assert h1.result(0) == ref1
+    assert h2.result(0) == ref2
+    assert small.allocator.num_free == small.allocator.num_total
+
+
+def test_scheduler_deadline_and_queue_bounds(decoder_params):
+    eng = make_engine(decoder_params, num_blocks=30, slots=1)
+    clock = FakeClock()
+    sched = ContinuousBatchingScheduler(eng, clock=clock, max_queue=2)
+    with pytest.raises(DeadlineExceededError):
+        sched.submit([1, 2], SamplingParams(), deadline_s=0)
+    h = sched.submit([1, 2], SamplingParams(max_new_tokens=50), deadline_s=5.0)
+    sched.submit([3, 4], SamplingParams())
+    with pytest.raises(QueueFullError):  # bound counts WAITING requests
+        sched.submit([5, 6], SamplingParams())
+    sched.step()
+    assert not h.done()
+    clock.advance(10.0)  # h expires mid-generation, queued ones still live
+    sched.step()
+    with pytest.raises(DeadlineExceededError):
+        h.result(0)
+    assert eng.allocator.num_free == eng.allocator.num_total - 1  # only the running seq holds blocks
+    assert sched.stats.get("expired") == 2
+
+
+def test_scheduler_chaos_transient_retry_and_poison(decoder_params):
+    """A transient decode fault is retried invisibly; a hard fault fails
+    the affected requests and trips the breaker toward OPEN."""
+    eng = make_engine(decoder_params, num_blocks=30, slots=2)
+    clock = FakeClock()
+    retry = RetryPolicy(max_attempts=3, sleep=lambda _s: None)
+    breaker = CircuitBreaker(failure_threshold=2, recovery_s=30.0, clock=clock)
+    sched = ContinuousBatchingScheduler(eng, clock=clock, retry=retry, breaker=breaker)
+    ref = naive_greedy(decoder_params, [1, 2, 3], 4)
+
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error", error=TransientDeviceError, nth=(1,))
+    with plan.active():
+        h = sched.submit([1, 2, 3], SamplingParams(max_new_tokens=4))
+        for _ in range(10):
+            if h.done():
+                break
+            sched.step()
+    assert h.result(0) == ref  # retry made the fault invisible
+    assert plan.fired("generation.decode_step") == 1
+
+    plan = FaultPlan(seed=0)
+    plan.on("generation.prefill", mode="error", error=FaultInjected, nth=(0, 1))
+    with plan.active():
+        h1 = sched.submit([4, 5], SamplingParams(max_new_tokens=2))
+        h2 = sched.submit([6, 7], SamplingParams(max_new_tokens=2))
+        for _ in range(5):
+            sched.step()
+    with pytest.raises(FaultInjected):
+        h1.result(0)
+    with pytest.raises(FaultInjected):
+        h2.result(0)
+    assert breaker.state == CircuitBreaker.OPEN  # 2 consecutive failures
+    with pytest.raises(CircuitOpenError):
+        sched.submit([1], SamplingParams())
+    assert eng.allocator.num_free == eng.allocator.num_total
+
+
+# ---------------------------------------------------------------------------
+# serving surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gen_server(decoder_params):
+    from flexflow_tpu.serving import InferenceServer
+    from flexflow_tpu.serving.generation import GenerationModel
+
+    eng = GenerationEngine(
+        decoder_params, CFG, max_batch_slots=2, block_size=BLOCK, prompt_buckets=BUCKETS
+    )
+    srv = InferenceServer(port=0)
+    srv.register_generation(GenerationModel(eng, name="lm"))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers={"Content-Type": "application/json"}
+    )
+    return urllib.request.urlopen(req, timeout=60)
+
+
+def test_http_generate_json(gen_server, decoder_params):
+    base = f"http://127.0.0.1:{gen_server.port}"
+    resp = json.load(_post(f"{base}/v2/models/lm/generate", {"prompt": [1, 2, 3], "max_new_tokens": 5}))
+    assert resp["tokens"] == naive_greedy(decoder_params, [1, 2, 3], 5)
+    assert resp["num_generated"] == 5
+
+
+def test_http_generate_sse_stream(gen_server, decoder_params):
+    base = f"http://127.0.0.1:{gen_server.port}"
+    r = _post(f"{base}/v2/models/lm/generate", {"prompt": [4, 5], "max_new_tokens": 4, "stream": True})
+    assert r.headers["Content-Type"] == "text/event-stream"
+    events = [json.loads(l[6:]) for l in r.read().decode().strip().split("\n\n")]
+    ref = naive_greedy(decoder_params, [4, 5], 4)
+    assert [e["token"] for e in events[:-1]] == ref
+    assert events[-1] == {"done": True, "tokens": ref}
+
+
+def test_http_stats_endpoint(gen_server):
+    base = f"http://127.0.0.1:{gen_server.port}"
+    stats = json.load(urllib.request.urlopen(f"{base}/v2/stats", timeout=30))
+    lm = stats["generation"]["lm"]
+    assert lm["completed"] >= 2
+    assert lm["tokens_generated"] >= 9
+    assert "tokens_per_s" in lm and "cache_occupancy" in lm
+    assert lm["latency"]["count"] >= 2
+    assert lm["recompiles"] == 0
+
+
+def test_http_generate_bad_request(gen_server):
+    base = f"http://127.0.0.1:{gen_server.port}"
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(f"{base}/v2/models/lm/generate", {"prompt": []})
+    assert exc.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(f"{base}/v2/models/nope/generate", {"prompt": [1]})
+    assert exc.value.code == 404
+
+
+def test_http_generation_model_ready(gen_server):
+    base = f"http://127.0.0.1:{gen_server.port}"
+    assert urllib.request.urlopen(f"{base}/v2/models/lm/ready", timeout=30).status == 200
+    meta = json.load(urllib.request.urlopen(f"{base}/v2/models/lm", timeout=30))
+    assert meta["platform"] == "flexflow_tpu_generation"
+    assert meta["prompt_buckets"] == list(BUCKETS)
+
+
+def test_batcher_stats_counters():
+    """The satellite: batcher exports queue/admission/latency stats."""
+    from flexflow_tpu import CompMode, FFConfig, FFModel
+    from flexflow_tpu.serving import DynamicBatcher, InferenceModel
+
+    cfg = FFConfig(batch_size=4)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([4, 8], name="x")
+    out = ff.dense(x, 2)
+    ff.compile(comp_mode=CompMode.INFERENCE, outputs=[out])
+    model = InferenceModel(ff, name="m", max_batch=4)
+    b = DynamicBatcher(model, max_delay_s=0.001, max_queue=4)
+    b.start()
+    try:
+        b.infer([np.zeros((2, 8), np.float32)], timeout=30)
+        with pytest.raises(DeadlineExceededError):
+            b.submit([np.zeros((1, 8), np.float32)], deadline_s=0)
+        snap = b.stats.snapshot()
+        assert snap["admitted"] == 1 and snap["completed"] == 1
+        assert snap["expired"] == 1
+        assert snap["latency"]["count"] == 1 and snap["latency"]["mean_s"] > 0
+        assert snap["queue_depth"] == 0
+    finally:
+        b.stop()

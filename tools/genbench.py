@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Generation micro-benchmark + recompile guard (CPU-runnable).
+
+Drives a mixed-length request stream through the continuous-batching
+scheduler and reports:
+
+  * prefill throughput (prompt tokens/s through the bucketed prefill)
+  * decode throughput (generated tokens/s at steady state)
+  * jit trace counts per program (prefill per bucket + the one decode)
+
+and FAILS (exit 1) if steady-state decode retraced — the engine's core
+contract is at most ONE compile per prompt bucket and exactly one
+decode program, whatever joins or leaves the batch.
+
+Usage:
+  python tools/genbench.py [--out genbench.json] [--requests 12]
+      [--max-new 16] [--layers 2] [--hidden 64] [--heads 4] [--vocab 128]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu.generation import (  # noqa: E402
+    ContinuousBatchingScheduler,
+    GenerationEngine,
+    SamplingParams,
+    init_decoder_params,
+)
+from flexflow_tpu.models.transformer import TransformerConfig  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="")
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--seq-len", type=int, default=128)
+    args = ap.parse_args()
+
+    cfg = TransformerConfig(
+        num_layers=args.layers, hidden_size=args.hidden, num_heads=args.heads,
+        ff_size=args.hidden * 4, seq_length=args.seq_len, vocab_size=args.vocab,
+        causal=True,
+    )
+    params = init_decoder_params(jax.random.key(0), cfg)
+    engine = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16)
+    sched = ContinuousBatchingScheduler(engine)
+
+    rs = np.random.RandomState(0)
+    lengths = [int(rs.randint(4, args.seq_len - args.max_new)) for _ in range(args.requests)]
+    prompts = [rs.randint(0, args.vocab, n).tolist() for n in lengths]
+    sampling = SamplingParams(max_new_tokens=args.max_new)
+
+    # warm every bucket + the decode program so the measured stream is
+    # steady state (compiles counted separately by the trace counters).
+    # max_new_tokens=2: the first token samples at prefill; the decode
+    # program only runs (and compiles) from the second token on
+    t0 = time.perf_counter()
+    engine.generate([prompts[0]], SamplingParams(max_new_tokens=2))
+    for b in sorted({engine.bucket_for(n) for n in lengths}):
+        engine.generate([[1] * min(b, args.seq_len - 2)], SamplingParams(max_new_tokens=1))
+    warm_s = time.perf_counter() - t0
+    traces_after_warmup = dict(engine.trace_counts)
+
+    t0 = time.perf_counter()
+    handles = [sched.submit(p, sampling) for p in prompts]
+    steps = 0
+    while any(not h.done() for h in handles):
+        if not sched.step():
+            break
+        steps += 1
+    elapsed = time.perf_counter() - t0
+    outs = [h.result(timeout=0) for h in handles]
+
+    prompt_tokens = sum(lengths)
+    gen_tokens = sum(len(o) for o in outs)
+    # retraces during the measured steady-state stream
+    steady_retraces = {
+        k: engine.trace_counts[k] - traces_after_warmup.get(k, 0)
+        for k in engine.trace_counts
+        if engine.trace_counts[k] - traces_after_warmup.get(k, 0) > 0
+    }
+    report = {
+        "requests": args.requests,
+        "prompt_tokens": prompt_tokens,
+        "generated_tokens": gen_tokens,
+        "scheduler_steps": steps,
+        "warmup_s": round(warm_s, 4),
+        "stream_s": round(elapsed, 4),
+        "prefill_tokens_per_s": round(prompt_tokens / elapsed, 2),
+        "decode_tokens_per_s": round(gen_tokens / elapsed, 2),
+        "preemptions": sched.preemptions,
+        "trace_counts": engine.trace_counts,
+        "steady_state_retraces": steady_retraces,
+        "recompiles": engine.recompiles(),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(report, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+
+    ok = True
+    if steady_retraces:
+        print(f"FAIL: steady-state stream retraced: {steady_retraces}", file=sys.stderr)
+        ok = False
+    # >1 recompile per bucket overall (i.e. >2 traces of any program)
+    over = {k: v for k, v in engine.trace_counts.items() if v > 2}
+    if over:
+        print(f"FAIL: programs compiled more than twice: {over}", file=sys.stderr)
+        ok = False
+    if engine.trace_counts.get("decode", 0) != 1:
+        print(
+            f"FAIL: decode traced {engine.trace_counts.get('decode', 0)} times; must be exactly 1",
+            file=sys.stderr,
+        )
+        ok = False
+    if not ok:
+        return 1
+    print("OK: zero steady-state recompiles; decode compiled exactly once")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
