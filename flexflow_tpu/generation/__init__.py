@@ -20,6 +20,13 @@ is the TPU-native serving answer for decoder transformers:
   admission is cache-capacity aware, and cache exhaustion preempts by
   recompute.
 
+* :mod:`speculative` — speculative decoding (SpecInfer / Leviathan et
+  al.): model-free n-gram and small-draft-model drafters, ONE
+  fixed-shape batched verification step over the block cache
+  (chunked-append attention), exact greedy acceptance and
+  distribution-preserving rejection sampling, with per-request
+  adaptive k driven by the scheduler.
+
 Serving integration lives in :mod:`flexflow_tpu.serving.generation`
 (`GenerationModel`), wired through the same deadline / backpressure /
 circuit-breaker paths as `InferenceModel`, with per-token streaming over
@@ -33,17 +40,27 @@ from .scheduler import (
     GenerationHandle,
     Request,
 )
+from .speculative import (
+    Drafter,
+    DraftModelDrafter,
+    NgramDrafter,
+    SpeculationConfig,
+)
 
 __all__ = [
     "BlockAllocator",
     "CacheConfig",
     "ContinuousBatchingScheduler",
     "DecoderParams",
+    "Drafter",
+    "DraftModelDrafter",
     "GenerationEngine",
     "GenerationHandle",
     "KVCache",
+    "NgramDrafter",
     "Request",
     "SamplingParams",
+    "SpeculationConfig",
     "forward_full",
     "init_decoder_params",
 ]

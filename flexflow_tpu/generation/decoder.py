@@ -22,9 +22,16 @@ Three forwards over one params pytree:
 * :func:`decode_step` — one token per sequence against the cache
   (writes the token's K/V, then decode-mode attention), [B] -> logits
   [B, V].
+* :func:`verify_step` — a W-token append window per sequence against
+  the cache (writes all W tokens' K/V, then chunked-append attention
+  with causal-within-window masking), [B, W] -> logits [B, W, V]. The
+  speculative-decoding verification forward: W sequential decode_steps
+  in ONE call, with identical logits.
 
 ``forward_full(tokens)[b, i] == decode logits after caching tokens[:i]``
-within fp32 tolerance — asserted by tests/test_generation.py.
+within fp32 tolerance — asserted by tests/test_generation.py;
+``verify_step`` agrees with ``decode_step`` token-for-token — asserted
+by tests/test_speculative.py.
 """
 from __future__ import annotations
 
@@ -34,7 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.transformer import TransformerConfig
-from ..ops.attention import decode_attention_core, masked_attention
+from ..ops.attention import append_attention_core, decode_attention_core, masked_attention
 from .cache import slot_mapping
 
 # a decoder is a plain pytree: jit-friendly, checkpoint-friendly
@@ -190,6 +197,61 @@ def decode_step(
             q, cache_k[li], cache_v[li], block_tables, context_lens, backend=backend
         )
         x = x + jnp.einsum("bhd,hde->be", ctx, layer["wo"])
+        x = _ffn(layer, x)
+    x = _ln(x, params["final_ln_g"], params["final_ln_b"])
+    return x @ params["lm_head"], cache_k, cache_v
+
+
+def verify_step(
+    params: DecoderParams,
+    tokens: jax.Array,
+    positions: jax.Array,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    block_tables: jax.Array,
+    backend: str = "cpu",
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One chunked-append (speculative verification) step for every
+    batch slot.
+
+    tokens/positions: [B, W] int32 — the window being scored (the last
+    committed token followed by up to W-1 drafted tokens) and each
+    window token's cache position. ``positions < 0`` marks padding
+    window slots (fixed-shape windows with fewer real drafts): their
+    K/V scatter to scratch block 0 and their attention/logits rows are
+    meaningless (the caller's acceptance logic never reads them).
+    cache_k/cache_v: [L, num_blocks, block_size, H, D]; block_tables:
+    [B, max_blocks]. Returns (logits [B, W, V], cache_k, cache_v) with
+    all W tokens' K/V written — accepted positions hold exactly the K/V
+    sequential decode would have written (a window token's K/V depends
+    only on its prefix, which is valid up to the first rejection);
+    rejected/later positions hold garbage that the next window
+    overwrites before any masked read can see it.
+    """
+    nb, bs = cache_k.shape[1], cache_k.shape[2]
+    safe_pos = jnp.maximum(positions, 0)
+    x = _embed(params, tokens, safe_pos)  # [B, W, E]
+    slots = jax.vmap(lambda bt, p: slot_mapping(bt, p, bs))(block_tables, safe_pos)
+    slots = jnp.where(positions >= 0, slots, 0)  # padding -> scratch
+    flat_slots = slots.reshape(-1)
+    for li, layer in enumerate(params["layers"]):
+        h = _ln(x, layer["ln1_g"], layer["ln1_b"])
+        q = jnp.einsum("bwe,ehd->bwhd", h, layer["wq"])
+        k = jnp.einsum("bwe,ehd->bwhd", h, layer["wk"])
+        v = jnp.einsum("bwe,ehd->bwhd", h, layer["wv"])
+        # write the whole window's K/V, then attend over the updated
+        # cache with per-query position masks (each token sees itself
+        # and everything before it, nothing after)
+        flat_k = cache_k[li].reshape(nb * bs, *cache_k.shape[3:])
+        flat_v = cache_v[li].reshape(nb * bs, *cache_v.shape[3:])
+        flat_k = flat_k.at[flat_slots].set(k.reshape(-1, *k.shape[2:]).astype(flat_k.dtype))
+        flat_v = flat_v.at[flat_slots].set(v.reshape(-1, *v.shape[2:]).astype(flat_v.dtype))
+        cache_k = cache_k.at[li].set(flat_k.reshape(cache_k.shape[1:]))
+        cache_v = cache_v.at[li].set(flat_v.reshape(cache_v.shape[1:]))
+        ctx = append_attention_core(
+            q, cache_k[li], cache_v[li], block_tables, positions, backend=backend
+        )
+        x = x + jnp.einsum("bwhd,hde->bwe", ctx, layer["wo"])
         x = _ffn(layer, x)
     x = _ln(x, params["final_ln_g"], params["final_ln_b"])
     return x @ params["lm_head"], cache_k, cache_v

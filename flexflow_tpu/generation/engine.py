@@ -33,7 +33,7 @@ import numpy as np
 from ..models.transformer import TransformerConfig
 from ..runtime import faults
 from .cache import BlockAllocator, CacheConfig, KVCache, slot_mapping
-from .decoder import DecoderParams, decode_step, prefill
+from .decoder import DecoderParams, decode_step, prefill, verify_step
 
 NEG_INF = -1e30
 
@@ -66,17 +66,31 @@ def default_buckets(max_seq_len: int, start: int = 16) -> Tuple[int, ...]:
     return tuple(buckets)
 
 
+def topk_scaled_logits(logits, temps, top_ks):
+    """Temperature-scaled, top-k-masked logits — THE sampling transform
+    for both the decode step and speculative verification (speculative/
+    sampling.py imports this one; two copies drifting apart would break
+    the zero-draft-verify ≡ decode bit-exactness contract).
+
+    logits [..., V]; temps/top_ks shaped logits.shape[:-1] (callers
+    broadcast). temp <= 0 rows are scaled by 1 (greedy callers argmax
+    the RAW logits); top_k <= 0 disables the top-k filter.
+    """
+    v = logits.shape[-1]
+    safe_t = jnp.where(temps <= 0.0, 1.0, temps)
+    scaled = logits / safe_t[..., None]
+    k = jnp.where(top_ks <= 0, v, jnp.clip(top_ks, 1, v)).astype(jnp.int32)
+    sorted_desc = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
+    thresh = jnp.take_along_axis(sorted_desc, k[..., None] - 1, axis=-1)
+    return jnp.where(scaled >= thresh, scaled, NEG_INF)
+
+
 def _sample(logits, temps, top_ks, keys):
     """Vectorized sampling: greedy where temp<=0, else temperature +
     optional top-k. logits [B, V]; temps/top_ks [B]; keys [B] PRNG."""
     v = logits.shape[-1]
     greedy = temps <= 0.0
-    safe_t = jnp.where(greedy, 1.0, temps)
-    scaled = logits / safe_t[:, None]
-    k = jnp.where(top_ks <= 0, v, jnp.clip(top_ks, 1, v)).astype(jnp.int32)
-    sorted_desc = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
-    thresh = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
-    masked = jnp.where(scaled >= thresh, scaled, NEG_INF)
+    masked = topk_scaled_logits(logits, temps, top_ks)
     gumbel = jax.vmap(lambda key: jax.random.gumbel(key, (v,)))(keys)
     sampled = jnp.argmax(masked + gumbel, axis=-1)
     return jnp.where(greedy, jnp.argmax(logits, axis=-1), sampled).astype(jnp.int32)
@@ -98,6 +112,7 @@ class GenerationEngine:
         prompt_buckets: Optional[Sequence[int]] = None,
         max_seq_len: Optional[int] = None,
         block_size: int = 16,
+        max_spec_tokens: int = 4,
     ):
         self.params = params
         self.cfg = cfg
@@ -136,12 +151,23 @@ class GenerationEngine:
             # which can reach max_seq_len - 1: there must be a bucket
             # that holds it
             self.buckets = self.buckets + (self.max_seq_len,)
+        if max_spec_tokens < 1:
+            raise ValueError("max_spec_tokens must be >= 1")
+        # speculative verification window: 1 committed token + up to
+        # max_spec_tokens drafts, ONE fixed jit shape whatever per-
+        # request adaptive k does
+        self.max_spec_tokens = max_spec_tokens
+        self.spec_window = max_spec_tokens + 1
         self.backend = jax.default_backend()
         # retrace counters: the Python body runs only when XLA traces, so
         # these count compiles, not calls (genbench's recompile guard)
         self.trace_counts: Dict[str, int] = {}
+        # host-call counters: engine steps actually issued (genbench's
+        # tokens-per-engine-step accounting)
+        self.step_counts: Dict[str, int] = {"prefill": 0, "decode": 0, "verify": 0}
         self._prefill_jit = jax.jit(self._prefill_impl)
         self._decode_jit = jax.jit(self._decode_impl)
+        self._verify_jit = jax.jit(self._verify_impl)
 
     # ------------------------------------------------------------ geometry
     def bucket_for(self, prompt_len: int) -> int:
@@ -182,6 +208,30 @@ class GenerationEngine:
         )
         return _sample(logits, temps, top_ks, keys), cache_k, cache_v
 
+    def _verify_impl(
+        self, params, tokens, start, n_draft, cache_k, cache_v, block_tables, temps, top_ks, keys
+    ):
+        """Speculative verification: score a [B, W] window (committed
+        token + drafts) in one forward and accept/emit in-jit.
+        ``n_draft[b]`` counts the slot's real drafts (0..W-1); -1 marks
+        an inactive slot (everything masked to scratch, 0 emitted)."""
+        from .speculative.sampling import speculative_accept
+
+        self.trace_counts["verify"] = self.trace_counts.get("verify", 0) + 1
+        w = tokens.shape[1]
+        offs = jnp.arange(w, dtype=jnp.int32)[None, :]
+        # window token j sits at cache position start + j; slots past the
+        # drafts (and whole inactive rows) are padding -> position -1
+        positions = jnp.where(offs <= n_draft[:, None], start[:, None] + offs, -1)
+        logits, cache_k, cache_v = verify_step(
+            params, tokens, positions, cache_k, cache_v, block_tables,
+            backend=self.backend,
+        )
+        out, n_emitted = speculative_accept(
+            logits, tokens[:, 1:], jnp.maximum(n_draft, 0), temps, top_ks, keys
+        )
+        return out, jnp.where(n_draft >= 0, n_emitted, 0), cache_k, cache_v
+
     # ----------------------------------------------------------- host API
     def prefill_one(
         self,
@@ -194,6 +244,7 @@ class GenerationEngine:
         first generated token. ``block_table`` is the sequence's block
         ids (padded internally to the engine's fixed table width)."""
         faults.inject("generation.prefill", prompt)
+        self.step_counts["prefill"] += 1
         n = len(prompt)
         bucket = self.bucket_for(n)
         tokens = np.zeros((1, bucket), np.int32)
@@ -228,6 +279,7 @@ class GenerationEngine:
         are slot-indexed; inactive slots (active[i] False) write to
         scratch and return garbage tokens the scheduler ignores."""
         faults.inject("generation.decode_step", tokens)
+        self.step_counts["decode"] += 1
         context_lens = np.where(active, positions + 1, 0).astype(np.int32)
         safe_pos = np.where(active, positions, 0).astype(np.int32)
         out, ck, cv = self._decode_jit(
@@ -245,20 +297,61 @@ class GenerationEngine:
         self.cache.update(ck, cv)
         return np.asarray(out)
 
+    def verify(
+        self,
+        window_tokens: np.ndarray,
+        start: np.ndarray,
+        n_draft: np.ndarray,
+        block_tables: np.ndarray,
+        temps: np.ndarray,
+        top_ks: np.ndarray,
+        keys: jax.Array,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One speculative verification step across all slots.
+
+        ``window_tokens`` [B, spec_window]: per slot, the last committed
+        token followed by its drafts (then padding); ``start`` [B]: the
+        committed token's cache position (the slot's ``cached_len``);
+        ``n_draft`` [B]: real drafts per slot, -1 for inactive slots;
+        ``keys`` [B, spec_window]: per-emitted-count sampling keys.
+        Returns (out_tokens [B, spec_window], n_emitted [B]) — the
+        scheduler keeps ``out_tokens[i, :n_emitted[i]]`` (further
+        truncated by EOS / budget). ONE fixed-shape jit: per-request
+        adaptive k only changes ``n_draft`` values, never the shape.
+        """
+        faults.inject("generation.verify", window_tokens)
+        self.step_counts["verify"] += 1
+        out, n_emitted, ck, cv = self._verify_jit(
+            self.params,
+            jnp.asarray(window_tokens.astype(np.int32)),
+            jnp.asarray(start.astype(np.int32)),
+            jnp.asarray(n_draft.astype(np.int32)),
+            self.cache.k,
+            self.cache.v,
+            jnp.asarray(block_tables.astype(np.int32)),
+            jnp.asarray(temps.astype(np.float32)),
+            jnp.asarray(top_ks.astype(np.int32)),
+            keys,
+        )
+        self.cache.update(ck, cv)
+        return np.asarray(out), np.asarray(n_emitted)
+
     def generate(
         self,
         prompts: Sequence[Sequence[int]],
         sampling: Optional[SamplingParams] = None,
+        speculation=None,
         **scheduler_kwargs,
     ) -> List[List[int]]:
         """Convenience: run ``prompts`` through a private continuous-
         batching scheduler to completion; returns generated tokens per
-        prompt (prompt excluded)."""
+        prompt (prompt excluded). ``speculation``: a SpeculationConfig
+        to decode speculatively (exact — greedy output is identical)."""
         from .scheduler import ContinuousBatchingScheduler
 
         sampling = sampling or SamplingParams()
         sched = ContinuousBatchingScheduler(self, **scheduler_kwargs)
-        handles = [sched.submit(list(p), sampling) for p in prompts]
+        handles = [sched.submit(list(p), sampling, speculation=speculation) for p in prompts]
         while any(not h.done() for h in handles):
             if not sched.step():
                 break

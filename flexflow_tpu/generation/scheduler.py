@@ -20,12 +20,26 @@ freely —
   indexed by generated-token count, so a preempted request's token
   stream continues exactly where it left off.
 
+* **speculation-aware stepping**: a request submitted with a
+  SpeculationConfig drafts up to k tokens per iteration (n-gram or
+  draft-model drafter) and the step verifies every slot's window in ONE
+  fixed-shape engine.verify call — up to k+1 tokens emitted per
+  sequence per step, exactly (greedy output is token-for-token the
+  non-speculative stream). The scheduler allocates blocks for the whole
+  window up front, caps a window's k when the allocator is tight
+  (before ever preempting), trims unused trailing blocks after partial
+  acceptance, truncates emission at mid-window EOS / budget, and adapts
+  each request's k against its acceptance EMA. Speculative and plain
+  requests mix freely in one batch (a plain request is a zero-draft
+  window whose sampling is bit-identical to the decode step).
+
 Resilience mirrors PR 1's serving semantics: bounded queue
 (QueueFullError), per-request deadlines (DeadlineExceededError before
 OR during generation), retry-with-backoff for TransientDeviceError,
 and a circuit breaker around device steps — all on an injectable clock
-so chaos tests run on virtual time. Fault sites: ``generation.prefill``
-and ``generation.decode_step`` (runtime/faults.py).
+so chaos tests run on virtual time. Fault sites: ``generation.prefill``,
+``generation.decode_step``, and ``generation.verify``
+(runtime/faults.py).
 
 The scheduler is synchronous-by-design: ``step()`` does one iteration
 and returns, so property tests drive it deterministically; ``start()``
@@ -33,6 +47,7 @@ wraps it in a background thread for serving.
 """
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import queue
 import threading
@@ -42,6 +57,7 @@ from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..runtime import faults
@@ -53,8 +69,9 @@ from ..serving.resilience import (
     RetryPolicy,
     ShuttingDownError,
 )
-from ..serving.stats import ServingStats, TokenRate
+from ..serving.stats import ServingStats, SpeculationStats, TokenRate
 from .engine import GenerationEngine, SamplingParams
+from .speculative.drafter import SpeculationConfig, build_drafter
 
 _END = object()  # token-stream sentinel
 
@@ -119,6 +136,8 @@ class Request:
         prompt: List[int],
         sampling: SamplingParams,
         deadline: Optional[float] = None,
+        speculation: Optional[SpeculationConfig] = None,
+        drafter=None,
     ):
         self.id = next(Request._ids)
         self.original_prompt = list(prompt)
@@ -134,8 +153,19 @@ class Request:
         self.preemptions = 0
         self.handle = GenerationHandle(self)
         # seed-only (no request-id mixing): the same seed + prompt +
-        # params must reproduce the same tokens, run to run
+        # params must reproduce the same tokens, run to run (with
+        # temperature speculation: under the same window layout — see
+        # speculative/sampling.py on realization-invariance)
         self.base_key = jax.random.key(sampling.seed)
+        # speculation state: live k adapts inside [1, config.k]; the
+        # drafter is a pure function of the prefix, so preemption needs
+        # no drafter checkpointing
+        self.speculation = speculation if (speculation and speculation.enabled) else None
+        self.drafter = drafter if self.speculation else None
+        self.spec_k = speculation.k if self.speculation else 0
+        self.acc_ema: Optional[float] = None
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
     @property
     def n_generated(self) -> int:
@@ -145,6 +175,35 @@ class Request:
         """Key for the NEXT token: indexed by generated count, so a
         recomputed request continues its exact sampling stream."""
         return jax.random.fold_in(self.base_key, self.n_generated)
+
+    def sample_keys(self, window: int) -> jax.Array:
+        """Keys for the next ``window`` token counts (a speculative
+        window's per-emitted-token streams): key j belongs to the token
+        emitted at count ``n_generated + j``, the same per-count
+        indexing as :meth:`sample_key`. One vmapped fold_in, not
+        ``window`` host dispatches."""
+        counts = self.n_generated + jnp.arange(window, dtype=jnp.int32)
+        return jax.vmap(lambda n: jax.random.fold_in(self.base_key, n))(counts)
+
+    def update_speculation(self, proposed: int, accepted: int) -> None:
+        """Fold one verification window into the adaptive-k state."""
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        cfg = self.speculation
+        if cfg is None or proposed <= 0:
+            return
+        rate = accepted / proposed
+        self.acc_ema = (
+            rate
+            if self.acc_ema is None
+            else cfg.ema_alpha * rate + (1.0 - cfg.ema_alpha) * self.acc_ema
+        )
+        if not cfg.adaptive:
+            return
+        if self.acc_ema < cfg.low_acceptance:
+            self.spec_k = max(1, self.spec_k - 1)
+        elif self.acc_ema >= cfg.high_acceptance:
+            self.spec_k = min(cfg.k, self.spec_k + 1)
 
     def finished(self) -> bool:
         if self.n_generated >= self.max_new:
@@ -156,7 +215,7 @@ class Request:
 class _Running:
     """Slot-resident state for an admitted request."""
 
-    __slots__ = ("req", "slot", "blocks", "cached_len", "admitted_seq")
+    __slots__ = ("req", "slot", "blocks", "cached_len", "admitted_seq", "step_k")
 
     def __init__(self, req: Request, slot: int, blocks: List[int], cached_len: int, admitted_seq: int):
         self.req = req
@@ -164,6 +223,7 @@ class _Running:
         self.blocks = blocks
         self.cached_len = cached_len  # cache positions written so far
         self.admitted_seq = admitted_seq  # admission order, for LIFO preemption
+        self.step_k = 0  # drafts planned for THIS step (<= req.spec_k)
 
 
 class ContinuousBatchingScheduler:
@@ -176,8 +236,14 @@ class ContinuousBatchingScheduler:
         breaker: Optional[CircuitBreaker] = None,
         retry: Optional[RetryPolicy] = None,
         idle_wait_s: float = 0.002,
+        speculation: Optional[SpeculationConfig] = None,
+        draft_params=None,
     ):
         self.engine = engine
+        # scheduler-wide default speculation policy (a request's own
+        # config overrides it); draft_params backs 'draft_model' drafters
+        self.speculation_default = speculation
+        self.draft_params = draft_params
         self.max_queue = max_queue
         self.clock = clock
         self.breaker = breaker or CircuitBreaker(clock=clock)
@@ -213,6 +279,9 @@ class ContinuousBatchingScheduler:
             lambda: 1.0 - self.engine.allocator.num_free / max(1, self.engine.allocator.num_total),
         )
         self.stats.add_gauge("recompiles", lambda: sum(self.engine.recompiles().values()))
+        self.spec_stats = SpeculationStats()
+        self.spec_stats.register_gauges(self.stats)
+        self._dummy_keys = None  # inactive-slot key rows, built once
 
     # ------------------------------------------------------------- submit
     def submit(
@@ -220,11 +289,14 @@ class ContinuousBatchingScheduler:
         prompt: Sequence[int],
         sampling: Optional[SamplingParams] = None,
         deadline_s: Optional[float] = None,
+        speculation: Optional[SpeculationConfig] = None,
     ) -> GenerationHandle:
         """Enqueue one request (FCFS). Typed rejections mirror the
         batcher: QueueFullError on backpressure, CircuitOpenError while
         the breaker holds traffic, ShuttingDownError while draining,
-        DeadlineExceededError for an already-expired budget."""
+        DeadlineExceededError for an already-expired budget.
+        ``speculation`` turns on (exact) speculative decoding for this
+        request; None falls back to the scheduler-wide default."""
         if self._draining:
             raise ShuttingDownError("generation scheduler draining")
         if self._stopped:
@@ -255,7 +327,21 @@ class ContinuousBatchingScheduler:
                 self.stats.incr("rejected")
                 raise CircuitOpenError("generation circuit open")
             deadline = None if deadline_s is None else self.clock() + deadline_s
-            req = Request(list(prompt), sampling, deadline=deadline)
+            spec = speculation if speculation is not None else self.speculation_default
+            drafter = None
+            if spec is not None and spec.enabled:
+                # clamp to the engine's compiled verify window so per-
+                # request k NEVER changes the jit shape
+                if spec.k > self.engine.max_spec_tokens:
+                    spec = dataclasses.replace(spec, k=self.engine.max_spec_tokens)
+                drafter = build_drafter(
+                    spec, draft_params=self.draft_params,
+                    max_seq_len=self.engine.max_seq_len,
+                )
+            req = Request(
+                list(prompt), sampling, deadline=deadline,
+                speculation=spec, drafter=drafter,
+            )
             req.submitted_at = self.clock()
             # the sequence can never outgrow max_seq_len (its last token
             # would need a cache position past the block table) NOR the
@@ -445,17 +531,41 @@ class ContinuousBatchingScheduler:
         state.req.generated.append(int(token))
         state.req.handle._emit(int(token))
 
+    def _plan_speculation(self) -> None:
+        """Decide each running sequence's draft count for THIS step:
+        its adaptive k, capped by the remaining token budget (never
+        draft past max_new), the sequence-length ceiling, and — in
+        _grow — cache pressure."""
+        for state in self._running.values():
+            req = state.req
+            if req.drafter is None:
+                state.step_k = 0
+                continue
+            budget = req.max_new - req.n_generated  # >= 1 while running
+            pos_room = (self.engine.max_seq_len - 1) - state.cached_len
+            state.step_k = max(0, min(req.spec_k, budget - 1, pos_room))
+
     def _grow(self) -> None:
-        """Ensure every running sequence has a cache slot for its next
-        token; preempt-by-recompute on exhaustion."""
+        """Ensure every running sequence has cache blocks for its next
+        window — up to step_k + 1 new positions. Under pressure, first
+        shrink the window (cap speculation), then preempt-by-recompute."""
         for state in list(self._running.values()):
             if self._running.get(state.slot) is not state:
                 continue  # preempted earlier in this sweep
-            need = self.engine.cache_config.blocks_for(state.cached_len + 1)
-            while len(state.blocks) < need:
+            while True:
+                need = self.engine.cache_config.blocks_for(
+                    state.cached_len + state.step_k + 1
+                )
+                if len(state.blocks) >= need:
+                    break
                 got = self.engine.allocator.allocate(1)
                 if got is not None:
                     state.blocks.extend(got)
+                    continue
+                if state.step_k > 0:
+                    # cap on cache pressure: give up drafts before
+                    # evicting anyone
+                    state.step_k -= 1
                     continue
                 if not self._preempt_youngest(exclude=state):
                     # nothing left to evict but this sequence itself:
@@ -472,30 +582,39 @@ class ContinuousBatchingScheduler:
         with self._lock:
             self._queue.appendleft(req)
 
-    def _decode_once(self) -> bool:
-        if not self._running:
-            return False
+    def _collect_slots(self, order):
+        """Slot-indexed arrays every batched device step needs: the
+        seed token (last emitted, not yet cached), its cache position,
+        block tables, the live mask, and per-slot sampling params —
+        shared by the decode and verify assemblies so the two paths
+        cannot drift."""
         b = self.engine.max_batch_slots
-        tokens = np.zeros((b,), np.int32)
-        positions = np.zeros((b,), np.int32)
+        last = np.zeros((b,), np.int32)
+        start = np.zeros((b,), np.int32)
         tables = np.zeros((b, self.engine.max_blocks_per_seq), np.int32)
         active = np.zeros((b,), bool)
         temps = np.zeros((b,), np.float32)
         top_ks = np.zeros((b,), np.int32)
-        keys = []
-        order = sorted(self._running.values(), key=lambda s: s.slot)
         for state in order:
             i = state.slot
             req = state.req
-            tokens[i] = req.generated[-1] if req.generated else req.prompt[-1]
-            positions[i] = state.cached_len  # next cache position
+            last[i] = req.generated[-1] if req.generated else req.prompt[-1]
+            start[i] = state.cached_len  # next cache position
             tables[i, : len(state.blocks)] = state.blocks
             active[i] = True
             temps[i] = req.sampling.temperature
             top_ks[i] = req.sampling.top_k
+        return last, start, tables, active, temps, top_ks
+
+    def _decode_once(self) -> bool:
+        if not self._running:
+            return False
+        b = self.engine.max_batch_slots
+        order = sorted(self._running.values(), key=lambda s: s.slot)
+        tokens, positions, tables, active, temps, top_ks = self._collect_slots(order)
         key_by_slot = {s.slot: s.req.sample_key() for s in order}
         dummy = jax.random.key(0)
-        keys = jax.numpy.stack([key_by_slot.get(i, dummy) for i in range(b)])
+        keys = jnp.stack([key_by_slot.get(i, dummy) for i in range(b)])
         try:
             out = self._device(
                 lambda: self.engine.decode(
@@ -523,16 +642,114 @@ class ContinuousBatchingScheduler:
         self.token_rate.record(n_live)
         return True
 
+    def _trim_blocks(self, state: _Running) -> None:
+        """Return trailing blocks a partially-accepted window no longer
+        covers (their positions hold rejected-draft garbage the next
+        window would rewrite anyway). Keeps allocator accounting exact
+        when acceptance stops short of a block boundary. cached_len + 1,
+        not cached_len: the next step always writes position cached_len,
+        so trimming its block would hand it to a queued request at
+        _admit and force an avoidable preemption one step later."""
+        keep = max(1, self.engine.cache_config.blocks_for(state.cached_len + 1))
+        if len(state.blocks) > keep:
+            extra = state.blocks[keep:]
+            del state.blocks[keep:]
+            self.engine.allocator.free(extra)
+
+    def _verify_once(self) -> bool:
+        """One speculative verification step across all running slots:
+        draft (host), verify the batch × (k+1) window (ONE fixed-shape
+        device call), then emit each slot's accepted run — truncated at
+        mid-window EOS and the request's budget."""
+        if not self._running:
+            return False
+        b = self.engine.max_batch_slots
+        w = self.engine.spec_window
+        order = sorted(self._running.values(), key=lambda s: s.slot)
+        last, start, tables, _active, temps, top_ks = self._collect_slots(order)
+        window = np.zeros((b, w), np.int32)
+        window[:, 0] = last
+        n_draft = np.full((b,), -1, np.int32)  # -1 = inactive slot
+        for state in order:
+            i = state.slot
+            req = state.req
+            draft: List[int] = []
+            if state.step_k > 0 and req.drafter is not None:
+                try:
+                    # original_prompt, NOT prompt: after a preemption the
+                    # recompute prompt already folds in generated tokens
+                    draft = list(
+                        req.drafter.propose(
+                            req.original_prompt + req.generated, state.step_k
+                        )
+                    )[: state.step_k]
+                except Exception:
+                    # a dying drafter must not kill the scheduler loop:
+                    # verification is exact with ANY draft, so a failed
+                    # proposal degrades to a plain (zero-draft) step
+                    self.stats.incr("drafter_errors")
+            window[i, 1 : 1 + len(draft)] = draft
+            n_draft[i] = len(draft)
+        keys_by_slot = {s.slot: s.req.sample_keys(w) for s in order}
+        if self._dummy_keys is None:
+            self._dummy_keys = jnp.stack([jax.random.key(0)] * w)
+        keys = jnp.stack([keys_by_slot.get(i, self._dummy_keys) for i in range(b)])
+        try:
+            out, n_emitted = self._device(
+                lambda: self.engine.verify(
+                    window, start, n_draft, tables, temps, top_ks, keys
+                )
+            )
+        except Exception as e:
+            # batch-wide failure, exactly like _decode_once
+            for state in list(self._running.values()):
+                self._release(state)
+                state.req.handle._fail(e)
+                self.stats.incr("failed")
+            return True
+        n_live_tokens = 0
+        for state in order:
+            if self._running.get(state.slot) is not state:
+                continue  # preempted/expired between collect and scatter
+            req = state.req
+            i = state.slot
+            m = int(n_emitted[i])
+            toks = [int(t) for t in out[i, :m]]
+            # budget truncation: never emit past max_new
+            toks = toks[: req.max_new - req.n_generated]
+            # mid-window EOS: keep through the FIRST eos, drop the rest
+            eos = req.sampling.eos_id
+            if eos is not None and eos in toks:
+                toks = toks[: toks.index(eos) + 1]
+            accepted = max(0, m - 1)  # drafts the target agreed with
+            req.update_speculation(proposed=int(max(0, n_draft[i])), accepted=accepted)
+            self.spec_stats.record_window(
+                proposed=int(max(0, n_draft[i])), accepted=accepted, emitted=len(toks)
+            )
+            for t in toks:
+                self._emit_token(state, t)
+            state.cached_len += len(toks)
+            self._trim_blocks(state)
+            n_live_tokens += len(toks)
+            if req.finished():
+                self._finish(state)
+        self.token_rate.record(n_live_tokens)
+        return True
+
     # ---------------------------------------------------------------- step
     def step(self) -> bool:
         """One scheduling iteration: expire, admit (join-mid-flight),
-        grow/preempt, decode. Returns True if any work happened."""
+        plan speculation, grow/preempt, then decode — or verify, when
+        any running request speculates. Returns True if any work
+        happened."""
         self._expire()
         did = False
         # admit as many as fit THIS iteration — they decode together below
         while self._admit():
             did = True
+        self._plan_speculation()
         self._grow()
-        if self._decode_once():
+        speculating = any(s.step_k > 0 for s in self._running.values())
+        if self._verify_once() if speculating else self._decode_once():
             did = True
         return did

@@ -1,0 +1,48 @@
+"""Speculative decoding: drafters + fixed-shape batched verification.
+
+Steady-state decode is memory-bandwidth-bound — one fixed-shape jit per
+generated token (engine.py). Speculative decoding (Leviathan et al.
+2023; SpecInfer, Miao et al. 2024, from the FlexFlow lineage this repo
+reproduces) amortizes that cost: a cheap *drafter* guesses up to k
+tokens, ONE fixed-shape ``verify`` forward scores the whole batch ×
+(k+1) window against the block-table KV cache (chunked-append
+attention, ops/attention.py), and exact acceptance keeps the output
+distribution identical to non-speculative decoding:
+
+* greedy verification reproduces the non-speculative greedy stream
+  token-for-token — unconditionally (any drafter, any preemption or
+  load pattern);
+* temperature/top-k sampling uses distribution-preserving rejection
+  sampling on the engine's per-token-count seeded keys: every emitted
+  token's marginal is exactly the target distribution, identical
+  scheduling replays the identical stream, and preemption never
+  rewrites emitted tokens (window layout — hence the realized draw —
+  can differ under different load; only greedy is
+  realization-invariant).
+
+The speculation-aware ContinuousBatchingScheduler (generation/
+scheduler.py) drives it: multi-token cache append with block allocation
+for up to k+1 tokens per step, per-request adaptive k (shrink on low
+acceptance, cap on cache pressure), and exact accounting when a
+partially-accepted window crosses a block boundary or EOS lands
+mid-window.
+"""
+from .drafter import (
+    Drafter,
+    DraftModelDrafter,
+    NgramDrafter,
+    SpeculationConfig,
+    build_drafter,
+)
+from .sampling import rejection_sample, residual_distribution, speculative_accept
+
+__all__ = [
+    "Drafter",
+    "DraftModelDrafter",
+    "NgramDrafter",
+    "SpeculationConfig",
+    "build_drafter",
+    "rejection_sample",
+    "residual_distribution",
+    "speculative_accept",
+]

@@ -1,0 +1,145 @@
+"""Speculative acceptance: exact verification of drafted windows.
+
+Leviathan et al. 2023 ("Fast Inference from Transformers via Speculative
+Decoding"): score k drafted tokens with ONE target forward, accept the
+longest prefix the target agrees with, and emit one extra token from the
+target's own distribution at the first disagreement (the correction) or
+after a fully-accepted window (the bonus) — so every window emits
+between 1 and k+1 tokens and the output distribution is EXACTLY the
+target model's.
+
+Two exactness regimes, both implemented here and jit-composed into the
+engine's fixed-shape verify step:
+
+* **Greedy** (``temperature <= 0``): a draft is accepted iff it equals
+  the target argmax. Emitted tokens are the target argmax chain — a
+  speculative greedy stream is token-for-token identical to the
+  non-speculative one, whatever the drafter proposes.
+* **Temperature/top-k sampling**: distribution-preserving rejection
+  sampling against a point-mass proposal (both in-tree drafters propose
+  deterministically): draft ``d`` with target probability ``p`` is
+  accepted with probability ``min(1, p(d)/q(d)) = p(d)``; on first
+  rejection the emitted token is drawn from the normalized residual
+  ``max(p - q, 0)`` (``p`` with ``d`` excluded); after a fully-accepted
+  window the bonus token is drawn from ``p`` itself. The marginal of
+  every emitted token is exactly ``p``.
+
+Key discipline mirrors the engine's per-token-count seeded streams: the
+token emitted at generated-count ``n`` consumes keys derived ONLY from
+``fold_in(base_key, n)`` — the accept coin from ``fold_in(key, 1)``,
+the residual draw from ``fold_in(key, 2)``, and the bonus draw from the
+raw key, which makes a zero-draft verify step sample *identically* to
+the non-speculative decode step (same Gumbel trick on the same raw
+key). Consequences: greedy streams are realization-invariant (argmax
+consumes no key); temperature streams replay identically under
+identical scheduling, and preempt-and-recompute preserves the emitted
+prefix verbatim while the continuation draws from the same per-count
+key stream. Which derivation a count consumes depends on where it
+lands in a window (draft / rejection / bonus), so a different window
+layout — different load, different adaptive k — may realize a
+different, equally-distributed temperature stream.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import topk_scaled_logits
+
+
+def residual_distribution(p: jax.Array, q: jax.Array) -> jax.Array:
+    """Normalized rejection residual ``max(p - q, 0)`` over the last
+    axis. Degenerate case (q covers p everywhere, so rejection has
+    probability zero): fall back to ``p`` instead of NaN."""
+    res = jnp.maximum(p - q, 0.0)
+    total = jnp.sum(res, axis=-1, keepdims=True)
+    return jnp.where(total > 1e-12, res / jnp.maximum(total, 1e-30), p)
+
+
+def rejection_sample(
+    p: jax.Array, q: jax.Array, draft: jax.Array, key: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """One general-proposal rejection-sampling step (the textbook rule,
+    exposed for tests and soft-q drafters): accept ``draft`` with
+    probability ``min(1, p[draft]/q[draft])``, else sample from the
+    normalized residual. ``p``/``q``: [V] target and proposal
+    probabilities. Returns (token, accepted) — the marginal of ``token``
+    is exactly ``p`` for ANY proposal ``q``."""
+    p_d = p[draft]
+    q_d = jnp.maximum(q[draft], 1e-30)
+    u = jax.random.uniform(jax.random.fold_in(key, 1))
+    accepted = u < jnp.minimum(1.0, p_d / q_d)
+    res = residual_distribution(p, q)
+    gumbel = jax.random.gumbel(jax.random.fold_in(key, 2), res.shape)
+    resampled = jnp.argmax(jnp.log(jnp.maximum(res, 1e-30)) + gumbel, axis=-1)
+    return jnp.where(accepted, draft, resampled).astype(jnp.int32), accepted
+
+
+def speculative_accept(
+    logits: jax.Array,
+    draft_tokens: jax.Array,
+    n_draft: jax.Array,
+    temps: jax.Array,
+    top_ks: jax.Array,
+    keys: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Vectorized window acceptance for the engine's verify step.
+
+    logits: [B, W, V] target logits over the window (index ``j`` scores
+    the token at emitted-count offset ``j``); draft_tokens: [B, W-1]
+    int32 (point-mass proposals; entries past ``n_draft`` ignored);
+    n_draft: [B] int32 in [0, W-1]; temps/top_ks: [B]; keys: [B, W]
+    PRNG keys, one per emitted-count offset.
+
+    Returns (out_tokens [B, W], n_emitted [B]): ``out_tokens[b, :a+1]``
+    are the emitted tokens where ``a`` is the accepted-prefix length —
+    accepted drafts followed by the correction (first rejection) or
+    bonus (full acceptance) token; entries past ``n_emitted`` are
+    garbage. ``n_draft == 0`` degenerates to exactly the engine's
+    non-speculative sampling of one token with ``keys[:, 0]``.
+    """
+    b, w, v = logits.shape
+    kd = w - 1
+    greedy = temps <= 0.0
+    offs = jnp.arange(w, dtype=jnp.int32)[None, :]
+    # the engine's own sampling transform, broadcast over the window —
+    # sharing it keeps zero-draft verify bit-identical to decode
+    masked = topk_scaled_logits(
+        logits,
+        jnp.broadcast_to(temps[:, None], (b, w)),
+        jnp.broadcast_to(top_ks[:, None], (b, w)),
+    )
+    p = jax.nn.softmax(masked, axis=-1)  # [B, W, V] target sampling dist
+    g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, W] greedy chain
+
+    # -- acceptance of each draft (point-mass proposal) ------------------
+    d = draft_tokens.astype(jnp.int32)  # [B, kd]
+    p_d = jnp.take_along_axis(p[:, :kd], d[..., None], axis=-1)[..., 0]  # [B, kd]
+    accept_key = jax.vmap(jax.vmap(lambda kk: jax.random.fold_in(kk, 1)))(keys[:, :kd])
+    u = jax.vmap(jax.vmap(jax.random.uniform))(accept_key)  # [B, kd]
+    acc = jnp.where(greedy[:, None], d == g[:, :kd], u < p_d)
+    acc = jnp.logical_and(acc, offs[:, :kd] < n_draft[:, None])
+    a = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)  # [B]
+
+    # -- correction / bonus token at every offset (selected at j == a) ---
+    # residual draw (rejection at offset j < n_draft): p_j minus the
+    # drafted token's mass, renormalized
+    res = residual_distribution(p[:, :kd], jax.nn.one_hot(d, v, dtype=p.dtype) * p_d[..., None])
+    res_key = jax.vmap(jax.vmap(lambda kk: jax.random.fold_in(kk, 2)))(keys[:, :kd])
+    res_gumbel = jax.vmap(jax.vmap(lambda kk: jax.random.gumbel(kk, (v,))))(res_key)
+    r_res = jnp.argmax(jnp.log(jnp.maximum(res, 1e-30)) + res_gumbel, axis=-1)  # [B, kd]
+    r_res = jnp.concatenate([r_res, jnp.zeros((b, 1), r_res.dtype)], axis=1)
+    # bonus draw (offset j == n_draft, nothing proposed): sample from p_j
+    # with the RAW key — byte-identical to engine._sample's Gumbel trick
+    bonus_gumbel = jax.vmap(jax.vmap(lambda kk: jax.random.gumbel(kk, (v,))))(keys)
+    r_bonus = jnp.argmax(masked + bonus_gumbel, axis=-1)  # [B, W]
+    corr = jnp.where(offs < n_draft[:, None], r_res, r_bonus)
+    corr = jnp.where(greedy[:, None], g, corr)
+
+    # -- emitted tokens: accepted drafts then the correction/bonus -------
+    out_draft = jnp.concatenate([d, jnp.zeros((b, 1), d.dtype)], axis=1)
+    out = jnp.where(offs < a[:, None], out_draft, corr)
+    out = jnp.where(greedy[:, None], g, out)  # accepted greedy drafts ARE g
+    return out.astype(jnp.int32), (a + 1).astype(jnp.int32)

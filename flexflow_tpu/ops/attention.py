@@ -239,6 +239,50 @@ def decode_attention_core(
     )
 
 
+def append_attention_core(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    q_positions: jax.Array,
+    backend: str = "tpu",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Chunked-append attention: a W-token window per sequence
+    ([B, W, H, D], K/V already written) over the block-structured KV
+    cache. Query (b, w) attends cache positions ``<= q_positions[b, w]``
+    — causal within the window, full history before it — so verifying a
+    k+1-token speculative window in one forward reproduces the k+1
+    sequential decode steps' logits exactly. ``q_positions < 0`` marks
+    fixed-shape padding queries (they emit zeros). Decode-mode attention
+    is the W = 1 special case.
+
+    Dispatches to the generalized Pallas paged kernel on TPU backends
+    (kernels/decode_attention.py) and to the XLA gather + masked softmax
+    composition elsewhere.
+    """
+    from .kernels.decode_attention import (
+        on_tpu,
+        paged_append_attention,
+        reference_paged_append_attention,
+        supports_append_shapes,
+    )
+
+    if (
+        backend == "tpu"
+        and on_tpu()
+        and supports_append_shapes(
+            q.shape[2], q.shape[3], k_cache.shape[1], q.shape[1]
+        )
+    ):
+        return paged_append_attention(
+            q, k_cache, v_cache, block_tables, q_positions, scale=scale
+        )
+    return reference_paged_append_attention(
+        q, k_cache, v_cache, block_tables, q_positions, scale=scale
+    )
+
+
 def masked_attention(q, k, v, lengths, causal=True, scale=None):
     """Causal attention over [B, S, H, D] with a per-sequence valid
     length: key positions >= lengths[b] are masked. The prefill side of

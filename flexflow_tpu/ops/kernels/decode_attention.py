@@ -1,26 +1,32 @@
-"""Paged decode attention: one query token per sequence attending over a
-block-structured KV cache.
+"""Paged decode/append attention: a window of query tokens per sequence
+attending over a block-structured KV cache.
 
-The generation engine's decode step calls this once per layer: ``q`` is
-[B, H, D] (the token being decoded, one per batch slot), and the cached
-K/V live in the block-structured cache (generation/cache.py) as
-[num_blocks, block_size, H, D] per layer, indexed per sequence through a
-block table. Position masking keeps only cache positions
-``< context_len`` in the softmax, so incremental decode reproduces the
-full-context causal logits exactly.
+The generation engine's decode step calls this once per layer with a
+one-token window (``q`` [B, H, D]); the speculative-verification step
+calls the generalized *chunked-append* form with a W = k+1 token window
+(``q`` [B, W, H, D]) — the W drafted-window tokens are scored against
+the cache in ONE forward instead of W sequential decode steps. Each
+window query has its own cache position; masking keeps only cache
+positions ``<= q_position`` in its softmax (causal within the window,
+full history before it), so chunked verification reproduces the
+sequential decode logits exactly. ``q_position < 0`` marks a padding
+query (fixed-shape windows with fewer real draft tokens): it attends to
+nothing and emits zeros.
 
 Two lowerings:
 
-* :func:`reference_paged_attention` — gather the table'd blocks and run
-  a masked softmax in plain XLA. This is the CPU/test path and the
-  parity oracle.
-* :func:`paged_decode_attention` — a Pallas TPU kernel gridded over
-  (batch, cache blocks) with the block tables scalar-prefetched
-  (``pltpu.PrefetchScalarGridSpec``), so each grid step DMAs exactly
-  one cache block into VMEM (the PagedAttention access pattern) and
-  accumulates online-softmax state in scratch across the sequential
-  grid. Out-of-range table entries point at the scratch block 0 and are
-  masked, never read out of bounds.
+* :func:`reference_paged_append_attention` — gather the table'd blocks
+  and run a masked softmax in plain XLA. This is the CPU/test path and
+  the parity oracle. :func:`reference_paged_attention` is its W = 1
+  wrapper (the original decode form).
+* :func:`paged_append_attention` — a Pallas TPU kernel gridded over
+  (batch, cache blocks) with the block tables AND per-query positions
+  scalar-prefetched (``pltpu.PrefetchScalarGridSpec``), so each grid
+  step DMAs exactly one cache block into VMEM (the PagedAttention
+  access pattern) and accumulates per-query online-softmax state in
+  scratch across the sequential grid. Out-of-range table entries point
+  at the scratch block 0 and are masked, never read out of bounds.
+  :func:`paged_decode_attention` is its W = 1 wrapper.
 """
 from __future__ import annotations
 
@@ -44,21 +50,23 @@ def on_tpu() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
-def reference_paged_attention(
+def reference_paged_append_attention(
     q: jax.Array,
     k_cache: jax.Array,
     v_cache: jax.Array,
     block_tables: jax.Array,
-    context_lens: jax.Array,
+    q_positions: jax.Array,
     scale: Optional[float] = None,
 ) -> jax.Array:
-    """Masked attention over gathered cache blocks, in plain XLA.
+    """Masked window attention over gathered cache blocks, in plain XLA.
 
-    q: [B, H, D]; k_cache/v_cache: [num_blocks, block_size, H, D];
-    block_tables: [B, max_blocks] int32; context_lens: [B] int32
-    (number of valid cache positions, INCLUDING the current token's
-    already-written K/V). Returns [B, H, D]. Sequences with
-    context_len == 0 (inactive slots) produce zeros, not NaN.
+    q: [B, W, H, D] (a W-token append window per sequence, K/V already
+    written into the cache); k_cache/v_cache: [num_blocks, block_size,
+    H, D]; block_tables: [B, max_blocks] int32; q_positions: [B, W]
+    int32 — each window query's cache position. Query (b, w) attends to
+    cache positions ``<= q_positions[b, w]`` (its own history including
+    itself); ``q_positions[b, w] < 0`` marks a padding query, which
+    produces zeros, not NaN. Returns [B, W, H, D].
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -67,9 +75,9 @@ def reference_paged_attention(
     # [B, max_blocks, bs, H, D] -> [B, S_max, H, D]
     k = k_cache[block_tables].reshape(b, max_blocks * bs, *k_cache.shape[2:])
     v = v_cache[block_tables].reshape(b, max_blocks * bs, *v_cache.shape[2:])
-    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
-    pos = jnp.arange(max_blocks * bs)[None, None, :]
-    valid = pos < context_lens[:, None, None]
+    s = jnp.einsum("bwhd,bkhd->bhwk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_blocks * bs)[None, None, None, :]  # key positions
+    valid = pos <= q_positions[:, None, :, None]  # [B, 1, W, S_max]
     s = jnp.where(valid, s, NEG_INF)
     # max over an all-masked row is NEG_INF; subtracting keeps exp at 1
     # on masked lanes, so zero the probabilities explicitly instead of
@@ -77,8 +85,26 @@ def reference_paged_attention(
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.where(valid, jnp.exp(s - m), 0.0)
     l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
-    out = jnp.einsum("bhk,bkhd->bhd", p / l, v.astype(jnp.float32))
+    out = jnp.einsum("bhwk,bkhd->bwhd", p / l, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def reference_paged_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    context_lens: jax.Array,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """One-token (decode) form: q [B, H, D], context_lens [B] int32 (the
+    number of valid cache positions INCLUDING the current token's
+    already-written K/V; 0 marks an inactive slot). The W = 1 special
+    case of :func:`reference_paged_append_attention`."""
+    out = reference_paged_append_attention(
+        q[:, None], k_cache, v_cache, block_tables, context_lens[:, None] - 1, scale
+    )
+    return out[:, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -86,16 +112,16 @@ def reference_paged_attention(
 # ---------------------------------------------------------------------------
 
 
-def _decode_kernel(
+def _append_kernel(
     bt_ref,  # scalar-prefetch: [B, max_blocks] block tables
-    len_ref,  # scalar-prefetch: [B] context lens
-    q_ref,  # [H, D] this sequence's query
+    qpos_ref,  # scalar-prefetch: [B, W] per-query cache positions (-1 = pad)
+    q_ref,  # [W, H, D] this sequence's query window
     k_ref,  # [block_size, H, D] the grid step's cache block
     v_ref,  # [block_size, H, D]
-    o_ref,  # [H, D]
-    m_ref,  # scratch [H, 1] running max
-    l_ref,  # scratch [H, 1] running denominator
-    acc_ref,  # scratch [H, D] running numerator
+    o_ref,  # [W, H, D]
+    m_ref,  # scratch [H, W] running max per query
+    l_ref,  # scratch [H, W] running denominator per query
+    acc_ref,  # scratch [H, W, D] running numerator per query
     *,
     scale,
     block_size,
@@ -103,7 +129,8 @@ def _decode_kernel(
     b = pl.program_id(0)
     j = pl.program_id(1)
     nblocks = pl.num_programs(1)
-    ctx = len_ref[b]
+    qp = qpos_ref[b, :]  # [W] each query's own cache position
+    max_qp = jnp.max(qp)
 
     @pl.when(j == 0)
     def _init():
@@ -111,37 +138,86 @@ def _decode_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # whole block past the context: nothing to accumulate (its DMA read
-    # the scratch block; the data is ignored)
-    @pl.when(j * block_size < ctx)
+    # whole block past every query's position: nothing to accumulate
+    # (its DMA read the scratch block; the data is ignored)
+    @pl.when(j * block_size <= max_qp)
     def _accum():
-        q = q_ref[:].astype(jnp.float32) * scale  # [H, D]
+        q = jnp.swapaxes(q_ref[:].astype(jnp.float32), 0, 1) * scale  # [H, W, D]
         k = k_ref[:].astype(jnp.float32)  # [bs, H, D]
         v = v_ref[:].astype(jnp.float32)
-        # s[h, t] = sum_d q[h, d] * k[t, h, d] — batch over H on the MXU
+        # s[h, w, t] = sum_d q[h, w, d] * k[t, h, d] — batch over H on the MXU
         s = jax.lax.dot_general(
-            q, k, (((1,), (2,)), ((0,), (1,))), preferred_element_type=jnp.float32
-        )  # [H, bs]
-        pos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos < ctx, s, NEG_INF)
-        m_prev, l_prev = m_ref[:], l_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(pos < ctx, jnp.exp(s - m_new), 0.0)
+            q, k, (((2,), (2,)), ((0,), (1,))), preferred_element_type=jnp.float32
+        )  # [H, W, bs]
+        pos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        valid = pos <= qp[None, :, None]  # causal-within-window + history
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]  # [H, W]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.where(valid, jnp.exp(s - m_new[:, :, None]), 0.0)
         corr = jnp.exp(m_prev - m_new)
         m_ref[:] = m_new
-        l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-        # acc[h, d] += sum_t p[h, t] * v[t, h, d]
+        l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1)
+        # acc[h, w, d] += sum_t p[h, w, t] * v[t, h, d]
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((0,), (1,))), preferred_element_type=jnp.float32
-        )  # [H, D]
-        acc_ref[:] = acc_ref[:] * corr + pv
+            p, v, (((2,), (0,)), ((0,), (1,))), preferred_element_type=jnp.float32
+        )  # [H, W, D]
+        acc_ref[:] = acc_ref[:] * corr[:, :, None] + pv
 
     @pl.when(j == nblocks - 1)
     def _finish():
-        l = jnp.maximum(l_ref[:], 1e-30)
-        # an inactive slot (ctx == 0) accumulated nothing: emit zeros
-        out = jnp.where(ctx > 0, acc_ref[:] / l, 0.0)
-        o_ref[:] = out.astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[:], 1e-30)  # [H, W]
+        # a padding query (qp < 0) accumulated nothing: emit zeros
+        out = jnp.where(qp[None, :, None] >= 0, acc_ref[:] / l[:, :, None], 0.0)
+        o_ref[:] = jnp.swapaxes(out, 0, 1).astype(o_ref.dtype)
+
+
+def paged_append_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    q_positions: jax.Array,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Pallas paged chunked-append attention (shapes as in
+    :func:`reference_paged_append_attention`). ``interpret=None``
+    auto-selects interpret mode off-TPU so the kernel path is testable
+    on CPU."""
+    if pl is None or pltpu is None:
+        return reference_paged_append_attention(
+            q, k_cache, v_cache, block_tables, q_positions, scale
+        )
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = not on_tpu()
+    b, w, h, d = q.shape
+    _, block_size, _, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_blocks),
+        in_specs=[
+            pl.BlockSpec((None, w, h, d), lambda i, j, bt, qp: (i, 0, 0, 0)),
+            pl.BlockSpec((None, block_size, h, d), lambda i, j, bt, qp: (bt[i, j], 0, 0, 0)),
+            pl.BlockSpec((None, block_size, h, d), lambda i, j, bt, qp: (bt[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, w, h, d), lambda i, j, bt, qp: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, w), jnp.float32),
+            pltpu.VMEM((h, w), jnp.float32),
+            pltpu.VMEM((h, w, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_append_kernel, scale=float(scale), block_size=block_size)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, w, h, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), q_positions.astype(jnp.int32), q, k_cache, v_cache)
 
 
 def paged_decode_attention(
@@ -153,43 +229,32 @@ def paged_decode_attention(
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Pallas paged decode attention (shapes as in
-    :func:`reference_paged_attention`). ``interpret=None`` auto-selects
-    interpret mode off-TPU so the kernel path is testable on CPU."""
-    if pl is None or pltpu is None:
-        return reference_paged_attention(q, k_cache, v_cache, block_tables, context_lens, scale)
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        interpret = not on_tpu()
-    b, h, d = q.shape
-    _, block_size, _, _ = k_cache.shape
-    max_blocks = block_tables.shape[1]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, max_blocks),
-        in_specs=[
-            pl.BlockSpec((None, h, d), lambda i, j, bt, ln: (i, 0, 0)),
-            pl.BlockSpec((None, block_size, h, d), lambda i, j, bt, ln: (bt[i, j], 0, 0, 0)),
-            pl.BlockSpec((None, block_size, h, d), lambda i, j, bt, ln: (bt[i, j], 0, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, h, d), lambda i, j, bt, ln: (i, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((h, 1), jnp.float32),
-            pltpu.VMEM((h, 1), jnp.float32),
-            pltpu.VMEM((h, d), jnp.float32),
-        ],
-    )
-    kernel = functools.partial(_decode_kernel, scale=float(scale), block_size=block_size)
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+    """One-token (decode) form of :func:`paged_append_attention`
+    (shapes as in :func:`reference_paged_attention`)."""
+    out = paged_append_attention(
+        q[:, None],
+        k_cache,
+        v_cache,
+        block_tables,
+        context_lens[:, None] - 1,
+        scale=scale,
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), q, k_cache, v_cache)
+    )
+    return out[:, 0]
 
 
 def supports_decode_shapes(num_heads: int, head_dim: int, block_size: int) -> bool:
     """Shapes the TPU kernel handles without falling back: lane-multiple
     head_dim and a sublane-multiple block size."""
     return head_dim in (64, 128, 256) and block_size % 8 == 0 and num_heads >= 1
+
+
+def supports_append_shapes(
+    num_heads: int, head_dim: int, block_size: int, window: int
+) -> bool:
+    """Append-window shapes the TPU kernel handles without falling back:
+    the decode constraints plus a bounded window (the per-query scratch
+    is [H, W, D] in VMEM; tiny speculative windows always fit)."""
+    return (
+        supports_decode_shapes(num_heads, head_dim, block_size) and 1 <= window <= 32
+    )

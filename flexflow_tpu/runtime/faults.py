@@ -30,6 +30,8 @@ Injection sites currently threaded through the codebase:
   ``checkpoint.save``           top of save_checkpoint
   ``generation.prefill``        before a generation prefill (value = prompt tokens)
   ``generation.decode_step``    before each batched decode step (value = slot tokens)
+  ``generation.verify``         before each speculative verification step
+                                (value = [B, k+1] window tokens)
 
 Usage::
 

@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..generation.engine import GenerationEngine, SamplingParams
 from ..generation.scheduler import ContinuousBatchingScheduler, GenerationHandle
+from ..generation.speculative import SpeculationConfig
 
 
 class GenerationModel:
@@ -57,17 +58,21 @@ class GenerationModel:
         prompt: Sequence[int],
         sampling: Optional[SamplingParams] = None,
         deadline_s: Optional[float] = None,
+        speculation: Optional[SpeculationConfig] = None,
     ) -> GenerationHandle:
-        return self.scheduler.submit(prompt, sampling, deadline_s=deadline_s)
+        return self.scheduler.submit(
+            prompt, sampling, deadline_s=deadline_s, speculation=speculation
+        )
 
     def generate(
         self,
         prompt: Sequence[int],
         sampling: Optional[SamplingParams] = None,
         timeout: Optional[float] = None,
+        speculation: Optional[SpeculationConfig] = None,
     ) -> List[int]:
         """Blocking single-request generation (deadline = timeout)."""
-        handle = self.submit(prompt, sampling, deadline_s=timeout)
+        handle = self.submit(prompt, sampling, deadline_s=timeout, speculation=speculation)
         return handle.result(timeout=timeout)
 
     @staticmethod
@@ -84,6 +89,27 @@ class GenerationModel:
             seed=int(params.get("seed", defaults.seed)),
         )
 
+    @staticmethod
+    def speculation_from(params: Dict) -> Optional[SpeculationConfig]:
+        """Build a SpeculationConfig from the request's ``speculation``
+        block (HTTP JSON body / gRPC parameters map), ignoring unknown
+        keys. Absent block (or ``enabled: false``) -> None (the
+        scheduler's default policy applies)."""
+        block = params.get("speculation")
+        if not isinstance(block, dict):
+            return None
+        if not bool(block.get("enabled", True)):
+            return SpeculationConfig(enabled=False)
+        defaults = SpeculationConfig()
+        return SpeculationConfig(
+            enabled=True,
+            k=int(block.get("k", defaults.k)),
+            method=str(block.get("method", defaults.method)),
+            max_ngram=int(block.get("max_ngram", defaults.max_ngram)),
+            min_ngram=int(block.get("min_ngram", defaults.min_ngram)),
+            adaptive=bool(block.get("adaptive", defaults.adaptive)),
+        )
+
     def metadata(self) -> Dict:
         cfg = self.engine.cfg
         cc = self.engine.cache_config
@@ -91,6 +117,7 @@ class GenerationModel:
             "name": self.name,
             "platform": "flexflow_tpu_generation",
             "max_batch_slots": self.engine.max_batch_slots,
+            "max_spec_tokens": self.engine.max_spec_tokens,
             "max_seq_len": self.engine.max_seq_len,
             "prompt_buckets": list(self.engine.buckets),
             "vocab_size": cfg.vocab_size,

@@ -222,7 +222,10 @@ class InferenceServer:
             def _generate(self, name: str):
                 """POST /v2/models/{name}/generate — body: {"prompt":
                 [ids], "max_new_tokens", "temperature", "top_k",
-                "eos_id", "seed", "stream", "parameters": {"timeout_ms"}}.
+                "eos_id", "seed", "stream", "parameters": {"timeout_ms"},
+                "speculation": {"enabled", "k", "method", "max_ngram",
+                "min_ngram", "adaptive"}}. The speculation block turns
+                on (exact) speculative decoding for this request.
                 Non-streaming: one JSON object. "stream": true: SSE — one
                 ``data:`` event per token, then a final done event."""
                 gen = server.generators.get(name)
@@ -238,7 +241,10 @@ class InferenceServer:
                         "timeout_ms", self.headers.get("X-Request-Timeout-Ms")
                     )
                     deadline_s = None if timeout_ms is None else float(timeout_ms) / 1000.0
-                    handle = gen.submit(prompt, sampling, deadline_s=deadline_s)
+                    speculation = gen.speculation_from(req)
+                    handle = gen.submit(
+                        prompt, sampling, deadline_s=deadline_s, speculation=speculation
+                    )
                 except ResilienceError as e:
                     return self._json(http_status(e), {"error": str(e)})
                 except Exception as e:

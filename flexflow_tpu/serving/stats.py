@@ -90,6 +90,57 @@ class ServingStats:
         return out
 
 
+class SpeculationStats:
+    """Speculative-decoding counters for one served model: drafted
+    (proposed) vs accepted tokens per verification window, plus the
+    derived acceptance rate and mean accepted run length surfaced as
+    /v2/stats gauges.
+
+    ``record_window(proposed, accepted)`` is called once per verify
+    window per sequence; windows with zero proposals (drafter miss,
+    budget cap) still count toward ``windows`` so the mean run length
+    reflects what the engine actually did.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.windows = 0
+        self.proposed = 0
+        self.accepted = 0
+        self.emitted = 0
+
+    def record_window(self, proposed: int, accepted: int, emitted: int) -> None:
+        with self._lock:
+            self.windows += 1
+            self.proposed += proposed
+            self.accepted += accepted
+            self.emitted += emitted
+
+    def acceptance_rate(self) -> float:
+        with self._lock:
+            return self.accepted / self.proposed if self.proposed else 0.0
+
+    def mean_accepted_len(self) -> float:
+        """Mean accepted drafts per verification window."""
+        with self._lock:
+            return self.accepted / self.windows if self.windows else 0.0
+
+    def mean_emitted_len(self) -> float:
+        """Mean tokens emitted per verification window (accepted drafts
+        + the correction/bonus token) — the tokens-per-engine-step
+        multiplier over non-speculative decode."""
+        with self._lock:
+            return self.emitted / self.windows if self.windows else 0.0
+
+    def register_gauges(self, stats: "ServingStats", prefix: str = "spec_") -> None:
+        stats.add_gauge(prefix + "windows", lambda: self.windows)
+        stats.add_gauge(prefix + "tokens_proposed", lambda: self.proposed)
+        stats.add_gauge(prefix + "tokens_accepted", lambda: self.accepted)
+        stats.add_gauge(prefix + "acceptance_rate", self.acceptance_rate)
+        stats.add_gauge(prefix + "mean_accepted_len", self.mean_accepted_len)
+        stats.add_gauge(prefix + "mean_emitted_len", self.mean_emitted_len)
+
+
 class TokenRate:
     """Windowed tokens/s gauge for the generation engine: record token
     batches as they are emitted; ``rate()`` is tokens over the trailing

@@ -12,9 +12,19 @@ and FAILS (exit 1) if steady-state decode retraced — the engine's core
 contract is at most ONE compile per prompt bucket and exactly one
 decode program, whatever joins or leaves the batch.
 
+``--speculate`` additionally benchmarks speculative decoding with the
+model-free n-gram drafter on repetitive prompts: same request stream
+through a baseline engine and a speculating engine (same params, so
+greedy outputs are token-for-token identical — asserted), reporting
+acceptance rate, mean accepted run length, and the decode
+tokens-per-engine-step speedup vs the baseline. The retrace guard
+extends to the verify program (exactly one compile), and the run fails
+below ``--min-speedup`` (default 1.5x).
+
 Usage:
   python tools/genbench.py [--out genbench.json] [--requests 12]
       [--max-new 16] [--layers 2] [--hidden 64] [--heads 4] [--vocab 128]
+      [--speculate] [--spec-k 4] [--min-speedup 1.5]
 """
 from __future__ import annotations
 
@@ -32,23 +42,151 @@ from flexflow_tpu.generation import (  # noqa: E402
     ContinuousBatchingScheduler,
     GenerationEngine,
     SamplingParams,
+    SpeculationConfig,
     init_decoder_params,
 )
 from flexflow_tpu.models.transformer import TransformerConfig  # noqa: E402
+
+
+def run_stream(engine, prompts, sampling, speculation=None):
+    """Drive one request stream to completion; returns (outputs,
+    scheduler, elapsed_s)."""
+    sched = ContinuousBatchingScheduler(engine)
+    t0 = time.perf_counter()
+    handles = [sched.submit(p, sampling, speculation=speculation) for p in prompts]
+    while any(not h.done() for h in handles):
+        if not sched.step():
+            break
+    elapsed = time.perf_counter() - t0
+    return [h.result(timeout=0) for h in handles], sched, elapsed
+
+
+def speculate_bench(args, cfg, params) -> tuple:
+    """Baseline vs n-gram-speculation on repetitive prompts. Returns
+    (report dict, ok bool)."""
+    rs = np.random.RandomState(1)
+    # decode-dominated stream: generation length drives the speedup an
+    # untrained model's greedy continuation settles into a cycle the
+    # prompt-lookup drafter then rides
+    max_new = args.max_new if args.max_new_set else 48
+    hi = min(48, args.seq_len - max_new - 1)
+    if hi < 5:
+        print(
+            f"--seq-len {args.seq_len} leaves no prompt room for "
+            f"--max-new {max_new}; need seq_len - max_new >= 6",
+            file=sys.stderr,
+        )
+        return {}, False
+    lo = min(12, hi - 1)
+    prompts = []
+    for _ in range(args.requests):
+        # repetitive prompt: a short random motif tiled to a mixed
+        # length — the prompt-lookup drafter's home turf
+        motif = rs.randint(0, args.vocab, rs.randint(3, 6)).tolist()
+        n = int(rs.randint(lo, hi))
+        prompts.append((motif * (n // len(motif) + 1))[:n])
+    sampling = SamplingParams(max_new_tokens=max_new)
+    spec = SpeculationConfig(k=args.spec_k, method="ngram")
+
+    base_eng = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16,
+                                max_spec_tokens=args.spec_k)
+    base_eng.generate([prompts[0]], SamplingParams(max_new_tokens=2))
+    for b in sorted({base_eng.bucket_for(len(p)) for p in prompts}):
+        base_eng.generate([[1] * min(b, args.seq_len - 2)], SamplingParams(max_new_tokens=2))
+    base_warm_steps = dict(base_eng.step_counts)
+    base_out, _, base_s = run_stream(base_eng, prompts, sampling)
+    spec_eng = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16,
+                                max_spec_tokens=args.spec_k)
+    # warm every prefill bucket + the verify/decode programs so the
+    # measured stream is steady state for the retrace guard
+    spec_eng.generate([prompts[0]], SamplingParams(max_new_tokens=4), speculation=spec)
+    for b in sorted({spec_eng.bucket_for(len(p)) for p in prompts}):
+        spec_eng.generate(
+            [[1] * min(b, args.seq_len - 2)], SamplingParams(max_new_tokens=2),
+            speculation=spec,
+        )
+    warm_traces = dict(spec_eng.trace_counts)
+    warm_steps = dict(spec_eng.step_counts)
+    spec_out, spec_sched, spec_s = run_stream(spec_eng, prompts, sampling, speculation=spec)
+
+    gen_tokens = sum(len(o) for o in base_out)
+    base_steps = base_eng.step_counts["decode"] - base_warm_steps["decode"]
+    spec_steps = (spec_eng.step_counts["verify"] - warm_steps["verify"]) + (
+        spec_eng.step_counts["decode"] - warm_steps["decode"]
+    )
+    base_tps = gen_tokens / max(1, base_steps)
+    spec_tps = sum(len(o) for o in spec_out) / max(1, spec_steps)
+    speedup = spec_tps / base_tps
+    ss = spec_sched.spec_stats
+    steady_retraces = {
+        k: spec_eng.trace_counts[k] - warm_traces.get(k, 0)
+        for k in spec_eng.trace_counts
+        if spec_eng.trace_counts[k] - warm_traces.get(k, 0) > 0
+    }
+    report = {
+        "requests": args.requests,
+        "generated_tokens": gen_tokens,
+        "exact": base_out == spec_out,
+        "baseline_decode_steps": base_steps,
+        "speculative_steps": spec_steps,
+        "baseline_tokens_per_step": round(base_tps, 3),
+        "speculative_tokens_per_step": round(spec_tps, 3),
+        "tokens_per_step_speedup": round(speedup, 3),
+        "baseline_stream_s": round(base_s, 4),
+        "speculative_stream_s": round(spec_s, 4),
+        "acceptance_rate": round(ss.acceptance_rate(), 3),
+        "mean_accepted_len": round(ss.mean_accepted_len(), 3),
+        "mean_emitted_len": round(ss.mean_emitted_len(), 3),
+        "tokens_proposed": ss.proposed,
+        "tokens_accepted": ss.accepted,
+        "spec_k": args.spec_k,
+        "verify_trace_counts": spec_eng.trace_counts,
+        "steady_state_retraces": steady_retraces,
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(report, indent=2))
+    ok = True
+    if not report["exact"]:
+        print("FAIL: speculative greedy output differs from baseline", file=sys.stderr)
+        ok = False
+    if steady_retraces:
+        print(f"FAIL: steady-state stream retraced: {steady_retraces}", file=sys.stderr)
+        ok = False
+    if spec_eng.trace_counts.get("verify", 0) != 1:
+        print(
+            f"FAIL: verify traced {spec_eng.trace_counts.get('verify', 0)} times; must be exactly 1",
+            file=sys.stderr,
+        )
+        ok = False
+    if speedup < args.min_speedup:
+        print(
+            f"FAIL: tokens-per-step speedup {speedup:.2f}x < required {args.min_speedup}x",
+            file=sys.stderr,
+        )
+        ok = False
+    return report, ok
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="")
     ap.add_argument("--requests", type=int, default=12)
-    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--max-new", type=int, default=None,
+                    help="tokens per request (default 16; 48 with --speculate)")
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--vocab", type=int, default=128)
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--speculate", action="store_true",
+                    help="benchmark n-gram speculative decoding vs baseline")
+    ap.add_argument("--spec-k", type=int, default=4)
+    ap.add_argument("--min-speedup", type=float, default=1.5)
     args = ap.parse_args()
+    args.max_new_set = args.max_new is not None
+    if args.max_new is None:
+        args.max_new = 16
 
     cfg = TransformerConfig(
         num_layers=args.layers, hidden_size=args.hidden, num_heads=args.heads,
@@ -56,6 +194,21 @@ def main() -> int:
         causal=True,
     )
     params = init_decoder_params(jax.random.key(0), cfg)
+
+    if args.speculate:
+        report, ok = speculate_bench(args, cfg, params)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2)
+        if not ok:
+            return 1
+        print(
+            f"OK: exact speculative decode at {report['tokens_per_step_speedup']}x "
+            f"tokens/step (acceptance {report['acceptance_rate']}, "
+            f"mean accepted {report['mean_accepted_len']})"
+        )
+        return 0
+
     engine = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16)
     sched = ContinuousBatchingScheduler(engine)
 
