@@ -27,6 +27,14 @@ is the TPU-native serving answer for decoder transformers:
   distribution-preserving rejection sampling, with per-request
   adaptive k driven by the scheduler.
 
+* :mod:`recovery` — the self-healing layer: per-request generation
+  journal (exact recompute-replay of any stream after an engine
+  teardown), an engine supervisor (step retry, poisoned-request
+  quarantine via NaN blame vectors + crash bisection, crash-restart
+  budget with exponential backoff), and a step watchdog that detects
+  stalled device steps and trips the circuit breaker so health
+  endpoints stop lying about a hung device.
+
 Serving integration lives in :mod:`flexflow_tpu.serving.generation`
 (`GenerationModel`), wired through the same deadline / backpressure /
 circuit-breaker paths as `InferenceModel`, with per-token streaming over
@@ -35,6 +43,16 @@ HTTP (SSE) and gRPC.
 from .cache import BlockAllocator, CacheConfig, KVCache
 from .decoder import DecoderParams, forward_full, init_decoder_params
 from .engine import GenerationEngine, SamplingParams
+from .recovery import (
+    EngineFailedError,
+    EngineSupervisor,
+    GenerationJournal,
+    PoisonedRequestError,
+    RecoveryPolicy,
+    StalledStepError,
+    StepWatchdog,
+    WatchdogPolicy,
+)
 from .scheduler import (
     ContinuousBatchingScheduler,
     GenerationHandle,
@@ -54,13 +72,21 @@ __all__ = [
     "DecoderParams",
     "Drafter",
     "DraftModelDrafter",
+    "EngineFailedError",
+    "EngineSupervisor",
     "GenerationEngine",
     "GenerationHandle",
+    "GenerationJournal",
     "KVCache",
     "NgramDrafter",
+    "PoisonedRequestError",
+    "RecoveryPolicy",
     "Request",
     "SamplingParams",
     "SpeculationConfig",
+    "StalledStepError",
+    "StepWatchdog",
+    "WatchdogPolicy",
     "forward_full",
     "init_decoder_params",
 ]

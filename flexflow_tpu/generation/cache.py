@@ -133,6 +133,14 @@ class KVCache:
         self.k = k
         self.v = v
 
+    def reset(self) -> None:
+        """Drop all cached K/V (engine crash recovery): every position is
+        rewritten by recompute-replay prefills, and rezeroing also clears
+        any NaN a poisoned batch may have written."""
+        zeros = jnp.zeros(self.k.shape, self.config.dtype.jnp)
+        self.k = zeros
+        self.v = zeros
+
 
 class BlockAllocator:
     """Host-side free list over the cache's blocks. Thread-safe: the
@@ -143,6 +151,14 @@ class BlockAllocator:
         self.config = config
         self._lock = threading.Lock()
         self._free: List[int] = list(range(config.num_blocks - 1, 0, -1))
+
+    def reset(self) -> None:
+        """Restore the full free list (engine crash recovery): every
+        outstanding block table is invalidated wholesale, so per-block
+        frees — which would double-free against the fresh list — must
+        not follow."""
+        with self._lock:
+            self._free = list(range(self.config.num_blocks - 1, 0, -1))
 
     @property
     def num_free(self) -> int:

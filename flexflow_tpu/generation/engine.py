@@ -165,11 +165,35 @@ class GenerationEngine:
         # host-call counters: engine steps actually issued (genbench's
         # tokens-per-engine-step accounting)
         self.step_counts: Dict[str, int] = {"prefill": 0, "decode": 0, "verify": 0}
+        # per-slot finiteness of the last step's logits (the supervisor's
+        # NaN blame vector: a cheap in-jit isfinite reduce, so a poisoned
+        # request is pinned to its slot without extra device calls);
+        # scalar-shaped [1] after prefill_one
+        self.last_finite = np.ones((max_batch_slots,), bool)
+        # crash-recovery restarts (generation/recovery.py supervisor)
+        self.resets = 0
+        # the fault plan's NaN-poison carrier: outside chaos runs inject
+        # returns this very object, so the steady-state decode path pays
+        # one identity check instead of a fresh alloc + device transfer
+        self._zero_bias = np.zeros((max_batch_slots,), np.float32)
+        self._zero_bias_dev = jnp.zeros((max_batch_slots,), jnp.float32)
         self._prefill_jit = jax.jit(self._prefill_impl)
         self._decode_jit = jax.jit(self._decode_impl)
         self._verify_jit = jax.jit(self._verify_impl)
 
     # ------------------------------------------------------------ geometry
+    def reset(self) -> None:
+        """Tear down device-side generation state after a crash or a
+        stalled step: rezero the KV cache and restore the allocator's
+        free list. The compiled program family and trace counters
+        survive (params are unchanged), so recovery costs no
+        recompilation — the scheduler journal-replays every live stream
+        into the fresh cache."""
+        self.cache.reset()
+        self.allocator.reset()
+        self.last_finite = np.ones((self.max_batch_slots,), bool)
+        self.resets += 1
+
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.buckets:
             if prompt_len <= b:
@@ -195,21 +219,27 @@ class GenerationEngine:
         cache_k = jax.vmap(write)(cache_k, ks[:, 0])
         cache_v = jax.vmap(write)(cache_v, vs[:, 0])
         last = logits[0, length - 1]
+        ok = jnp.all(jnp.isfinite(last))  # blame: poisoned prompt
         token = _sample(last[None], temp[None], top_k[None], key[None])[0]
-        return token, cache_k, cache_v
+        return token, ok, cache_k, cache_v
 
     def _decode_impl(
-        self, params, tokens, positions, cache_k, cache_v, block_tables, context_lens, temps, top_ks, keys
+        self, params, tokens, positions, cache_k, cache_v, block_tables, context_lens, temps, top_ks, bias, keys
     ):
         self.trace_counts["decode"] = self.trace_counts.get("decode", 0) + 1
         logits, cache_k, cache_v = decode_step(
             params, tokens, positions, cache_k, cache_v, block_tables,
             context_lens, backend=self.backend,
         )
-        return _sample(logits, temps, top_ks, keys), cache_k, cache_v
+        # bias is the fault plan's per-slot NaN poison (zeros outside
+        # chaos runs); applying it before the finiteness reduce makes the
+        # injected poison indistinguishable from model-produced NaN/inf
+        logits = logits + bias[:, None]
+        ok = jnp.all(jnp.isfinite(logits), axis=-1)
+        return _sample(logits, temps, top_ks, keys), ok, cache_k, cache_v
 
     def _verify_impl(
-        self, params, tokens, start, n_draft, cache_k, cache_v, block_tables, temps, top_ks, keys
+        self, params, tokens, start, n_draft, cache_k, cache_v, block_tables, temps, top_ks, bias, keys
     ):
         """Speculative verification: score a [B, W] window (committed
         token + drafts) in one forward and accept/emit in-jit.
@@ -227,10 +257,18 @@ class GenerationEngine:
             params, tokens, positions, cache_k, cache_v, block_tables,
             backend=self.backend,
         )
+        logits = logits + bias[:, None, None]
+        # blame vector: finiteness over each slot's REAL window positions
+        # only — padded positions (and whole inactive rows) attend to
+        # nothing and may hold garbage that must not indict the request
+        valid = offs <= jnp.maximum(n_draft, 0)[:, None]
+        ok = jnp.all(
+            jnp.where(valid[:, :, None], jnp.isfinite(logits), True), axis=(1, 2)
+        )
         out, n_emitted = speculative_accept(
             logits, tokens[:, 1:], jnp.maximum(n_draft, 0), temps, top_ks, keys
         )
-        return out, jnp.where(n_draft >= 0, n_emitted, 0), cache_k, cache_v
+        return out, jnp.where(n_draft >= 0, n_emitted, 0), ok, cache_k, cache_v
 
     # ----------------------------------------------------------- host API
     def prefill_one(
@@ -251,7 +289,7 @@ class GenerationEngine:
         tokens[0, :n] = prompt
         table = np.zeros((self.max_blocks_per_seq,), np.int32)
         table[: len(block_table)] = block_table
-        token, ck, cv = self._prefill_jit(
+        token, ok, ck, cv = self._prefill_jit(
             self.params,
             jnp.asarray(tokens),
             jnp.int32(n),
@@ -263,6 +301,7 @@ class GenerationEngine:
             key,
         )
         self.cache.update(ck, cv)
+        self.last_finite = np.asarray(ok).reshape(1)
         return int(token)
 
     def decode(
@@ -277,25 +316,42 @@ class GenerationEngine:
     ) -> np.ndarray:
         """One decode step across all ``max_batch_slots`` slots. Arrays
         are slot-indexed; inactive slots (active[i] False) write to
-        scratch and return garbage tokens the scheduler ignores."""
-        faults.inject("generation.decode_step", tokens)
+        scratch and return garbage tokens the scheduler ignores. After
+        the call ``last_finite[i]`` says whether slot i's logits were
+        finite — the supervisor's per-slot NaN blame vector."""
+        masked = np.where(active, tokens, 0).astype(np.int32)
+        masked, bias = faults.inject("generation.decode_step", (masked, self._zero_bias))
         self.step_counts["decode"] += 1
         context_lens = np.where(active, positions + 1, 0).astype(np.int32)
         safe_pos = np.where(active, positions, 0).astype(np.int32)
-        out, ck, cv = self._decode_jit(
+        # scratch-mask inactive slots' tables too: an inactive slot with
+        # a REAL table (a bisection probe deactivating a live slot)
+        # would otherwise write its position-0 K/V into that slot's
+        # first real block and silently corrupt the surviving stream
+        tables = np.where(active[:, None], block_tables, 0).astype(np.int32)
+        out, ok, ck, cv = self._decode_jit(
             self.params,
-            jnp.asarray(np.where(active, tokens, 0).astype(np.int32)),
+            jnp.asarray(masked),
             jnp.asarray(safe_pos),
             self.cache.k,
             self.cache.v,
-            jnp.asarray(block_tables.astype(np.int32)),
+            jnp.asarray(tables),
             jnp.asarray(context_lens),
             jnp.asarray(temps.astype(np.float32)),
             jnp.asarray(top_ks.astype(np.int32)),
+            self._bias_arg(bias),
             keys,
         )
         self.cache.update(ck, cv)
+        self.last_finite = np.asarray(ok)
         return np.asarray(out)
+
+    def _bias_arg(self, bias) -> jax.Array:
+        """Device-side logit bias: the cached zeros unless a fault plan
+        actually poisoned this call."""
+        if bias is self._zero_bias:
+            return self._zero_bias_dev
+        return jnp.asarray(np.asarray(bias, np.float32))
 
     def verify(
         self,
@@ -319,11 +375,12 @@ class GenerationEngine:
         truncated by EOS / budget). ONE fixed-shape jit: per-request
         adaptive k only changes ``n_draft`` values, never the shape.
         """
-        faults.inject("generation.verify", window_tokens)
+        window = window_tokens.astype(np.int32)
+        window, bias = faults.inject("generation.verify", (window, self._zero_bias))
         self.step_counts["verify"] += 1
-        out, n_emitted, ck, cv = self._verify_jit(
+        out, n_emitted, ok, ck, cv = self._verify_jit(
             self.params,
-            jnp.asarray(window_tokens.astype(np.int32)),
+            jnp.asarray(window),
             jnp.asarray(start.astype(np.int32)),
             jnp.asarray(n_draft.astype(np.int32)),
             self.cache.k,
@@ -331,9 +388,11 @@ class GenerationEngine:
             jnp.asarray(block_tables.astype(np.int32)),
             jnp.asarray(temps.astype(np.float32)),
             jnp.asarray(top_ks.astype(np.int32)),
+            self._bias_arg(bias),
             keys,
         )
         self.cache.update(ck, cv)
+        self.last_finite = np.asarray(ok)
         return np.asarray(out), np.asarray(n_emitted)
 
     def generate(

@@ -38,8 +38,23 @@ Resilience mirrors PR 1's serving semantics: bounded queue
 OR during generation), retry-with-backoff for TransientDeviceError,
 and a circuit breaker around device steps — all on an injectable clock
 so chaos tests run on virtual time. Fault sites: ``generation.prefill``,
-``generation.decode_step``, and ``generation.verify``
-(runtime/faults.py).
+``generation.decode_step``, ``generation.verify``, and
+``generation.journal_replay`` (runtime/faults.py).
+
+* **self-healing** (recovery.py): every admitted stream is entered in a
+  :class:`GenerationJournal`; batched device steps run under an
+  :class:`EngineSupervisor` that absorbs one-off crashes (single step
+  retry), quarantines poisoned requests (per-slot NaN blame vector from
+  the jitted steps, or crash bisection with subset probes) so one bad
+  request can no longer fail the whole batch, and recovers engine-level
+  failures by ``engine.reset()`` + journal replay over the
+  preempt-by-recompute path — byte-exact, because sampling keys index
+  by generated-token count. A :class:`StepWatchdog` heartbeat around
+  device calls detects stalled steps, trips the breaker (honest
+  health), and drives the same restart. An exhausted restart budget
+  fails *running* streams with a typed EngineFailedError; queued
+  requests are held behind the breaker, never failed with the engine's
+  internal error.
 
 The scheduler is synchronous-by-design: ``step()`` does one iteration
 and returns, so property tests drive it deterministically; ``start()``
@@ -47,6 +62,7 @@ wraps it in a background thread for serving.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
 import queue
@@ -69,8 +85,17 @@ from ..serving.resilience import (
     RetryPolicy,
     ShuttingDownError,
 )
-from ..serving.stats import ServingStats, SpeculationStats, TokenRate
+from ..serving.stats import RecoveryStats, ServingStats, SpeculationStats, TokenRate
 from .engine import GenerationEngine, SamplingParams
+from .recovery import (
+    EngineFailedError,
+    EngineSupervisor,
+    GenerationJournal,
+    PoisonedRequestError,
+    RecoveryPolicy,
+    StepWatchdog,
+    WatchdogPolicy,
+)
 from .speculative.drafter import SpeculationConfig, build_drafter
 
 _END = object()  # token-stream sentinel
@@ -112,15 +137,30 @@ class GenerationHandle:
         self._tokens.put(token)
 
     def _finish(self, tokens: List[int]) -> None:
-        self._tokens.put(_END)
-        if not self.future.done():
+        # idempotent under races: the watchdog thread may reap a
+        # deadline while the loop thread is deciding the same request's
+        # fate — the loser of the set_result/set_exception race must
+        # not propagate InvalidStateError into (and kill) the loop
+        if self.future.done():
+            return
+        try:
             self.future.set_result(tokens)
+        except Exception:
+            return
+        self._tokens.put(_END)
 
-    def _fail(self, err: BaseException) -> None:
+    def _fail(self, err: BaseException) -> bool:
+        """Returns True only if THIS call failed the handle — losers of
+        the loop/watchdog race must not double-count in stats."""
+        if self.future.done():
+            return False
+        try:
+            self.future.set_exception(err)
+        except Exception:
+            return False
         self._tokens.put(err)
         self._tokens.put(_END)
-        if not self.future.done():
-            self.future.set_exception(err)
+        return True
 
 
 class Request:
@@ -151,6 +191,7 @@ class Request:
         self.generated: List[int] = []  # tokens generated so far (total)
         self.cancelled = False
         self.preemptions = 0
+        self.replays = 0  # journal-replay recoveries this stream rode out
         self.handle = GenerationHandle(self)
         # seed-only (no request-id mixing): the same seed + prompt +
         # params must reproduce the same tokens, run to run (with
@@ -238,6 +279,8 @@ class ContinuousBatchingScheduler:
         idle_wait_s: float = 0.002,
         speculation: Optional[SpeculationConfig] = None,
         draft_params=None,
+        recovery: Optional[RecoveryPolicy] = None,
+        watchdog: Optional[WatchdogPolicy] = None,
     ):
         self.engine = engine
         # scheduler-wide default speculation policy (a request's own
@@ -282,6 +325,20 @@ class ContinuousBatchingScheduler:
         self.spec_stats = SpeculationStats()
         self.spec_stats.register_gauges(self.stats)
         self._dummy_keys = None  # inactive-slot key rows, built once
+        # self-healing (recovery.py): journal + supervisor + watchdog.
+        # _heartbeat is (seq, started_at) while a device call is in
+        # flight — the watchdog's stall signal
+        self.recovery_stats = RecoveryStats()
+        self.recovery_stats.register_gauges(self.stats)
+        self.journal = GenerationJournal()
+        self.supervisor = EngineSupervisor(self, recovery)
+        self.watchdog = StepWatchdog(self, watchdog)
+        self._heartbeat = None
+        self._hb_seq = 0
+        # the request popped for admission but not yet slot-resident:
+        # visible to the watchdog's deadline reaper, which otherwise
+        # could not see it while its prefill is wedged
+        self._admitting: Optional[Request] = None
 
     # ------------------------------------------------------------- submit
     def submit(
@@ -323,7 +380,12 @@ class ContinuousBatchingScheduler:
             if len(self._queue) >= self.max_queue:
                 self.stats.incr("rejected")
                 raise QueueFullError(f"generation queue full ({self.max_queue})")
-            if not self.breaker.allow():
+            # ready(), NOT allow(): submit only enqueues — the device
+            # call happens at admission, so the half-open probe slot
+            # must be claimed by _admit. A submit that claimed it would
+            # leave the probe's outcome forever unrecorded and stall
+            # held requests for another recovery window.
+            if not self.breaker.ready():
                 self.stats.incr("rejected")
                 raise CircuitOpenError("generation circuit open")
             deadline = None if deadline_s is None else self.clock() + deadline_s
@@ -367,6 +429,7 @@ class ContinuousBatchingScheduler:
         self._stopped = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+        self.watchdog.start()
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Graceful by default: finish queued + running requests, then
@@ -388,33 +451,124 @@ class ContinuousBatchingScheduler:
             self._hard_stop = True  # loop exits after the current step
         self._wake.set()
         self._thread.join(timeout=timeout)
+        if self._thread.is_alive() and self._heartbeat is None:
+            # alive but NOT inside a device call: the drain is starved,
+            # not wedged — e.g. an OPEN breaker holding queued requests
+            # it cannot admit. Break the loop and fail the leftovers
+            # typed below instead of leaking threads + hanging clients.
+            self._hard_stop = True
+            self._wake.set()
+            self._thread.join(timeout=5.0)
         wedged = self._thread.is_alive()
         self._thread = None
         if wedged:
             # a wedged step keeps ownership of the slot/allocator state;
-            # touching it here would race the live thread
+            # touching it here would race the live thread. The watchdog
+            # stays alive on purpose: it is the only thing left that can
+            # fail deadline-carrying handles stuck behind the zombie step
             return
         if drain:
             # the loop exited; anything still outstanding completes here
+            # (watchdog still running: a step wedging during THIS drain
+            # is the exact failure class it exists to catch)
             while self.has_work() and self.step():
                 pass
-        else:
-            # abort only AFTER the loop exited: _abort_all mutates
-            # _running/allocator state the stepping thread owns
+        if self.has_work():
+            # leftovers that cannot make progress (held behind an open
+            # breaker, or drain=False): fail them typed, never hang them.
+            # Runs only AFTER the loop exited: _abort_all mutates
+            # _running/allocator state the stepping thread owns.
             self._abort_all(ShuttingDownError("scheduler stopped"))
+        self.watchdog.stop()
         self._draining = False
         self._stopped = True
 
     def _abort_all(self, err: BaseException) -> None:
+        """Shutdown-only teardown (``err`` is always a typed
+        ShuttingDownError). Engine failures never come through here:
+        the supervisor journal-replays running streams and HOLDS queued
+        requests, so a queued-but-never-admitted request can no longer
+        be failed with some other request's engine-internal error."""
         with self._lock:
             queued, self._queue = list(self._queue), deque()
         for req in queued:
-            req.handle._fail(err)
-            self.stats.incr("failed")
+            if req.handle._fail(err):
+                self.stats.incr("failed")
         for state in list(self._running.values()):
             self._release(state)
-            state.req.handle._fail(err)
+            if state.req.handle._fail(err):
+                self.stats.incr("failed")
+
+    def _fail_running_engine_dead(self, err: EngineFailedError) -> None:
+        """Restart budget exhausted: every slot-resident stream is truly
+        lost — fail it with the typed EngineFailedError (never the raw
+        device traceback). The engine was reset, so slot/allocator
+        bookkeeping restarts from empty rather than freeing stale block
+        ids into the fresh free list."""
+        self.journal.drain()
+        states = list(self._running.values())
+        self._reset_slots()
+        self.engine.reset()
+        for state in states:
+            state.blocks = []
+            if state.req.handle._fail(err):
+                self.stats.incr("failed")
+        # replay-requeued MID-STREAM requests (n_generated > 0) are as
+        # lost as the slot-resident ones — their clients already hold
+        # tokens, so holding them for a possible future probe would
+        # hang them instead. Fresh queued requests stay held: they
+        # streamed nothing and remain safe to resubmit or admit later.
+        with self._lock:
+            keep: deque = deque()
+            for req in self._queue:
+                if req.n_generated > 0:
+                    if req.handle._fail(err):
+                        self.stats.incr("failed")
+                else:
+                    keep.append(req)
+            self._queue = keep
+
+    def _rebuild_from_journal(self) -> None:
+        """Journal-replay after an engine teardown: every live stream is
+        requeued at the FRONT (it was admitted before anything waiting)
+        with its generated tokens folded into the prompt — the
+        preempt-by-recompute path then resumes each token stream
+        exactly. Must run after ``engine.reset()``: old block ids must
+        not be freed into the fresh allocator."""
+        entries = self.journal.drain()
+        self._reset_slots()
+        replayed = 0
+        requeue = []
+        for entry in entries:
+            req = entry.req
+            if req.handle.done():  # reaped (deadline) while the engine was down
+                continue
+            req.prompt = req.original_prompt + list(req.generated)
+            req.replays += 1
+            replayed += req.n_generated
+            requeue.append(req)
+        with self._lock:
+            for req in reversed(requeue):
+                self._queue.appendleft(req)
+        if replayed:
+            self.recovery_stats.incr("replayed_tokens", replayed)
+        self._wake.set()
+
+    def _reset_slots(self) -> None:
+        """Post-``engine.reset()`` slot bookkeeping: every slot is empty
+        and every outstanding block table invalid wholesale (the
+        allocator free list was restored, so per-block frees — which
+        would double-free — must never follow this)."""
+        self._running.clear()
+        self._free_slots = list(range(self.engine.max_batch_slots - 1, -1, -1))
+
+    def _quarantine(self, state: _Running, err: BaseException) -> None:
+        """Fail ONE poisoned request and keep the batch: blocks freed,
+        slot returned, everyone else untouched."""
+        self._release(state)
+        if state.req.handle._fail(err):
             self.stats.incr("failed")
+            self.recovery_stats.incr("quarantined")
 
     def ready(self) -> bool:
         return not self._draining and self.breaker.ready()
@@ -430,6 +584,7 @@ class ContinuousBatchingScheduler:
 
     # ---------------------------------------------------------- internals
     def _release(self, state: _Running) -> None:
+        self.journal.discard(state.req)
         self.engine.allocator.free(state.blocks)
         state.blocks = []
         del self._running[state.slot]
@@ -447,35 +602,64 @@ class ContinuousBatchingScheduler:
         with self._lock:
             keep: deque = deque()
             for req in self._queue:
-                if req.cancelled:
-                    req.handle._fail(ShuttingDownError("request cancelled"))
-                    self.stats.incr("cancelled")
+                if req.handle.done():
+                    pass  # reaped by the watchdog during a stall; just drop
+                elif req.cancelled:
+                    if req.handle._fail(ShuttingDownError("request cancelled")):
+                        self.stats.incr("cancelled")
                 elif req.deadline is not None and now >= req.deadline:
-                    req.handle._fail(DeadlineExceededError("deadline expired while queued"))
-                    self.stats.incr("expired")
+                    if req.handle._fail(DeadlineExceededError("deadline expired while queued")):
+                        self.stats.incr("expired")
                 else:
                     keep.append(req)
             self._queue = keep
         for state in list(self._running.values()):
             req = state.req
-            if req.cancelled:
+            if req.handle.done():
+                # failed externally (watchdog deadline reap): resource
+                # cleanup belongs to this thread, the counting happened
+                # where the handle was failed
                 self._release(state)
-                req.handle._fail(ShuttingDownError("request cancelled"))
-                self.stats.incr("cancelled")
+            elif req.cancelled:
+                self._release(state)
+                if req.handle._fail(ShuttingDownError("request cancelled")):
+                    self.stats.incr("cancelled")
             elif req.deadline is not None and now >= req.deadline:
                 self._release(state)
-                req.handle._fail(DeadlineExceededError("deadline expired mid-generation"))
-                self.stats.incr("expired")
+                if req.handle._fail(DeadlineExceededError("deadline expired mid-generation")):
+                    self.stats.incr("expired")
+
+    @contextlib.contextmanager
+    def _stamped(self):
+        """Heartbeat stamp around any section that can wedge on the
+        device — the watchdog's only stall signal."""
+        self._hb_seq += 1
+        self._heartbeat = (self._hb_seq, self.clock())
+        try:
+            yield
+        finally:
+            self._heartbeat = None
 
     def _device(self, fn):
-        """Run one device step under retry + breaker accounting."""
-        try:
-            out = self.retry.run(fn)
-        except Exception:
-            self.breaker.record_failure()
-            raise
+        """Run one device step under retry + breaker accounting, with a
+        heartbeat stamped around the call so the watchdog can see a step
+        that neither returns nor raises."""
+        with self._stamped():
+            try:
+                out = self.retry.run(fn)
+            except Exception:
+                self.breaker.record_failure()
+                raise
         self.breaker.record_success()
         return out
+
+    def _probe_call(self, fn):
+        """Device call for a blame-assignment probe: heartbeat only, no
+        retry/breaker (an expected crash while bisecting is not device
+        health signal — but a STALL during a probe must still be
+        visible to the watchdog)."""
+        with self._stamped():
+            return fn()
 
     def _preempt_youngest(self, exclude: Optional[_Running] = None) -> bool:
         """Evict the most recently admitted running sequence (vLLM's
@@ -500,6 +684,13 @@ class ContinuousBatchingScheduler:
         with self._lock:
             if not self._queue or not self._free_slots:
                 return False
+            # an OPEN breaker holds admission: queued requests wait out a
+            # device outage (expiring at their own deadlines) instead of
+            # being burned one per step against a dead engine; after
+            # recovery_s the next admission is the half-open probe whose
+            # success resumes service
+            if not self.breaker.allow():
+                return False
             req = self._queue[0]
             need = self.engine.cache_config.blocks_for(len(req.prompt) + 1)
             blocks = self.engine.allocator.allocate(need)
@@ -507,6 +698,7 @@ class ContinuousBatchingScheduler:
                 return False
             self._queue.popleft()
             slot = self._free_slots.pop()
+        self._admitting = req
         try:
             token = self._device(
                 lambda: self.engine.prefill_one(
@@ -514,13 +706,54 @@ class ContinuousBatchingScheduler:
                 )
             )
         except Exception as e:
+            self._admitting = None
             self.engine.allocator.free(blocks)
             self._free_slots.append(slot)
-            req.handle._fail(e)
-            self.stats.incr("failed")
+            if self.supervisor.failed:
+                # half-open probe against a still-dead engine: a HELD
+                # request must not eat the raw device error for probing.
+                # Back to the front; the probe's recorded failure just
+                # re-opened the breaker, so admission waits out another
+                # recovery window before the next attempt.
+                with self._lock:
+                    self._queue.appendleft(req)
+                return False
+            if req.n_generated > 0:
+                # a replayed/preempted stream whose consumer already
+                # holds tokens: a raw prefill error must not cut it off
+                # mid-stream. Requeue it and treat the failure as
+                # engine-level — budgeted restart + backoff (give-up
+                # fails running streams typed and holds the queue).
+                with self._lock:
+                    self._queue.appendleft(req)
+                self.supervisor._restart_and_replay(e, "prefill")
+                return True
+            if req.handle._fail(e):
+                self.stats.incr("failed")
             return True  # did work (and must not spin on the same head)
+        self._admitting = None
+        if not bool(self.engine.last_finite[0]):
+            # poisoned prompt: the prefill's logits went non-finite, and
+            # a single-sequence step needs no bisection to assign blame
+            self.engine.allocator.free(blocks)
+            self._free_slots.append(slot)
+            if req.handle._fail(
+                PoisonedRequestError(
+                    f"request {req.id} produced non-finite logits at prefill",
+                    request_id=req.id, step="prefill", reason="nan_logits",
+                )
+            ):
+                self.stats.incr("failed")
+                self.recovery_stats.incr("quarantined")
+            return True
         state = _Running(req, slot, blocks, cached_len=len(req.prompt), admitted_seq=next(self._admitted_seq))
         self._running[slot] = state
+        if self.supervisor.failed:  # a dead engine just served a prefill
+            self.supervisor.note_engine_recovered()
+        self.journal.record(req, state.admitted_seq)
+        if req.handle.done():  # watchdog reaped it while the prefill ran
+            self._release(state)
+            return True
         self._emit_token(state, token)
         self.token_rate.record(1)
         if req.finished():
@@ -606,6 +839,31 @@ class ContinuousBatchingScheduler:
             top_ks[i] = req.sampling.top_k
         return last, start, tables, active, temps, top_ks
 
+    def _quarantine_nan(self, kind: str, order) -> bool:
+        """Act on the engine's per-slot NaN blame vector after a step
+        that returned normally. Partial blame pins the poison on the
+        flagged request(s): quarantine them, keep everyone else (their
+        tokens from this step are valid and the step is about to scatter
+        them). Whole-batch blame is not data-dependent — restart and
+        journal-replay instead (returns True: skip the scatter)."""
+        ok = self.engine.last_finite
+        live = [s for s in order if self._running.get(s.slot) is s]
+        blamed = [s for s in live if not bool(ok[s.slot])]
+        if not blamed:
+            return False
+        if len(blamed) == len(live) and len(live) > 1:
+            self.supervisor.handle_engine_nan(kind)
+            return True
+        for state in blamed:
+            self._quarantine(
+                state,
+                PoisonedRequestError(
+                    f"request {state.req.id} produced non-finite logits at {kind} step",
+                    request_id=state.req.id, step=kind, reason="nan_logits",
+                ),
+            )
+        return False
+
     def _decode_once(self) -> bool:
         if not self._running:
             return False
@@ -615,25 +873,35 @@ class ContinuousBatchingScheduler:
         key_by_slot = {s.slot: s.req.sample_key() for s in order}
         dummy = jax.random.key(0)
         keys = jnp.stack([key_by_slot.get(i, dummy) for i in range(b)])
-        try:
-            out = self._device(
+
+        def step():
+            return self.engine.decode(
+                tokens, positions, tables, active, temps, top_ks, keys
+            )
+
+        def probe(subset):
+            # blame-assignment probe: same step with only ``subset``
+            # active; outputs discarded, cache writes idempotent
+            act = np.zeros((b,), bool)
+            for s in subset:
+                act[s.slot] = True
+            self._probe_call(
                 lambda: self.engine.decode(
-                    tokens, positions, tables, active, temps, top_ks, keys
+                    tokens, positions, tables, act, temps, top_ks, keys
                 )
             )
-        except Exception as e:
-            # a decode failure is batch-wide: fail every running request
-            # (leaf attribution like the batcher's bisection needs
-            # per-sequence device calls, which defeats batching here)
-            for state in list(self._running.values()):
-                self._release(state)
-                state.req.handle._fail(e)
-                self.stats.incr("failed")
+
+        out = self.supervisor.run_step("decode", step, order, probe)
+        if out is None:
+            return True  # failure handled: quarantined or journal-replayed
+        if self._quarantine_nan("decode", order):
             return True
         n_live = 0
         for state in order:
             if self._running.get(state.slot) is not state:
                 continue  # preempted/expired between collect and scatter
+            if state.req.handle.done():
+                continue  # watchdog-reaped mid-step; _expire releases it
             state.cached_len += 1
             self._emit_token(state, int(out[state.slot]))
             n_live += 1
@@ -694,23 +962,34 @@ class ContinuousBatchingScheduler:
         if self._dummy_keys is None:
             self._dummy_keys = jnp.stack([jax.random.key(0)] * w)
         keys = jnp.stack([keys_by_slot.get(i, self._dummy_keys) for i in range(b)])
-        try:
-            out, n_emitted = self._device(
+
+        def step():
+            return self.engine.verify(
+                window, start, n_draft, tables, temps, top_ks, keys
+            )
+
+        def probe(subset):
+            nd = np.full((b,), -1, np.int32)  # everyone else inactive
+            for s in subset:
+                nd[s.slot] = n_draft[s.slot]
+            self._probe_call(
                 lambda: self.engine.verify(
-                    window, start, n_draft, tables, temps, top_ks, keys
+                    window, start, nd, tables, temps, top_ks, keys
                 )
             )
-        except Exception as e:
-            # batch-wide failure, exactly like _decode_once
-            for state in list(self._running.values()):
-                self._release(state)
-                state.req.handle._fail(e)
-                self.stats.incr("failed")
+
+        result = self.supervisor.run_step("verify", step, order, probe)
+        if result is None:
+            return True  # failure handled: quarantined or journal-replayed
+        out, n_emitted = result
+        if self._quarantine_nan("verify", order):
             return True
         n_live_tokens = 0
         for state in order:
             if self._running.get(state.slot) is not state:
                 continue  # preempted/expired between collect and scatter
+            if state.req.handle.done():
+                continue  # watchdog-reaped mid-step; _expire releases it
             req = state.req
             i = state.slot
             m = int(n_emitted[i])

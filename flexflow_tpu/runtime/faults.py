@@ -29,9 +29,17 @@ Injection sites currently threaded through the codebase:
   ``serving.repository.load``   before a repository model load
   ``checkpoint.save``           top of save_checkpoint
   ``generation.prefill``        before a generation prefill (value = prompt tokens)
-  ``generation.decode_step``    before each batched decode step (value = slot tokens)
+  ``generation.decode_step``    before each batched decode step (value =
+                                ([B] slot tokens, [B] float32 logit bias); ``nan``
+                                mode poisons the bias, which the engine adds to
+                                the step's logits — per slot with ``select``)
   ``generation.verify``         before each speculative verification step
-                                (value = [B, k+1] window tokens)
+                                (value = ([B, k+1] window tokens, [B] float32
+                                logit bias), same nan-mode contract as decode)
+  ``generation.journal_replay`` top of each supervisor journal-replay engine
+                                restart (value = journal entries); an error here
+                                is a double fault consuming another restart
+                                budget unit (generation/recovery.py)
 
 Usage::
 
@@ -81,20 +89,32 @@ def active_plan() -> Optional["FaultPlan"]:
     return _PLAN
 
 
-def _poison(value: Any) -> Any:
+def _poison(value: Any, mask: Any = None) -> Any:
     """NaN-poison array-like leaves of ``value`` (lists/tuples of arrays,
-    single arrays, dicts); non-float leaves pass through unchanged."""
+    single arrays, dicts); non-float leaves pass through unchanged.
+    ``mask`` (a bool array broadcastable against each float leaf, from a
+    rule's ``select``) restricts the poison to the selected entries —
+    how chaos tests poison ONE batch slot data-dependently instead of
+    the whole step."""
     if isinstance(value, (list, tuple)):
-        return type(value)(_poison(v) for v in value)
+        return type(value)(_poison(v, mask) for v in value)
     if isinstance(value, dict):
-        return {k: _poison(v) for k, v in value.items()}
+        return {k: _poison(v, mask) for k, v in value.items()}
     try:
         arr = np.asarray(value)
     except Exception:
         return value
     if arr.dtype.kind != "f":
         return value
-    return np.full_like(arr, np.nan)
+    if mask is None:
+        return np.full_like(arr, np.nan)
+    m = np.asarray(mask, bool)
+    # a select over higher-rank site data (e.g. a [B, W] verify-window
+    # mask against the [B] bias leaf) collapses trailing dims: any hit
+    # in a row poisons that row's slot
+    while m.ndim > arr.ndim:
+        m = m.any(axis=-1)
+    return np.where(m, np.full_like(arr, np.nan), arr)
 
 
 @dataclasses.dataclass(eq=False)  # identity equality: two identically
@@ -112,6 +132,7 @@ class FaultRule:
     every: Optional[int] = None  # fire on every k-th call (1-based)
     probability: Optional[float] = None  # seeded coin flip
     when: Optional[Callable[[Any], bool]] = None  # predicate on value
+    select: Optional[Callable[[Any], Any]] = None  # nan mode: per-entry mask
     max_fires: Optional[int] = None
     fires: int = 0
 
@@ -143,16 +164,19 @@ class FaultPlan:
         every: Optional[int] = None,
         probability: Optional[float] = None,
         when: Optional[Callable[[Any], bool]] = None,
+        select: Optional[Callable[[Any], Any]] = None,
         max_fires: Optional[int] = None,
     ) -> "FaultPlan":
         if mode not in ("error", "latency", "nan", "stall"):
             raise ValueError(f"unknown fault mode {mode!r}")
         if mode == "stall" and gate is None:
             raise ValueError("stall mode requires a gate Event")
+        if select is not None and mode != "nan":
+            raise ValueError("select only applies to nan mode")
         rule = FaultRule(
             site=site, mode=mode, error=error, latency_s=latency_s, gate=gate,
             nth=tuple(nth) if nth is not None else None, every=every,
-            probability=probability, when=when, max_fires=max_fires,
+            probability=probability, when=when, select=select, max_fires=max_fires,
         )
         self._rules.setdefault(site, []).append(rule)
         return self
@@ -237,5 +261,5 @@ class FaultPlan:
             elif r.mode == "stall":
                 r.gate.wait(timeout=30.0)  # bounded: a leaked gate must not hang tests
             elif r.mode == "nan":
-                value = _poison(value)
+                value = _poison(value, r.select(value) if r.select else None)
         return value

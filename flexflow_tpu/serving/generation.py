@@ -11,6 +11,17 @@ the gRPC front end on ``ModelStreamInfer``; both reuse PR 1's status
 mapping (backpressure 503/RESOURCE_EXHAUSTED, expired deadline
 504/DEADLINE_EXCEEDED, open breaker 503/UNAVAILABLE) because the
 scheduler raises the same typed ResilienceErrors as the batcher.
+
+The scheduler it owns is self-healing (generation/recovery.py):
+engine-loop crashes are journal-replayed, poisoned requests are
+quarantined alone, and a stalled device step trips the breaker via the
+step watchdog — so ``ready()`` (and therefore ``/v2/health/ready``,
+``/v2/models/{name}/ready`` and gRPC ModelReady) reflects a hung or
+dead engine instead of lying. Recovery counters (``recoveries``,
+``replayed_tokens``, ``quarantined``, ``watchdog_trips``, ...) ride the
+model's stats block on ``GET /v2/stats``. Pass ``recovery=`` /
+``watchdog=`` (RecoveryPolicy / WatchdogPolicy) through
+``scheduler_kwargs`` to tune restart budgets and stall timeouts.
 """
 from __future__ import annotations
 
@@ -51,6 +62,10 @@ class GenerationModel:
     @property
     def stats(self):
         return self.scheduler.stats
+
+    @property
+    def recovery_stats(self):
+        return self.scheduler.recovery_stats
 
     # --------------------------------------------------------------- run
     def submit(
@@ -113,9 +128,18 @@ class GenerationModel:
     def metadata(self) -> Dict:
         cfg = self.engine.cfg
         cc = self.engine.cache_config
+        sup = self.scheduler.supervisor
+        wd = self.scheduler.watchdog
         return {
             "name": self.name,
             "platform": "flexflow_tpu_generation",
+            "recovery": {
+                "max_restarts": sup.policy.max_restarts,
+                "budget_window_s": sup.policy.budget_window_s,
+                "watchdog_enabled": wd.policy.enabled,
+                "stall_timeout_s": wd.policy.stall_timeout_s,
+                "engine_resets": self.engine.resets,
+            },
             "max_batch_slots": self.engine.max_batch_slots,
             "max_spec_tokens": self.engine.max_spec_tokens,
             "max_seq_len": self.engine.max_seq_len,

@@ -212,3 +212,15 @@ class CircuitBreaker:
                 self._opened_at = self.clock()
                 self._failures = 0
                 self._probing = False
+
+    def trip(self) -> None:
+        """Force the breaker OPEN immediately, bypassing the consecutive-
+        failure count. The generation step watchdog calls this when a
+        device step stalls: no request completes (so nothing calls
+        record_failure), but health endpoints must stop reporting a hung
+        device as ready."""
+        with self._lock:
+            self._state = self.OPEN
+            self._opened_at = self.clock()
+            self._failures = 0
+            self._probing = False

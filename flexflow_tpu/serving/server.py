@@ -12,7 +12,10 @@ API. This server implements the same surface directly (stdlib only):
                                               (queue depth, admission
                                               counters, latency,
                                               generation tokens/s +
-                                              cache occupancy)
+                                              cache occupancy, and the
+                                              self-healing counters:
+                                              recoveries, replayed_tokens,
+                                              quarantined, watchdog_trips)
   GET  /v2/models/{name}                   -> model metadata
   GET  /v2/models/{name}/ready             -> per-model readiness
   POST /v2/models/{name}/infer             -> run inference

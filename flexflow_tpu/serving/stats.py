@@ -141,6 +141,47 @@ class SpeculationStats:
         stats.add_gauge(prefix + "mean_emitted_len", self.mean_emitted_len)
 
 
+class RecoveryStats:
+    """Self-healing counters for one generation engine (supervisor +
+    step watchdog, generation/recovery.py), surfaced as /v2/stats
+    gauges:
+
+      recoveries       completed engine restart + journal-replay cycles
+      step_retries     failed device steps absorbed by the supervisor's
+                       single step retry (no restart needed)
+      replayed_tokens  generated tokens folded back into prompts for
+                       recompute-replay across all recoveries
+      quarantined      poisoned requests failed alone (NaN blame or
+                       crash bisection) while the rest of the batch
+                       kept going
+      watchdog_trips   stalled device steps detected by the watchdog
+      engine_failures  restart budgets exhausted (engine declared dead)
+
+    Writers: the scheduler loop thread and the watchdog thread; the
+    lock keeps increments exact so chaoscheck can assert counts.
+    """
+
+    FIELDS = (
+        "recoveries", "step_retries", "replayed_tokens",
+        "quarantined", "watchdog_trips", "engine_failures",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+
+    def incr(self, field: str, n: int = 1) -> None:
+        if field not in self.FIELDS:
+            raise ValueError(f"unknown recovery counter {field!r}")
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def register_gauges(self, stats: "ServingStats") -> None:
+        for f in self.FIELDS:
+            stats.add_gauge(f, lambda f=f: getattr(self, f))
+
+
 class TokenRate:
     """Windowed tokens/s gauge for the generation engine: record token
     batches as they are emitted; ``rate()`` is tokens over the trailing

@@ -1,0 +1,482 @@
+"""Self-healing generation serving tests (ISSUE 4): journal-replay
+recovery exactness, poisoned-request quarantine, step-watchdog stall
+handling, and restart-budget semantics.
+
+The core property under test is **recovery exactness**: a stream
+interrupted by an injected engine failure must produce byte-identical
+tokens to an uninterrupted run — greedy, seeded-temperature, and
+speculative, including across cache-block boundaries. Everything runs
+on virtual clocks with no-op backoff sleeps; the one stall test drives
+the watchdog with manual ``check()`` calls while a worker thread is
+wedged on the injected gate.
+"""
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from flexflow_tpu.generation import (
+    CacheConfig,
+    ContinuousBatchingScheduler,
+    EngineFailedError,
+    GenerationEngine,
+    PoisonedRequestError,
+    RecoveryPolicy,
+    SamplingParams,
+    SpeculationConfig,
+    WatchdogPolicy,
+    init_decoder_params,
+)
+from flexflow_tpu.models.transformer import TransformerConfig
+from flexflow_tpu.runtime import faults
+from flexflow_tpu.runtime.faults import FaultPlan
+from flexflow_tpu.serving.resilience import (
+    CircuitBreaker,
+    DeadlineExceededError,
+    ShuttingDownError,
+)
+
+pytestmark = pytest.mark.recovery
+
+CFG = TransformerConfig(
+    num_layers=2, hidden_size=32, num_heads=4, ff_size=64,
+    seq_length=64, vocab_size=50, causal=True,
+)
+BUCKETS = (8, 16, 32, 64)
+BLOCK = 8
+NO_SLEEP = RecoveryPolicy(sleep=lambda _s: None)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def decoder_params():
+    return init_decoder_params(jax.random.key(0), CFG)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    assert faults.active_plan() is None, "a test leaked an installed FaultPlan"
+
+
+def make_engine(decoder_params, slots=3, spec=4):
+    return GenerationEngine(
+        decoder_params, CFG, max_batch_slots=slots, block_size=BLOCK,
+        prompt_buckets=BUCKETS, max_spec_tokens=spec,
+    )
+
+
+def drive(sched, handles, steps=500):
+    for _ in range(steps):
+        if all(h.done() for h in handles):
+            return
+        if not sched.step():
+            return
+
+
+def run_batch(engine, prompts, samplings, *, plan=None, speculation=None, **kw):
+    kw.setdefault("recovery", NO_SLEEP)
+    kw.setdefault("clock", FakeClock())
+    sched = ContinuousBatchingScheduler(engine, **kw)
+    ctx = plan.active() if plan is not None else None
+    if ctx:
+        ctx.__enter__()
+    try:
+        handles = [
+            sched.submit(p, s, speculation=speculation)
+            for p, s in zip(prompts, samplings)
+        ]
+        drive(sched, handles)
+    finally:
+        if ctx:
+            ctx.__exit__(None, None, None)
+    return handles, sched
+
+
+def unique_token(streams, idx):
+    """A token in streams[idx][:-1] appearing in no other stream — feeds
+    a later decode step of exactly that request, so a data-dependent
+    fault keyed on it hits one slot regardless of slot assignment."""
+    others = {t for j, s in enumerate(streams) if j != idx for t in s[:-1]}
+    uniq = [t for t in streams[idx][:-1] if t not in others]
+    assert uniq, "test setup: no stream-unique token to poison"
+    return uniq[0]
+
+
+PROMPTS = [[1, 2, 3], [4, 5, 6, 7], [9, 8, 7, 6, 5]]
+
+
+# ---------------------------------------------------------------------------
+# journal-replay recovery exactness
+# ---------------------------------------------------------------------------
+
+
+def test_crash_replay_greedy_exact(decoder_params):
+    """A mid-stream engine crash (hard error surviving the supervisor's
+    single step retry) restarts the engine and journal-replays every
+    stream byte-identically — across a block boundary (12 > BLOCK)."""
+    samp = [SamplingParams(max_new_tokens=12)] * 3
+    ref = [
+        h.result(0)
+        for h in run_batch(make_engine(decoder_params), PROMPTS, samp)[0]
+    ]
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=RuntimeError("device crash"), nth=(3, 4))
+    eng = make_engine(decoder_params)
+    handles, sched = run_batch(eng, PROMPTS, samp, plan=plan)
+    assert [h.result(0) for h in handles] == ref
+    assert sched.recovery_stats.recoveries == 1
+    assert sched.recovery_stats.replayed_tokens > 0
+    assert all(h._request.replays == 1 for h in handles)
+    assert eng.resets == 1
+    assert eng.allocator.num_free == eng.allocator.num_total
+    assert len(sched.journal) == 0
+
+
+def test_crash_replay_seeded_temperature_exact(decoder_params):
+    """Sampling keys index by generated-token count, so a replayed
+    seeded-temperature stream continues its exact sampling stream."""
+    samp = [
+        SamplingParams(max_new_tokens=10, temperature=0.8, top_k=10, seed=42),
+        SamplingParams(max_new_tokens=10, temperature=0.7, top_k=8, seed=7),
+    ]
+    prompts = PROMPTS[:2]
+    ref = [
+        h.result(0)
+        for h in run_batch(make_engine(decoder_params), prompts, samp)[0]
+    ]
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=RuntimeError("device crash"), nth=(4, 5))
+    handles, sched = run_batch(make_engine(decoder_params), prompts, samp, plan=plan)
+    assert [h.result(0) for h in handles] == ref
+    assert sched.recovery_stats.recoveries == 1
+
+
+def test_crash_replay_speculative_exact(decoder_params):
+    """Speculative (greedy) streams replay exactly too: the drafter is a
+    pure function of the prefix and verification is exact, so replay
+    needs no drafter checkpoint. Crash hits the verify step."""
+    prompts = [[1, 2, 3, 1, 2, 3], [5, 6, 5, 6, 5, 6, 5]]
+    samp = [SamplingParams(max_new_tokens=12)] * 2
+    spec = SpeculationConfig(k=3, method="ngram")
+    ref = [
+        h.result(0)
+        for h in run_batch(
+            make_engine(decoder_params), prompts, samp, speculation=spec
+        )[0]
+    ]
+    plan = FaultPlan(seed=0)
+    plan.on("generation.verify", mode="error",
+            error=RuntimeError("device crash"), nth=(2, 3))
+    handles, sched = run_batch(
+        make_engine(decoder_params), prompts, samp, plan=plan, speculation=spec
+    )
+    assert [h.result(0) for h in handles] == ref
+    assert sched.recovery_stats.recoveries == 1
+
+
+def test_supervisor_absorbs_single_crash(decoder_params):
+    """One hard step failure is retried by the supervisor and stays
+    invisible: no restart, no replay, exact output."""
+    samp = [SamplingParams(max_new_tokens=8)] * 3
+    ref = [
+        h.result(0)
+        for h in run_batch(make_engine(decoder_params), PROMPTS, samp)[0]
+    ]
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=RuntimeError("one-off crash"), nth=(2,))
+    eng = make_engine(decoder_params)
+    handles, sched = run_batch(eng, PROMPTS, samp, plan=plan)
+    assert [h.result(0) for h in handles] == ref
+    assert sched.recovery_stats.step_retries == 1
+    assert sched.recovery_stats.recoveries == 0
+    assert eng.resets == 0
+
+
+def test_double_fault_during_replay_consumes_budget(decoder_params):
+    """A crash whose first journal replay ALSO crashes (the
+    generation.journal_replay site) burns a second restart budget unit,
+    then recovers exactly."""
+    samp = [SamplingParams(max_new_tokens=10)] * 3
+    ref = [
+        h.result(0)
+        for h in run_batch(make_engine(decoder_params), PROMPTS, samp)[0]
+    ]
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=RuntimeError("device crash"), nth=(3, 4))
+    plan.on("generation.journal_replay", mode="error",
+            error=RuntimeError("crash during replay"), nth=(0,))
+    handles, sched = run_batch(make_engine(decoder_params), PROMPTS, samp, plan=plan)
+    assert plan.fired("generation.journal_replay") == 1
+    assert [h.result(0) for h in handles] == ref
+    assert sched.recovery_stats.recoveries == 1  # one COMPLETED recovery
+    assert len(sched.supervisor._restart_times) == 2  # but two budget units
+
+
+# ---------------------------------------------------------------------------
+# poisoned-request quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_nan_quarantine_blames_one_slot(decoder_params):
+    """Data-dependent NaN logits: the in-jit blame vector pins the
+    poisoned request, which fails alone with a structured error while
+    survivors complete byte-identically — no engine restart."""
+    samp = [SamplingParams(max_new_tokens=10)] * 3
+    ref = [
+        h.result(0)
+        for h in run_batch(make_engine(decoder_params), PROMPTS, samp)[0]
+    ]
+    tok = unique_token(ref, 1)
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="nan",
+            when=lambda v: bool((np.asarray(v[0]) == tok).any()),
+            select=lambda v: np.asarray(v[0]) == tok)
+    eng = make_engine(decoder_params)
+    handles, sched = run_batch(eng, PROMPTS, samp, plan=plan)
+    with pytest.raises(PoisonedRequestError) as exc:
+        handles[1].result(0)
+    assert exc.value.reason == "nan_logits" and exc.value.step == "decode"
+    assert handles[0].result(0) == ref[0]
+    assert handles[2].result(0) == ref[2]
+    assert sched.recovery_stats.quarantined == 1
+    assert sched.recovery_stats.recoveries == 0
+    assert eng.resets == 0
+    assert eng.allocator.num_free == eng.allocator.num_total
+
+
+def test_nan_engine_wide_restarts_instead_of_quarantine(decoder_params):
+    """Whole-batch NaN is not data-dependent: nobody is quarantined; the
+    engine restarts (clearing any NaN the cache absorbed) and every
+    stream replays exactly."""
+    samp = [SamplingParams(max_new_tokens=10)] * 3
+    ref = [
+        h.result(0)
+        for h in run_batch(make_engine(decoder_params), PROMPTS, samp)[0]
+    ]
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="nan", nth=(2,))
+    eng = make_engine(decoder_params)
+    handles, sched = run_batch(eng, PROMPTS, samp, plan=plan)
+    assert [h.result(0) for h in handles] == ref
+    assert sched.recovery_stats.quarantined == 0
+    assert sched.recovery_stats.recoveries == 1
+    assert eng.resets == 1
+
+
+def test_nan_quarantine_on_verify_window(decoder_params):
+    """Same blame contract on the speculative path: a [B, W] window
+    select (collapsed per-slot by the fault layer) poisons one
+    speculating request's verify logits; it is quarantined alone and
+    the surviving stream matches the fault-free run."""
+    prompts = [[1, 2, 3, 1, 2, 3], [5, 6, 5, 6, 5, 6, 5]]
+    samp = [SamplingParams(max_new_tokens=10)] * 2
+    spec = SpeculationConfig(k=3, method="ngram")
+    ref = [
+        h.result(0)
+        for h in run_batch(
+            make_engine(decoder_params), prompts, samp, speculation=spec
+        )[0]
+    ]
+    # n-gram drafts echo the stream's WHOLE prefix, so the poison token
+    # must be absent from every prompt too, not just the other stream
+    excluded = set(ref[1]) | {t for p in prompts for t in p}
+    uniq = [t for t in ref[0][:-1] if t not in excluded]
+    assert uniq, "test setup: no window-unique token to poison"
+    tok = uniq[0]
+    plan = FaultPlan(seed=0)
+    plan.on("generation.verify", mode="nan",
+            when=lambda v: bool((np.asarray(v[0]) == tok).any()),
+            select=lambda v: np.asarray(v[0]) == tok)  # [B, W] mask
+    eng = make_engine(decoder_params)
+    handles, sched = run_batch(eng, prompts, samp, plan=plan, speculation=spec)
+    with pytest.raises(PoisonedRequestError) as exc:
+        handles[0].result(0)
+    assert exc.value.step == "verify" and exc.value.reason == "nan_logits"
+    assert handles[1].result(0) == ref[1]
+    assert sched.recovery_stats.quarantined == 1
+    assert eng.allocator.num_free == eng.allocator.num_total
+
+
+def test_crash_bisection_quarantines_poisoned_request(decoder_params):
+    """A reproducible crash keyed on one request's data: batch bisection
+    probes isolate it; it fails alone with the original error and the
+    survivors keep generating to byte-identical completion. The poison
+    sits in the MIDDLE stream on purpose: both survivors get deactivated
+    in some probe subset, so a probe that wrote into a deactivated live
+    slot's real blocks (instead of scratch) would corrupt their history
+    and break the byte-identical assertions below."""
+    samp = [SamplingParams(max_new_tokens=10)] * 3
+    ref = [
+        h.result(0)
+        for h in run_batch(make_engine(decoder_params), PROMPTS, samp)[0]
+    ]
+    tok = unique_token(ref, 1)
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=RuntimeError("poisoned-input crash"),
+            when=lambda v: bool((np.asarray(v[0]) == tok).any()))
+    eng = make_engine(decoder_params)
+    handles, sched = run_batch(eng, PROMPTS, samp, plan=plan)
+    with pytest.raises(RuntimeError, match="poisoned-input crash"):
+        handles[1].result(0)
+    assert handles[0].result(0) == ref[0]
+    assert handles[2].result(0) == ref[2]
+    assert sched.recovery_stats.quarantined == 1
+    assert eng.resets == 0
+    assert eng.allocator.num_free == eng.allocator.num_total
+
+
+# ---------------------------------------------------------------------------
+# step watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_trips_reaps_deadlines_and_replays(decoder_params):
+    """A stalled decode step: the watchdog trips the breaker (health
+    goes not-ready), fails a deadline-expired queued request while the
+    loop thread is wedged, and once the device unwedges the stale result
+    is discarded in favor of an exact journal replay."""
+    eng = make_engine(decoder_params, slots=2)
+    solo = make_engine(decoder_params, slots=2)
+    samp = SamplingParams(max_new_tokens=10)
+    ref = [
+        h.result(0)
+        for h in run_batch(solo, PROMPTS[:2], [samp] * 2)[0]
+    ]
+    clock = FakeClock()
+    sched = ContinuousBatchingScheduler(
+        eng, clock=clock, recovery=NO_SLEEP,
+        watchdog=WatchdogPolicy(stall_timeout_s=5.0, poll_s=0.01),
+    )
+    gate = threading.Event()
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="stall", gate=gate, nth=(2,))
+    with plan.active():
+        h1 = sched.submit(PROMPTS[0], samp)
+        h2 = sched.submit(PROMPTS[1], samp)
+        h3 = sched.submit(PROMPTS[2], samp, deadline_s=3.0)  # queued: 2 slots
+
+        def work():
+            for _ in range(200):
+                if h1.done() and h2.done() and h3.done():
+                    return
+                if not sched.step():
+                    return
+
+        worker = threading.Thread(target=work, daemon=True)
+        worker.start()
+        # wait (real time) until the worker is wedged inside the gated call
+        t0 = time.monotonic()
+        while plan.calls("generation.decode_step") < 3 or sched._heartbeat is None:
+            assert time.monotonic() - t0 < 30, "worker never reached the stall"
+            time.sleep(0.001)
+        clock.advance(6.0)  # past h3's deadline AND the stall timeout
+        assert sched.watchdog.check() is True
+        assert sched.recovery_stats.watchdog_trips == 1
+        assert not sched.ready()  # breaker OPEN: health reflects the hang
+        with pytest.raises(DeadlineExceededError):
+            h3.result(0)  # reaped mid-stall, not after
+        assert sched.watchdog.check() is False  # one trip per step
+        gate.set()
+        worker.join(timeout=30)
+        assert not worker.is_alive()
+    assert h1.result(0) == ref[0]
+    assert h2.result(0) == ref[1]
+    assert sched.recovery_stats.recoveries == 1  # stale result discarded
+    assert sched.ready()  # successful recovery closed the breaker
+    assert eng.allocator.num_free == eng.allocator.num_total
+
+
+# ---------------------------------------------------------------------------
+# restart budget + typed terminal failures
+# ---------------------------------------------------------------------------
+
+
+def test_budget_exhaustion_typed_failure_holds_queue_then_recovers(decoder_params):
+    """A persistently failing engine exhausts its restart budget:
+    running streams fail with the typed EngineFailedError (never the raw
+    device traceback), the breaker opens, and the queued-but-never-
+    admitted request is HELD — after the fault clears and the breaker's
+    recovery window elapses, the half-open probe admits it and it
+    completes normally."""
+    solo = make_engine(decoder_params, slots=2)
+    samp = SamplingParams(max_new_tokens=6)
+    ref3 = run_batch(solo, [PROMPTS[2]], [samp])[0][0].result(0)
+
+    eng = make_engine(decoder_params, slots=2)
+    clock = FakeClock()
+    breaker = CircuitBreaker(failure_threshold=5, recovery_s=30.0, clock=clock)
+    sched = ContinuousBatchingScheduler(
+        eng, clock=clock, breaker=breaker,
+        recovery=RecoveryPolicy(max_restarts=2, sleep=lambda _s: None),
+    )
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=RuntimeError("device is gone"), every=1)
+    with plan.active():
+        h1 = sched.submit(PROMPTS[0], samp)
+        h2 = sched.submit(PROMPTS[1], samp)
+        h3 = sched.submit(PROMPTS[2], samp)  # queued behind 2 slots
+        drive(sched, [h1, h2])
+    for h in (h1, h2):
+        with pytest.raises(EngineFailedError):
+            h.result(0)
+    assert sched.recovery_stats.engine_failures == 1
+    assert sched.recovery_stats.recoveries == 2  # budget of 2, both burned
+    assert not sched.ready()  # breaker OPEN
+    assert not h3.done()  # held, NOT failed with the engine's error
+    # fault cleared + recovery window elapsed: the half-open probe
+    # admission brings the queued request through untouched
+    clock.advance(31.0)
+    drive(sched, [h3])
+    assert h3.result(0) == ref3
+    assert breaker.state == CircuitBreaker.CLOSED
+
+
+def test_stop_fails_queued_with_typed_error(decoder_params):
+    """Shutdown keeps the typed-error contract for queued work: a never-
+    admitted request sees ShuttingDownError, not an internal error."""
+    eng = make_engine(decoder_params, slots=1)
+    sched = ContinuousBatchingScheduler(eng, clock=FakeClock(), recovery=NO_SLEEP)
+    h = sched.submit(PROMPTS[0], SamplingParams(max_new_tokens=4))
+    sched.stop(drain=False)
+    with pytest.raises(ShuttingDownError):
+        h.result(0)
+
+
+def test_prefill_nan_quarantined_at_admission(decoder_params):
+    """Non-finite prefill logits quarantine the request before it ever
+    occupies a slot (single-sequence step: blame needs no bisection)."""
+    eng = make_engine(decoder_params)
+    # force NaN params copy? cheaper: poison via a plan is not wired for
+    # prefill, so synthesize the condition through the blame vector by
+    # checking the quarantine path directly on a poisoned engine clone
+    bad = GenerationEngine(
+        jax.tree_util.tree_map(lambda a: np.asarray(a) * np.nan, decoder_params),
+        CFG, max_batch_slots=2, block_size=BLOCK, prompt_buckets=BUCKETS,
+    )
+    sched = ContinuousBatchingScheduler(bad, clock=FakeClock(), recovery=NO_SLEEP)
+    h = sched.submit(PROMPTS[0], SamplingParams(max_new_tokens=4))
+    sched.step()
+    with pytest.raises(PoisonedRequestError) as exc:
+        h.result(0)
+    assert exc.value.step == "prefill"
+    assert bad.allocator.num_free == bad.allocator.num_total
+    assert eng.resets == 0

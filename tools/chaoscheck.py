@@ -1,26 +1,351 @@
 #!/usr/bin/env python
-"""chaoscheck: run only the chaos (fault-injection) suite.
+"""chaoscheck: run the chaos (fault-injection) suites + the
+generation-recovery scenario sweep.
 
-The chaos tests exercise the serving-resilience layer through
-runtime/faults.py injection sites — backpressure, deadlines, retries,
-batch bisection, circuit breaking, graceful drain, elastic backoff, and
-checkpoint retention — on deterministic virtual clocks, so the whole
-sweep stays well inside the tier-1 time budget.
+Part 1 runs the pytest chaos/recovery suites (backpressure, deadlines,
+retries, batch bisection, circuit breaking, graceful drain, elastic
+backoff, checkpoint retention, journal-replay recovery) on
+deterministic virtual clocks.
 
-Usage: python tools/chaoscheck.py [extra pytest args]
+Part 2 is an in-process **generation-recovery sweep** against a live
+engine (CPU backend): one fault-free reference stream, then the same
+request mix re-run under each injected failure class —
+
+  crash        a decode step that hard-fails twice (past the supervisor's
+               single retry) -> engine restart + journal replay; every
+               stream must come out byte-identical to the reference
+  stall        a decode step that hangs on a gate -> the step watchdog
+               trips the breaker (health goes not-ready), a deadlined
+               request expires ON TIME while the device is wedged, and
+               once the step unwedges the late result is discarded and
+               the streams replay to byte-identical completion
+  nan          one request's slot data-dependently produces NaN logits
+               -> the in-jit blame vector quarantines exactly that
+               request (typed PoisonedRequestError); survivors match the
+               reference byte-for-byte
+  double fault a crash whose FIRST journal replay also crashes
+               (generation.journal_replay site) -> a second budget unit
+               + backoff, then exact recovery
+  budget       every decode fails -> restarts exhaust the budget, the
+               running streams fail with typed EngineFailedError, and
+               the scheduler reports not-ready (breaker OPEN)
+  combined     ISSUE 4's acceptance gate: crash + stall + NaN-poisoned
+               request in ONE batch of concurrent streams — the poisoned
+               request alone fails, every other greedy stream is
+               byte-identical to the fault-free run, no request hangs
+               past its deadline, and the /v2/stats snapshot carries the
+               recovery/quarantine counts
+
+Usage: python tools/chaoscheck.py [--sweep-only | --no-sweep]
+                                  [extra pytest args]
 """
+import argparse
+import json
 import os
 import subprocess
 import sys
+import threading
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+
+def run_recovery_sweep() -> bool:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO)
+
+    import jax
+    import numpy as np
+
+    from flexflow_tpu.generation import (
+        ContinuousBatchingScheduler,
+        EngineFailedError,
+        GenerationEngine,
+        PoisonedRequestError,
+        RecoveryPolicy,
+        SamplingParams,
+        WatchdogPolicy,
+        init_decoder_params,
+    )
+    from flexflow_tpu.models.transformer import TransformerConfig
+    from flexflow_tpu.runtime.faults import FaultPlan
+    from flexflow_tpu.serving.resilience import DeadlineExceededError
+
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=32, num_heads=4, ff_size=64,
+        seq_length=64, vocab_size=50, causal=True,
+    )
+    params = init_decoder_params(jax.random.key(0), cfg)
+    prompts = [[1, 2, 3], [4, 5, 6, 7], [9, 8, 7, 6, 5]]
+    sampling = SamplingParams(max_new_tokens=10)
+    policy = RecoveryPolicy(sleep=lambda _s: None)  # virtual backoff
+
+    # ONE shared engine, warmed before any fault runs: stall timeouts
+    # are calibrated against warm steps — a cold jit compile can take
+    # whole seconds and must not read as a stalled device (the same
+    # reason production stall timeouts must exceed worst-case compile)
+    eng = GenerationEngine(params, cfg, max_batch_slots=3, block_size=8)
+    eng.generate([[1] * 12], SamplingParams(max_new_tokens=2))  # replay-length bucket
+
+    def make(**kw):
+        return eng, ContinuousBatchingScheduler(eng, recovery=policy, **kw)
+
+    def drive(sched, handles, steps=500):
+        for _ in range(steps):
+            if all(h.done() for h in handles):
+                return
+            if not sched.step():
+                return
+
+    report, failures = {}, []
+
+    def check(scenario, cond, msg):
+        if not cond:
+            failures.append(f"{scenario}: {msg}")
+
+    # ----------------------------------------------------- reference run
+    eng, sched = make()
+    handles = [sched.submit(p, sampling) for p in prompts]
+    drive(sched, handles)
+    ref = [h.result(timeout=0) for h in handles]
+    check("reference", eng.resets == 0, "fault-free run restarted the engine")
+    report["reference"] = {"tokens": sum(len(r) for r in ref)}
+
+    # ----------------------------------------------------------- crash
+    eng, sched = make()
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=RuntimeError("injected device crash"), nth=(2, 3))
+    with plan.active():
+        handles = [sched.submit(p, sampling) for p in prompts]
+        drive(sched, handles)
+    got = [h.result(timeout=0) for h in handles]
+    rs = sched.recovery_stats
+    check("crash", got == ref, f"streams diverged after crash replay: {got} != {ref}")
+    check("crash", rs.recoveries == 1, f"expected 1 recovery, got {rs.recoveries}")
+    check("crash", eng.allocator.num_free == eng.allocator.num_total, "leaked blocks")
+    report["crash"] = {"recoveries": rs.recoveries,
+                      "replayed_tokens": rs.replayed_tokens, "exact": got == ref}
+
+    # ------------------------------------------------------------- stall
+    # real clocks: the watchdog thread must trip while a decode hangs on
+    # the injected gate, and a deadlined request must expire ON TIME
+    _, sched = make(watchdog=WatchdogPolicy(stall_timeout_s=1.0, poll_s=0.05))
+    gate = threading.Event()
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="stall", gate=gate, nth=(2,))
+    with plan.active():
+        sched.start()
+        handles = [sched.submit(p, sampling) for p in prompts]
+        # 4th request waits in the queue (3 slots) with a deadline that
+        # expires mid-stall; the watchdog must reap it while the loop
+        # thread is wedged inside the device call
+        h_dead = sched.submit([2, 2, 2], sampling, deadline_s=0.5)
+        t0 = time.monotonic()
+        while sched.recovery_stats.watchdog_trips == 0 and time.monotonic() - t0 < 10:
+            time.sleep(0.02)
+        tripped_ready = sched.ready()
+        gate.set()
+        got = [h.result(timeout=30) for h in handles]
+    rs = sched.recovery_stats
+    try:
+        h_dead.result(timeout=5)
+        dead_ok = False
+    except DeadlineExceededError:
+        dead_ok = True
+    except Exception:
+        dead_ok = False
+    sched.stop()
+    check("stall", rs.watchdog_trips >= 1, "watchdog never tripped")
+    check("stall", not tripped_ready, "health stayed ready during the stall")
+    check("stall", got == ref, f"streams diverged after stall replay: {got} != {ref}")
+    check("stall", rs.recoveries >= 1, "stalled step's late result was not replayed")
+    check("stall", dead_ok, "deadlined request did not expire during the stall")
+    report["stall"] = {"watchdog_trips": rs.watchdog_trips,
+                      "recoveries": rs.recoveries, "exact": got == ref,
+                      "deadline_enforced": dead_ok}
+
+    # --------------------------------------------------------------- nan
+    # pick a token unique to ONE reference stream: when it feeds the next
+    # decode step, that slot's logits are poisoned — data-dependent, so
+    # the blame vector must pin it whatever slot the scheduler chose
+    poison_idx, poison_tok = None, None
+    for i, stream in enumerate(ref):
+        others = {t for j, s2 in enumerate(ref) if j != i for t in s2[:-1]}
+        uniq = [t for t in stream[:-1] if t not in others]
+        if uniq:
+            poison_idx, poison_tok = i, uniq[0]
+            break
+    check("nan", poison_idx is not None, "no stream-unique token to poison")
+    if poison_idx is not None:
+        eng, sched = make()
+        plan = FaultPlan(seed=0)
+        plan.on("generation.decode_step", mode="nan",
+                when=lambda v: bool((np.asarray(v[0]) == poison_tok).any()),
+                select=lambda v: np.asarray(v[0]) == poison_tok)
+        with plan.active():
+            handles = [sched.submit(p, sampling) for p in prompts]
+            drive(sched, handles)
+        rs = sched.recovery_stats
+        for i, h in enumerate(handles):
+            if i == poison_idx:
+                try:
+                    h.result(timeout=0)
+                    check("nan", False, "poisoned request did not fail")
+                except PoisonedRequestError as e:
+                    check("nan", e.reason == "nan_logits", f"wrong reason {e.reason}")
+                except Exception as e:
+                    check("nan", False, f"poisoned request failed untyped: {e!r}")
+            else:
+                check("nan", h.result(timeout=0) == ref[i],
+                      f"survivor stream {i} diverged")
+        check("nan", rs.quarantined == 1, f"expected 1 quarantine, got {rs.quarantined}")
+        check("nan", rs.recoveries == 0, "partial NaN blame must not restart the engine")
+        check("nan", eng.allocator.num_free == eng.allocator.num_total, "leaked blocks")
+        report["nan"] = {"quarantined": rs.quarantined, "poison_token": poison_tok}
+
+    # ------------------------------------------------- double fault (replay)
+    eng, sched = make()
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=RuntimeError("injected device crash"), nth=(2, 3))
+    plan.on("generation.journal_replay", mode="error",
+            error=RuntimeError("crash during replay"), nth=(0,))
+    with plan.active():
+        handles = [sched.submit(p, sampling) for p in prompts]
+        drive(sched, handles)
+    got = [h.result(timeout=0) for h in handles]
+    rs = sched.recovery_stats
+    check("double_fault", got == ref, "streams diverged after double-fault recovery")
+    check("double_fault", plan.fired("generation.journal_replay") == 1,
+          "replay fault never fired")
+    check("double_fault", rs.recoveries == 1,
+          f"expected 1 completed recovery, got {rs.recoveries}")
+    report["double_fault"] = {"recoveries": rs.recoveries, "exact": got == ref}
+
+    # ------------------------------------------------- budget exhaustion
+    eng, sched = make()
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=RuntimeError("device is gone"), every=1)
+    with plan.active():
+        handles = [sched.submit(p, sampling) for p in prompts]
+        drive(sched, handles)
+    rs = sched.recovery_stats
+    typed = 0
+    for h in handles:
+        try:
+            h.result(timeout=0)
+        except EngineFailedError:
+            typed += 1
+        except Exception:
+            pass
+    check("budget", typed == len(handles),
+          f"{typed}/{len(handles)} running requests got the typed EngineFailedError")
+    check("budget", rs.engine_failures == 1, "budget exhaustion not recorded")
+    check("budget", not sched.ready(), "dead engine still reports ready")
+    report["budget"] = {"recoveries": rs.recoveries,
+                       "engine_failures": rs.engine_failures,
+                       "typed_failures": typed}
+
+    # ------------------------------------------- combined (ISSUE 4 gate)
+    # one seeded run, one batch of concurrent streams, ALL THREE faults:
+    # an engine crash, a stalled step, and a NaN-poisoned request — the
+    # poisoned request alone fails (structured), every other greedy
+    # stream is byte-identical to the fault-free run, no request hangs
+    # past its deadline, and the /v2/stats snapshot shows the counts
+    if poison_idx is not None:
+        _, sched = make(watchdog=WatchdogPolicy(stall_timeout_s=1.0, poll_s=0.05))
+        gate = threading.Event()
+        plan = FaultPlan(seed=0)
+        plan.on("generation.decode_step", mode="error",
+                error=RuntimeError("injected device crash"), nth=(4, 5))
+        plan.on("generation.decode_step", mode="stall", gate=gate, nth=(9,))
+        plan.on("generation.decode_step", mode="nan",
+                when=lambda v: bool((np.asarray(v[0]) == poison_tok).any()),
+                select=lambda v: np.asarray(v[0]) == poison_tok)
+        with plan.active():
+            sched.start()
+            handles = [sched.submit(p, sampling) for p in prompts]
+            h_dead = sched.submit([2, 2, 2], sampling, deadline_s=0.5)
+            t0 = time.monotonic()
+            while sched.recovery_stats.watchdog_trips == 0 and time.monotonic() - t0 < 10:
+                time.sleep(0.02)
+            gate.set()
+            t0 = time.monotonic()
+            while not all(h.done() for h in handles + [h_dead]):
+                if time.monotonic() - t0 > 30:
+                    break
+                time.sleep(0.02)
+        rs = sched.recovery_stats
+        check("combined", all(h.done() for h in handles + [h_dead]),
+              "a request hung (past any deadline it had)")
+        for i, h in enumerate(handles):
+            if i == poison_idx:
+                try:
+                    h.result(timeout=0)
+                    check("combined", False, "poisoned request did not fail")
+                except PoisonedRequestError:
+                    pass
+                except Exception as e:
+                    check("combined", False, f"poisoned request failed untyped: {e!r}")
+            else:
+                check("combined", h.done() and h.result(timeout=0) == ref[i],
+                      f"survivor stream {i} not byte-identical")
+        if h_dead.done():
+            try:
+                h_dead.result(timeout=0)  # finished in time: fine
+            except DeadlineExceededError:
+                pass  # expired ON time: fine
+            except Exception as e:
+                check("combined", False, f"deadlined request failed untyped: {e!r}")
+        snap = sched.stats.snapshot()  # the exact /v2/stats payload path
+        check("combined", snap.get("quarantined") == 1,
+              f"/v2/stats quarantined = {snap.get('quarantined')}, want 1")
+        check("combined", (snap.get("recoveries") or 0) >= 2,
+              f"/v2/stats recoveries = {snap.get('recoveries')}, want >= 2")
+        check("combined", (snap.get("watchdog_trips") or 0) >= 1, "no watchdog trip")
+        sched.stop()
+        report["combined"] = {
+            "recoveries": snap.get("recoveries"),
+            "quarantined": snap.get("quarantined"),
+            "watchdog_trips": snap.get("watchdog_trips"),
+            "replayed_tokens": snap.get("replayed_tokens"),
+        }
+
+    report["ok"] = not failures
+    print(json.dumps({"recovery_sweep": report}, indent=2))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print("OK: recovery sweep — crash/stall/nan/double-fault/budget/"
+              "combined all behaved; surviving streams byte-identical")
+    return not failures
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sweep-only", action="store_true",
+                    help="skip pytest; run only the generation-recovery sweep")
+    ap.add_argument("--no-sweep", action="store_true",
+                    help="run only the pytest chaos/recovery suites")
+    args, pytest_args = ap.parse_known_args()
+
+    rc = 0
+    if not args.sweep_only:
+        cmd = [
+            sys.executable, "-m", "pytest", "tests", "-q",
+            "-m", "chaos or recovery",
+            "-p", "no:cacheprovider",
+            *pytest_args,
+        ]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        rc = subprocess.call(cmd, cwd=REPO, env=env)
+    if not args.no_sweep and rc == 0:
+        if not run_recovery_sweep():
+            rc = 1
+    return rc
+
+
 if __name__ == "__main__":
-    cmd = [
-        sys.executable, "-m", "pytest", "tests", "-q",
-        "-m", "chaos",
-        "-p", "no:cacheprovider",
-        *sys.argv[1:],
-    ]
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    sys.exit(subprocess.call(cmd, cwd=REPO, env=env))
+    sys.exit(main())

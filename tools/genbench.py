@@ -12,6 +12,12 @@ and FAILS (exit 1) if steady-state decode retraced — the engine's core
 contract is at most ONE compile per prompt bucket and exactly one
 decode program, whatever joins or leaves the batch.
 
+The run also FAILS if the fault-free stream triggered any self-healing:
+engine restarts (``engine.resets``), quarantines, or watchdog trips
+must all be zero with no faults injected — the guard that the
+supervisor never misfires and the watchdog never false-trips under
+plain load (generation/recovery.py).
+
 ``--speculate`` additionally benchmarks speculative decoding with the
 model-free n-gram drafter on repetitive prompts: same request stream
 through a baseline engine and a speculating engine (same params, so
@@ -61,6 +67,29 @@ def run_stream(engine, prompts, sampling, speculation=None):
     return [h.result(timeout=0) for h in handles], sched, elapsed
 
 
+def check_no_self_healing(report, schedulers, engines) -> bool:
+    """Fault-free runs must never exercise the recovery path: a nonzero
+    count here means the supervisor or watchdog misfired under plain
+    load. Adds the counters to ``report``; returns ok."""
+    restarts = sum(e.resets for e in engines)
+    quarantined = sum(s.recovery_stats.quarantined for s in schedulers)
+    trips = sum(s.recovery_stats.watchdog_trips for s in schedulers)
+    retries = sum(s.recovery_stats.step_retries for s in schedulers)
+    report["engine_restarts"] = restarts
+    report["quarantined"] = quarantined
+    report["watchdog_trips"] = trips
+    report["supervisor_step_retries"] = retries
+    if restarts or quarantined or trips or retries:
+        print(
+            f"FAIL: fault-free run exercised self-healing: "
+            f"restarts={restarts} quarantined={quarantined} "
+            f"watchdog_trips={trips} step_retries={retries}",
+            file=sys.stderr,
+        )
+        return False
+    return True
+
+
 def speculate_bench(args, cfg, params) -> tuple:
     """Baseline vs n-gram-speculation on repetitive prompts. Returns
     (report dict, ok bool)."""
@@ -94,7 +123,7 @@ def speculate_bench(args, cfg, params) -> tuple:
     for b in sorted({base_eng.bucket_for(len(p)) for p in prompts}):
         base_eng.generate([[1] * min(b, args.seq_len - 2)], SamplingParams(max_new_tokens=2))
     base_warm_steps = dict(base_eng.step_counts)
-    base_out, _, base_s = run_stream(base_eng, prompts, sampling)
+    base_out, base_sched, base_s = run_stream(base_eng, prompts, sampling)
     spec_eng = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16,
                                 max_spec_tokens=args.spec_k)
     # warm every prefill bucket + the verify/decode programs so the
@@ -144,8 +173,10 @@ def speculate_bench(args, cfg, params) -> tuple:
         "steady_state_retraces": steady_retraces,
         "backend": jax.default_backend(),
     }
+    ok = check_no_self_healing(
+        report, [base_sched, spec_sched], [base_eng, spec_eng]
+    )
     print(json.dumps(report, indent=2))
-    ok = True
     if not report["exact"]:
         print("FAIL: speculative greedy output differs from baseline", file=sys.stderr)
         ok = False
@@ -261,12 +292,12 @@ def main() -> int:
         "recompiles": engine.recompiles(),
         "backend": jax.default_backend(),
     }
+    ok = check_no_self_healing(report, [sched], [engine])
     print(json.dumps(report, indent=2))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=2)
 
-    ok = True
     if steady_retraces:
         print(f"FAIL: steady-state stream retraced: {steady_retraces}", file=sys.stderr)
         ok = False
