@@ -24,6 +24,7 @@ program.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -165,6 +166,9 @@ class GenerationEngine:
         # host-call counters: engine steps actually issued (genbench's
         # tokens-per-engine-step accounting)
         self.step_counts: Dict[str, int] = {"prefill": 0, "decode": 0, "verify": 0}
+        # cumulative wall seconds inside each step kind's host API call
+        # (dispatch + device + result sync) — the device_time_s gauge
+        self.device_time_s: Dict[str, float] = {"prefill": 0.0, "decode": 0.0, "verify": 0.0}
         # per-slot finiteness of the last step's logits (the supervisor's
         # NaN blame vector: a cheap in-jit isfinite reduce, so a poisoned
         # request is pinned to its slot without extra device calls);
@@ -283,6 +287,7 @@ class GenerationEngine:
         ids (padded internally to the engine's fixed table width)."""
         faults.inject("generation.prefill", prompt)
         self.step_counts["prefill"] += 1
+        t0 = time.perf_counter()
         n = len(prompt)
         bucket = self.bucket_for(n)
         tokens = np.zeros((1, bucket), np.int32)
@@ -302,7 +307,9 @@ class GenerationEngine:
         )
         self.cache.update(ck, cv)
         self.last_finite = np.asarray(ok).reshape(1)
-        return int(token)
+        out = int(token)  # forces the result sync before the clock stops
+        self.device_time_s["prefill"] += time.perf_counter() - t0
+        return out
 
     def decode(
         self,
@@ -322,6 +329,7 @@ class GenerationEngine:
         masked = np.where(active, tokens, 0).astype(np.int32)
         masked, bias = faults.inject("generation.decode_step", (masked, self._zero_bias))
         self.step_counts["decode"] += 1
+        t0 = time.perf_counter()
         context_lens = np.where(active, positions + 1, 0).astype(np.int32)
         safe_pos = np.where(active, positions, 0).astype(np.int32)
         # scratch-mask inactive slots' tables too: an inactive slot with
@@ -344,7 +352,9 @@ class GenerationEngine:
         )
         self.cache.update(ck, cv)
         self.last_finite = np.asarray(ok)
-        return np.asarray(out)
+        result = np.asarray(out)  # result sync included in the timing
+        self.device_time_s["decode"] += time.perf_counter() - t0
+        return result
 
     def _bias_arg(self, bias) -> jax.Array:
         """Device-side logit bias: the cached zeros unless a fault plan
@@ -378,6 +388,7 @@ class GenerationEngine:
         window = window_tokens.astype(np.int32)
         window, bias = faults.inject("generation.verify", (window, self._zero_bias))
         self.step_counts["verify"] += 1
+        t0 = time.perf_counter()
         out, n_emitted, ok, ck, cv = self._verify_jit(
             self.params,
             jnp.asarray(window),
@@ -393,7 +404,9 @@ class GenerationEngine:
         )
         self.cache.update(ck, cv)
         self.last_finite = np.asarray(ok)
-        return np.asarray(out), np.asarray(n_emitted)
+        result = (np.asarray(out), np.asarray(n_emitted))
+        self.device_time_s["verify"] += time.perf_counter() - t0
+        return result
 
     def generate(
         self,

@@ -245,6 +245,9 @@ class EngineSupervisor:
         try:
             out = sched._device(step_fn)
         except Exception as e:
+            # flight recorder: the failing step is now ON the ring, so
+            # every downstream incident snapshot contains it
+            sched.flight.record_event("step_failed", step=kind, error=repr(e)[:200])
             if self._consume_stall(seq0):
                 self._restart_and_replay(e, kind)
                 return None
@@ -252,9 +255,11 @@ class EngineSupervisor:
                 self._handle_double_failure(e, kind, states, probe)
                 return None
             self.stats.incr("step_retries")
+            sched.flight.record_event("step_retry", step=kind)
             try:
                 out = sched._device(step_fn)
             except Exception as e2:
+                sched.flight.record_event("step_failed", step=kind, error=repr(e2)[:200])
                 if self._consume_stall(seq0):
                     self._restart_and_replay(e2, kind)
                     return None
@@ -327,6 +332,19 @@ class EngineSupervisor:
         budget unit and backs off further."""
         sched = self.scheduler
         pol = self.policy
+        # postmortem FIRST: the snapshot must show the engine's last
+        # steps (including the step_failed marker) before reset rebuilds
+        # the world; attached to the cause so a later give-up's
+        # EngineFailedError still carries the first crash's context
+        snap = sched.flight.incident(
+            "restart", step=kind, error=repr(cause)[:200],
+            journal_entries=len(sched.journal),
+        )
+        if getattr(cause, "flight_snapshot", None) is None:
+            try:
+                cause.flight_snapshot = snap
+            except Exception:
+                pass
         while True:
             now = sched.clock()
             self._restart_times = [
@@ -356,8 +374,12 @@ class EngineSupervisor:
                     sched._rebuild_from_journal()
             except Exception as e:  # double fault: burn another budget unit
                 cause = e
+                sched.flight.record_event("double_fault", error=repr(e)[:200])
                 continue
             self.stats.incr("recoveries")
+            sched.flight.record_event(
+                "recovery", step=kind, consecutive=self._consecutive
+            )
             # recovery proved the device responsive; close the breaker a
             # watchdog trip (or the crash's recorded failures) opened so
             # admission resumes immediately instead of after recovery_s
@@ -373,6 +395,9 @@ class EngineSupervisor:
             f"(last cause: {cause!r})"
         )
         err.__cause__ = cause
+        err.flight_snapshot = self.scheduler.flight.incident(
+            "engine_failed", error=repr(cause)[:200]
+        )
         self.scheduler._fail_running_engine_dead(err)
         # queued-but-never-admitted requests are NOT failed: they hold no
         # slot and streamed nothing, so they wait out the outage behind
@@ -419,6 +444,10 @@ class StepWatchdog:
         if tripped:
             self._last_tripped_seq = seq
             self.stats.incr("watchdog_trips")
+            sched.flight.record_event(
+                "watchdog_trip", heartbeat_seq=seq,
+                stalled_s=sched.clock() - started,
+            )
             sched.breaker.trip()  # health stops lying about a hung device
             sched.supervisor.mark_stalled(seq)
         # while the device is wedged the loop thread cannot expire

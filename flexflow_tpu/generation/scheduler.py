@@ -76,6 +76,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import NULL_TRACE, FlightRecorder, RequestTrace, TraceRing, next_request_id
 from ..runtime import faults
 from ..serving.resilience import (
     CircuitBreaker,
@@ -109,6 +110,19 @@ class GenerationHandle:
         self._request = request
         self.future: Future = Future()
         self._tokens: "queue.Queue" = queue.Queue()
+        # settle arbitration: the loop and watchdog threads race to
+        # finish/fail a handle; the claim winner owns BOTH the future
+        # and the trace, and closes the trace BEFORE the future settles
+        # so a client woken by the future never reads a half-open trace
+        self._settle_lock = threading.Lock()
+        self._settled = False
+
+    def _claim(self) -> bool:
+        with self._settle_lock:
+            if self._settled or self.future.done():
+                return False
+            self._settled = True
+            return True
 
     # ----------------------------------------------------------- caller
     def done(self) -> bool:
@@ -120,6 +134,16 @@ class GenerationHandle:
     def cancel(self) -> None:
         """Ask the scheduler to drop this request at its next step."""
         self._request.cancelled = True
+
+    @property
+    def trace(self):
+        """The request's RequestTrace (NULL_TRACE when observability is
+        off) — transports read it to embed postmortems in error
+        responses and annotate the transport kind."""
+        return self._request.trace
+
+    def trace_dict(self) -> dict:
+        return self._request.trace.to_dict()
 
     def tokens(self, timeout: Optional[float] = None):
         """Iterate generated tokens as they are produced. Raises the
@@ -139,10 +163,13 @@ class GenerationHandle:
     def _finish(self, tokens: List[int]) -> None:
         # idempotent under races: the watchdog thread may reap a
         # deadline while the loop thread is deciding the same request's
-        # fate — the loser of the set_result/set_exception race must
-        # not propagate InvalidStateError into (and kill) the loop
-        if self.future.done():
+        # fate — the loser of the claim must not propagate
+        # InvalidStateError into (and kill) the loop
+        if not self._claim():
             return
+        # trace first: a client thread woken by the settling future may
+        # immediately read trace_dict() for its response
+        self._request._trace_done("completed", None)
         try:
             self.future.set_result(tokens)
         except Exception:
@@ -152,8 +179,13 @@ class GenerationHandle:
     def _fail(self, err: BaseException) -> bool:
         """Returns True only if THIS call failed the handle — losers of
         the loop/watchdog race must not double-count in stats."""
-        if self.future.done():
+        if not self._claim():
             return False
+        # the claim winner also closes the trace (BEFORE the future
+        # settles), so every terminal path — loop, watchdog reap,
+        # shutdown — lands exactly one finished trace in the ring and
+        # error responses never embed a half-open trace
+        self._request._trace_done(type(err).__name__, err)
         try:
             self.future.set_exception(err)
         except Exception:
@@ -167,9 +199,10 @@ class Request:
     """One generation request. ``prompt`` may grow on preemption (the
     generated prefix is folded in for recompute); ``n_generated`` is the
     TOTAL generated count across preemptions, which also indexes the
-    per-request sampling key stream."""
-
-    _ids = itertools.count()
+    per-request sampling key stream. Ids come from the process-wide
+    obs counter so a trace id names exactly one request across every
+    serving path (sampling never mixes the id in — determinism is
+    seed-only)."""
 
     def __init__(
         self,
@@ -179,7 +212,11 @@ class Request:
         speculation: Optional[SpeculationConfig] = None,
         drafter=None,
     ):
-        self.id = next(Request._ids)
+        self.id = next_request_id()
+        # observability: the scheduler swaps in a live RequestTrace (+
+        # destination ring) at submit when tracing is enabled
+        self.trace = NULL_TRACE
+        self.trace_ring = None
         self.original_prompt = list(prompt)
         self.prompt = list(prompt)  # prompt + recomputed prefix
         self.sampling = sampling
@@ -211,6 +248,15 @@ class Request:
     @property
     def n_generated(self) -> int:
         return len(self.generated)
+
+    def _trace_done(self, outcome: str, err: Optional[BaseException]) -> None:
+        """Terminal trace hook, called by the handle's settle-race
+        winner (exactly once per request)."""
+        if self.trace is NULL_TRACE:
+            return
+        self.trace.mark_finish(outcome, err)
+        if self.trace_ring is not None:
+            self.trace_ring.add(self.trace)
 
     def sample_key(self) -> jax.Array:
         """Key for the NEXT token: indexed by generated count, so a
@@ -281,6 +327,10 @@ class ContinuousBatchingScheduler:
         draft_params=None,
         recovery: Optional[RecoveryPolicy] = None,
         watchdog: Optional[WatchdogPolicy] = None,
+        observability: bool = True,
+        trace_ring_size: int = 256,
+        flight_capacity: int = 512,
+        trace_progress_every: int = 8,
     ):
         self.engine = engine
         # scheduler-wide default speculation policy (a request's own
@@ -322,6 +372,22 @@ class ContinuousBatchingScheduler:
             lambda: 1.0 - self.engine.allocator.num_free / max(1, self.engine.allocator.num_total),
         )
         self.stats.add_gauge("recompiles", lambda: sum(self.engine.recompiles().values()))
+        self.stats.add_gauge(
+            "device_time_s", lambda: sum(self.engine.device_time_s.values())
+        )
+        # per-request tracing + engine flight recorder (obs/): one
+        # RequestTrace per submit, finished traces in a bounded ring
+        # (GET /v2/debug/traces); one flight record per scheduler step
+        # (GET /v2/debug/timeline, quarantine/restart postmortems).
+        # observability=False turns both into no-ops (genbench's
+        # tracing-overhead baseline).
+        self.obs_enabled = observability
+        self.trace_progress_every = trace_progress_every
+        self.trace_ring = TraceRing(trace_ring_size)
+        self.flight = FlightRecorder(capacity=flight_capacity, enabled=observability)
+        self._step_phases: Dict[str, float] = {}
+        self._step_info: Dict = {}
+        self._step_recorded = False
         self.spec_stats = SpeculationStats()
         self.spec_stats.register_gauges(self.stats)
         self._dummy_keys = None  # inactive-slot key rows, built once
@@ -347,13 +413,15 @@ class ContinuousBatchingScheduler:
         sampling: Optional[SamplingParams] = None,
         deadline_s: Optional[float] = None,
         speculation: Optional[SpeculationConfig] = None,
+        transport: Optional[str] = None,
     ) -> GenerationHandle:
         """Enqueue one request (FCFS). Typed rejections mirror the
         batcher: QueueFullError on backpressure, CircuitOpenError while
         the breaker holds traffic, ShuttingDownError while draining,
         DeadlineExceededError for an already-expired budget.
         ``speculation`` turns on (exact) speculative decoding for this
-        request; None falls back to the scheduler-wide default."""
+        request; None falls back to the scheduler-wide default.
+        ``transport`` annotates the request's trace ("http"/"grpc")."""
         if self._draining:
             raise ShuttingDownError("generation scheduler draining")
         if self._stopped:
@@ -405,6 +473,19 @@ class ContinuousBatchingScheduler:
                 speculation=spec, drafter=drafter,
             )
             req.submitted_at = self.clock()
+            if self.obs_enabled:
+                req.trace = RequestTrace(
+                    req.id, clock=self.clock,
+                    progress_every=self.trace_progress_every,
+                )
+                req.trace_ring = self.trace_ring
+                req.trace.mark_accept(
+                    prompt_len=len(prompt),
+                    deadline_s=deadline_s,
+                    speculative=bool(spec is not None and spec.enabled),
+                )
+                if transport is not None:
+                    req.trace.mark_transport(transport)
             # the sequence can never outgrow max_seq_len (its last token
             # would need a cache position past the block table) NOR the
             # TOTAL cache: a sequence needing more blocks than exist
@@ -545,6 +626,7 @@ class ContinuousBatchingScheduler:
                 continue
             req.prompt = req.original_prompt + list(req.generated)
             req.replays += 1
+            req.trace.note_replay()
             replayed += req.n_generated
             requeue.append(req)
         with self._lock:
@@ -564,9 +646,24 @@ class ContinuousBatchingScheduler:
 
     def _quarantine(self, state: _Running, err: BaseException) -> None:
         """Fail ONE poisoned request and keep the batch: blocks freed,
-        slot returned, everyone else untouched."""
+        slot returned, everyone else untouched. The flight recorder's
+        trailing window rides the error out as the postmortem."""
+        req = state.req
+        req.trace.event(
+            "quarantine",
+            step=getattr(err, "step", None),
+            reason=getattr(err, "reason", type(err).__name__),
+        )
+        if getattr(err, "flight_snapshot", None) is None:
+            try:
+                err.flight_snapshot = self.flight.incident(
+                    "quarantine", request_id=req.id,
+                    error=repr(err)[:200],
+                )
+            except Exception:
+                pass  # exceptions with __slots__ cannot carry the dump
         self._release(state)
-        if state.req.handle._fail(err):
+        if req.handle._fail(err):
             self.stats.incr("failed")
             self.recovery_stats.incr("quarantined")
 
@@ -594,6 +691,9 @@ class ContinuousBatchingScheduler:
         self._release(state)
         req = state.req
         self.stats.latency.record(max(0.0, self.clock() - req.submitted_at))
+        tpot = req.trace.tpot_s
+        if tpot is not None:
+            self.stats.observe("tpot", tpot)
         req.handle._finish(list(req.generated))
         self.stats.incr("completed")
 
@@ -674,6 +774,7 @@ class ContinuousBatchingScheduler:
         req.prompt = req.original_prompt + list(req.generated)
         req.preemptions += 1
         self.preemptions += 1
+        req.trace.note_preempt()
         with self._lock:
             self._queue.appendleft(req)
         return True
@@ -699,6 +800,7 @@ class ContinuousBatchingScheduler:
             self._queue.popleft()
             slot = self._free_slots.pop()
         self._admitting = req
+        t_dev = time.perf_counter()
         try:
             token = self._device(
                 lambda: self.engine.prefill_one(
@@ -732,17 +834,22 @@ class ContinuousBatchingScheduler:
                 self.stats.incr("failed")
             return True  # did work (and must not spin on the same head)
         self._admitting = None
+        dev_s = time.perf_counter() - t_dev
         if not bool(self.engine.last_finite[0]):
             # poisoned prompt: the prefill's logits went non-finite, and
             # a single-sequence step needs no bisection to assign blame
             self.engine.allocator.free(blocks)
             self._free_slots.append(slot)
-            if req.handle._fail(
-                PoisonedRequestError(
-                    f"request {req.id} produced non-finite logits at prefill",
-                    request_id=req.id, step="prefill", reason="nan_logits",
-                )
-            ):
+            err = PoisonedRequestError(
+                f"request {req.id} produced non-finite logits at prefill",
+                request_id=req.id, step="prefill", reason="nan_logits",
+            )
+            req.trace.event("quarantine", step="prefill", reason="nan_logits")
+            err.flight_snapshot = self.flight.incident(
+                "quarantine", request_id=req.id, step="prefill",
+                reason="nan_logits",
+            )
+            if req.handle._fail(err):
                 self.stats.incr("failed")
                 self.recovery_stats.incr("quarantined")
             return True
@@ -754,7 +861,29 @@ class ContinuousBatchingScheduler:
         if req.handle.done():  # watchdog reaped it while the prefill ran
             self._release(state)
             return True
+        was_first = req.n_generated == 0
+        now = self.clock()
+        req.trace.mark_admit(
+            slot=slot, prompt_len=len(req.prompt),
+            preemptions=req.preemptions, replays=req.replays,
+        )
+        if self.obs_enabled and was_first and req.preemptions == 0 and req.replays == 0:
+            # first-life admission only: a recompute re-admission is a
+            # scheduling event, not client-visible queueing
+            self.stats.observe("queue_time", max(0.0, now - req.submitted_at))
         self._emit_token(state, token)
+        req.trace.note_tokens(1, "prefill")
+        if self.obs_enabled and was_first:
+            # gated like tpot (trace-derived in _finish) so disabling
+            # observability drops all three SLO windows together, not
+            # a confusing two of three
+            self.stats.observe("ttft", max(0.0, now - req.submitted_at))
+        self.flight.record_step(
+            "prefill", phases={"device": dev_s}, request_id=req.id,
+            prompt_len=len(req.prompt), occupancy=len(self._running),
+            queue_depth=len(self._queue),
+            blocks_free=self.engine.allocator.num_free,
+        )
         self.token_rate.record(1)
         if req.finished():
             self._finish(state)
@@ -812,6 +941,7 @@ class ContinuousBatchingScheduler:
         req.prompt = req.original_prompt + list(req.generated)
         req.preemptions += 1
         self.preemptions += 1
+        req.trace.note_preempt()
         with self._lock:
             self._queue.appendleft(req)
 
@@ -851,6 +981,13 @@ class ContinuousBatchingScheduler:
         blamed = [s for s in live if not bool(ok[s.slot])]
         if not blamed:
             return False
+        # the failing step must be ON the flight ring before any
+        # quarantine/restart incident freezes its snapshot
+        self._flight_step()
+        self.flight.record_event(
+            "nan_blame", step=kind,
+            request_ids=[s.req.id for s in blamed], live=len(live),
+        )
         if len(blamed) == len(live) and len(live) > 1:
             self.supervisor.handle_engine_nan(kind)
             return True
@@ -891,11 +1028,18 @@ class ContinuousBatchingScheduler:
                 )
             )
 
+        ph, info = self._step_phases, self._step_info
+        info["kind"] = "decode"
+        t_dev = time.perf_counter()
         out = self.supervisor.run_step("decode", step, order, probe)
+        ph["device"] = time.perf_counter() - t_dev
         if out is None:
+            info["handled_failure"] = True
             return True  # failure handled: quarantined or journal-replayed
         if self._quarantine_nan("decode", order):
+            info["handled_failure"] = True
             return True
+        t_book = time.perf_counter()
         n_live = 0
         for state in order:
             if self._running.get(state.slot) is not state:
@@ -904,9 +1048,12 @@ class ContinuousBatchingScheduler:
                 continue  # watchdog-reaped mid-step; _expire releases it
             state.cached_len += 1
             self._emit_token(state, int(out[state.slot]))
+            state.req.trace.note_tokens(1, "decode")
             n_live += 1
             if state.req.finished():
                 self._finish(state)
+        ph["bookkeep"] = time.perf_counter() - t_book
+        info["emitted"] = n_live
         self.token_rate.record(n_live)
         return True
 
@@ -933,6 +1080,9 @@ class ContinuousBatchingScheduler:
             return False
         b = self.engine.max_batch_slots
         w = self.engine.spec_window
+        ph, info = self._step_phases, self._step_info
+        info["kind"] = "verify"
+        t_draft = time.perf_counter()
         order = sorted(self._running.values(), key=lambda s: s.slot)
         last, start, tables, _active, temps, top_ks = self._collect_slots(order)
         window = np.zeros((b, w), np.int32)
@@ -962,6 +1112,8 @@ class ContinuousBatchingScheduler:
         if self._dummy_keys is None:
             self._dummy_keys = jnp.stack([jax.random.key(0)] * w)
         keys = jnp.stack([keys_by_slot.get(i, self._dummy_keys) for i in range(b)])
+        ph["draft"] = time.perf_counter() - t_draft
+        info["drafted"] = int(np.maximum(n_draft, 0).sum())
 
         def step():
             return self.engine.verify(
@@ -978,12 +1130,18 @@ class ContinuousBatchingScheduler:
                 )
             )
 
+        t_dev = time.perf_counter()
         result = self.supervisor.run_step("verify", step, order, probe)
+        ph["device"] = time.perf_counter() - t_dev
         if result is None:
+            info["handled_failure"] = True
             return True  # failure handled: quarantined or journal-replayed
         out, n_emitted = result
         if self._quarantine_nan("verify", order):
+            info["handled_failure"] = True
             return True
+        t_book = time.perf_counter()
+        n_accepted = 0
         n_live_tokens = 0
         for state in order:
             if self._running.get(state.slot) is not state:
@@ -1001,34 +1159,73 @@ class ContinuousBatchingScheduler:
             if eos is not None and eos in toks:
                 toks = toks[: toks.index(eos) + 1]
             accepted = max(0, m - 1)  # drafts the target agreed with
+            n_accepted += accepted
             req.update_speculation(proposed=int(max(0, n_draft[i])), accepted=accepted)
+            req.trace.note_speculation(int(max(0, n_draft[i])), accepted)
             self.spec_stats.record_window(
                 proposed=int(max(0, n_draft[i])), accepted=accepted, emitted=len(toks)
             )
             for t in toks:
                 self._emit_token(state, t)
+            req.trace.note_tokens(len(toks), "verify")
             state.cached_len += len(toks)
             self._trim_blocks(state)
             n_live_tokens += len(toks)
             if req.finished():
                 self._finish(state)
+        ph["bookkeep"] = time.perf_counter() - t_book
+        info["accepted"] = n_accepted
+        info["emitted"] = n_live_tokens
         self.token_rate.record(n_live_tokens)
         return True
+
+    def _flight_step(self) -> None:
+        """Write THIS iteration's step record (idempotent per step):
+        normally at the end of step(), but flushed early when NaN blame
+        is about to freeze an incident snapshot — the failing step must
+        be on the ring its own postmortem is cut from."""
+        if self._step_recorded or not self.flight.enabled:
+            return
+        self._step_recorded = True
+        info = dict(self._step_info)
+        self.flight.record_step(
+            info.pop("kind", "admit"),
+            phases=dict(self._step_phases),
+            occupancy=len(self._running),
+            queue_depth=len(self._queue),
+            blocks_free=self.engine.allocator.num_free,
+            **info,
+        )
 
     # ---------------------------------------------------------------- step
     def step(self) -> bool:
         """One scheduling iteration: expire, admit (join-mid-flight),
         plan speculation, grow/preempt, then decode — or verify, when
         any running request speculates. Returns True if any work
-        happened."""
+        happened. Each working iteration writes one flight-recorder
+        step record with its phase decomposition (admission prefills
+        record their own entries inside _admit)."""
+        ph = self._step_phases = {}
+        info = self._step_info = {}
+        self._step_recorded = False
+        t0 = time.perf_counter()
         self._expire()
-        did = False
+        t1 = time.perf_counter()
+        admitted = 0
         # admit as many as fit THIS iteration — they decode together below
         while self._admit():
-            did = True
+            admitted += 1
+        t2 = time.perf_counter()
         self._plan_speculation()
         self._grow()
+        t3 = time.perf_counter()
+        ph["schedule"] = (t1 - t0) + (t3 - t2)
+        if admitted:
+            ph["admit"] = t2 - t1
+            info["admitted"] = admitted
         speculating = any(s.step_k > 0 for s in self._running.values())
-        if self._verify_once() if speculating else self._decode_once():
-            did = True
+        stepped = self._verify_once() if speculating else self._decode_once()
+        did = stepped or admitted > 0
+        if did:
+            self._flight_step()
         return did

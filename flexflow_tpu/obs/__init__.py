@@ -1,0 +1,42 @@
+"""End-to-end serving observability.
+
+Three low-overhead pieces threaded through the serving path:
+
+* :mod:`trace` — per-request :class:`RequestTrace` (accept -> queue ->
+  admit -> prefill -> decode progress -> finish/fail, with speculation
+  and recovery annotations) feeding the per-model TTFT / TPOT /
+  queue-time windows, retained in a bounded :class:`TraceRing` served
+  on ``GET /v2/debug/traces`` and embedded in error responses;
+* :mod:`flight` — the engine :class:`FlightRecorder`: a ring of
+  per-step records (occupancy, cache pressure, phase timings) plus
+  supervisor/watchdog events, snapshotted into every quarantine /
+  restart postmortem and dumpable as chrome://tracing JSON on
+  ``GET /v2/debug/timeline``;
+* :mod:`prom` — Prometheus text exposition for every ServingStats
+  counter / gauge / latency window / histogram on ``GET /metrics``.
+
+See tools/obsreport.py for the CLI (summaries, trace waterfalls,
+timeline dumps, and the CI ``--selfcheck``).
+"""
+from .flight import FlightRecorder
+from .prom import (
+    escape_label_value,
+    format_value,
+    render_prometheus,
+    sanitize_name,
+    validate_exposition,
+)
+from .trace import NULL_TRACE, RequestTrace, TraceRing, next_request_id
+
+__all__ = [
+    "FlightRecorder",
+    "NULL_TRACE",
+    "RequestTrace",
+    "TraceRing",
+    "next_request_id",
+    "escape_label_value",
+    "format_value",
+    "render_prometheus",
+    "sanitize_name",
+    "validate_exposition",
+]

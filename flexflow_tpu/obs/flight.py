@@ -1,0 +1,165 @@
+"""Engine flight recorder: a bounded ring of per-step records written
+by the continuous-batching scheduler loop.
+
+FlexFlow brackets kernels with cudaEvents under ``--profiling`` and
+replays Legion traces for postmortems; the serving-plane analog here is
+a crash-safe, lock-cheap ring the scheduler writes once per step:
+
+  step records  step kind (prefill/decode/verify), batch occupancy,
+                queue depth, free cache blocks, drafted/accepted/emitted
+                token counts, and wall-clock phase timings
+                (schedule / admit / draft / device / bookkeep)
+  events        instantaneous markers from the self-healing layer:
+                step_failed, step_retry, watchdog_trip, quarantine,
+                restart, recovery, engine_failed
+
+Both share one ring so a snapshot interleaves them in true order — the
+"what was the engine doing when it tripped the watchdog?" answer.
+
+Incidents: the supervisor calls :meth:`incident` at every quarantine /
+restart / give-up; the recorder freezes the trailing window of records
+into a bounded ``incidents`` list AND returns the snapshot so it can be
+attached to the error object riding back to the client. Every PR-4
+recovery therefore has a postmortem without anyone scraping in time.
+
+``to_chrome_trace`` renders the ring as chrome://tracing JSON (load in
+``chrome://tracing`` or https://ui.perfetto.dev): phases as duration
+events, markers as instants, occupancy/free-blocks as counter tracks.
+
+Timing uses ``time.perf_counter`` (real wall time, independent of the
+scheduler's possibly-virtual clock): phase durations are physical
+profiling data even in virtual-clock tests. Disabled recorders
+(``enabled=False``) make every method a cheap no-op.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        capacity: int = 512,
+        clock: Callable[[], float] = time.perf_counter,
+        max_incidents: int = 8,
+        incident_window: int = 64,
+        enabled: bool = True,
+    ):
+        self.enabled = enabled
+        self.capacity = max(1, capacity)
+        self.clock = clock
+        self.incident_window = incident_window
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+        self.incidents: deque = deque(maxlen=max(1, max_incidents))
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    # ------------------------------------------------------------ recording
+    def record_step(
+        self,
+        kind: str,
+        *,
+        phases: Optional[Dict[str, float]] = None,
+        **fields,
+    ) -> int:
+        """One scheduler-loop step. ``phases`` maps phase name ->
+        seconds; extra fields (occupancy, queue_depth, blocks_free,
+        drafted, accepted, emitted, admitted) ride along verbatim."""
+        if not self.enabled:
+            return -1
+        rec = {"t": self.clock(), "kind": kind}
+        if phases:
+            rec["phases"] = phases
+        rec.update(fields)
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+        return rec["seq"]
+
+    def record_event(self, kind: str, **fields) -> int:
+        """Instantaneous marker (no phases): supervisor/watchdog events."""
+        return self.record_step(kind, **fields)
+
+    def incident(self, kind: str, **fields) -> Dict:
+        """Freeze the trailing window of records as a postmortem. The
+        snapshot is stored in ``incidents`` AND returned so callers can
+        attach it to the error context (PoisonedRequestError /
+        EngineFailedError / restart cause)."""
+        if not self.enabled:
+            return {}
+        marker_seq = self.record_event("incident:" + kind, **fields)
+        with self._lock:
+            records = list(self._ring)[-self.incident_window:]
+        snap = {
+            "kind": kind,
+            "t": self.clock(),
+            "seq": marker_seq,
+            **fields,
+            "records": records,
+        }
+        self.incidents.append(snap)
+        return snap
+
+    # ------------------------------------------------------------ snapshots
+    def snapshot(self, last: Optional[int] = None) -> List[Dict]:
+        """Ring contents in order, oldest first (``last`` trims to the
+        trailing N)."""
+        with self._lock:
+            records = list(self._ring)
+        if last is not None:
+            records = records[-last:]
+        return records
+
+    def to_chrome_trace(self, pid: int = 1, name: str = "engine") -> Dict:
+        """chrome://tracing JSON: one duration event per step (phases as
+        nested durations), instants for markers, counter tracks for
+        occupancy and free cache blocks. Timestamps are microseconds
+        relative to the oldest retained record."""
+        records = self.snapshot()
+        events: List[Dict] = [
+            {"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": name}},
+        ]
+        if not records:
+            return {"traceEvents": events, "displayTimeUnit": "ms"}
+        t0 = records[0]["t"]
+        for rec in records:
+            ts = (rec["t"] - t0) * 1e6
+            phases = rec.get("phases")
+            args = {
+                k: v for k, v in rec.items()
+                if k not in ("t", "phases") and v is not None
+            }
+            if phases:
+                total = sum(phases.values())
+                events.append({
+                    "name": rec["kind"], "ph": "X", "pid": pid, "tid": 1,
+                    "ts": ts, "dur": total * 1e6, "args": args,
+                })
+                off = ts
+                for pname, dur in phases.items():
+                    events.append({
+                        "name": pname, "ph": "X", "pid": pid, "tid": 2,
+                        "ts": off, "dur": dur * 1e6, "args": {},
+                    })
+                    off += dur * 1e6
+            else:
+                events.append({
+                    "name": rec["kind"], "ph": "i", "pid": pid, "tid": 3,
+                    "ts": ts, "s": "p", "args": args,
+                })
+            for counter in ("occupancy", "blocks_free", "queue_depth"):
+                if counter in rec:
+                    events.append({
+                        "name": counter, "ph": "C", "pid": pid,
+                        "ts": ts, "args": {counter: rec[counter]},
+                    })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -1,0 +1,210 @@
+"""Prometheus text exposition (format 0.0.4) for the serving stats.
+
+Renders every :class:`~flexflow_tpu.serving.stats.ServingStats`
+counter, gauge, latency window, and histogram under STABLE metric
+names, so standard monitoring can scrape ``GET /metrics`` instead of
+parsing the ad-hoc ``/v2/stats`` JSON. The name scheme (the golden
+test in tests/test_observability.py pins the full rendering, so a
+rename breaks CI instead of dashboards):
+
+  flexflow_serving_requests_total{model,outcome}      counter — one
+      family for all admission/terminal counters (admitted, rejected,
+      expired, completed, failed, cancelled, drafter_errors, ...)
+  flexflow_serving_request_latency_seconds{model}     summary — the
+      end-to-end latency window (rolling-window quantiles + cumulative
+      _sum/_count)
+  flexflow_serving_<window>_seconds{model}            histogram — one
+      family per named observation window: queue_time, ttft, tpot
+  flexflow_serving_<gauge>{model}                     gauge — one
+      family per registered gauge (queue_depth, running, tokens_per_s,
+      cache_occupancy, spec_*, recoveries, watchdog_trips, ...)
+  flexflow_fault_site_calls_total{site}               counter — times
+      each fault-injection site was reached (active plan only)
+  flexflow_fault_site_fires_total{site}               counter — times
+      a rule actually fired at the site
+
+Label values are escaped per the exposition format (backslash, quote,
+newline); metric names are sanitized to ``[a-zA-Z0-9_]``. Rendering is
+deterministic: models, families, and labels are sorted.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, Mapping, Optional
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+_HELP = {
+    "requests_total": "Request outcomes per model (cumulative).",
+    "request_latency_seconds": "End-to-end request latency; quantiles over a rolling window, sum/count cumulative.",
+    "queue_time_seconds": "Accept-to-admission queue wait per request.",
+    "ttft_seconds": "Time to first generated token (accept to first token).",
+    "tpot_seconds": "Mean time per output token after the first.",
+    "queue_depth": "Requests waiting in the admission queue.",
+    "running": "Requests currently occupying engine batch slots.",
+    "tokens_generated": "Total generated tokens (cumulative).",
+    "tokens_per_s": "Generated tokens per second over the trailing window.",
+    "preemptions": "Sequences evicted for recompute under cache pressure.",
+    "cache_blocks_used": "KV-cache blocks currently allocated.",
+    "cache_blocks_total": "KV-cache blocks total.",
+    "cache_occupancy": "Fraction of KV-cache blocks in use.",
+    "recompiles": "XLA retraces beyond the first compile, all programs.",
+    "device_time_s": "Cumulative wall seconds inside device step calls.",
+    "recoveries": "Completed engine restart + journal-replay cycles.",
+    "step_retries": "Failed device steps absorbed by the single step retry.",
+    "replayed_tokens": "Generated tokens recomputed across recoveries.",
+    "quarantined": "Poisoned requests failed alone (batch preserved).",
+    "watchdog_trips": "Stalled device steps detected by the watchdog.",
+    "engine_failures": "Restart budgets exhausted (engine declared dead).",
+    "flexflow_fault_site_calls_total": "Times each fault-injection site was reached (active plan).",
+    "flexflow_fault_site_fires_total": "Times a fault rule fired at the site (active plan).",
+}
+
+
+def escape_label_value(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def sanitize_name(name: str) -> str:
+    return _NAME_RE.sub("_", name)
+
+
+def format_value(v) -> str:
+    """Prometheus sample value: integers bare, floats via repr, and the
+    spec's spellings for the non-finite values."""
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return "NaN"
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _help_type(lines, name: str, kind: str) -> None:
+    short = name[len("flexflow_serving_"):] if name.startswith("flexflow_serving_") else name
+    text = _HELP.get(short, f"flexflow_tpu serving {kind} {short.replace('_', ' ')}.")
+    lines.append(f"# HELP {name} {text}")
+    lines.append(f"# TYPE {name} {kind}")
+
+
+def render_prometheus(
+    models: Mapping[str, "object"],
+    fault_sites: Optional[Dict[str, Dict[str, int]]] = None,
+) -> str:
+    """Render ``{model_name: ServingStats}`` (plus optional fault-site
+    counters from runtime.faults.site_counters()) as exposition text."""
+    lines: list = []
+    names = sorted(models)
+
+    # ------------------------------------------------------------ counters
+    _help_type(lines, "flexflow_serving_requests_total", "counter")
+    for m in names:
+        counts = models[m].counters()
+        for outcome in sorted(counts):
+            lines.append(
+                'flexflow_serving_requests_total{model="%s",outcome="%s"} %s'
+                % (escape_label_value(m), escape_label_value(outcome),
+                   format_value(counts[outcome]))
+            )
+
+    # ----------------------------------------------------- latency summary
+    _help_type(lines, "flexflow_serving_request_latency_seconds", "summary")
+    for m in names:
+        snap = models[m].latency.snapshot()
+        ml = escape_label_value(m)
+        for q, key in (("0.5", "p50_s"), ("0.95", "p95_s"), ("0.99", "p99_s")):
+            lines.append(
+                'flexflow_serving_request_latency_seconds{model="%s",quantile="%s"} %s'
+                % (ml, q, format_value(snap[key]))
+            )
+        # sum/count from the SAME locked snapshot, so ratio consumers
+        # never see a sum that includes an observation count doesn't
+        lines.append(
+            'flexflow_serving_request_latency_seconds_sum{model="%s"} %s'
+            % (ml, format_value(snap["sum_s"]))
+        )
+        lines.append(
+            'flexflow_serving_request_latency_seconds_count{model="%s"} %s'
+            % (ml, format_value(snap["count"]))
+        )
+
+    # ---------------------------------------------------------- histograms
+    # one snapshot pass per model (like gauges below): re-snapshotting
+    # per family would both repeat the locked copies and mix instants
+    # within a single scrape
+    hist_snaps = {m: models[m].histogram_snapshots() for m in names}
+    hist_names = sorted({h for m in names for h in hist_snaps[m]})
+    for hname in hist_names:
+        family = "flexflow_serving_%s_seconds" % sanitize_name(hname)
+        _help_type(lines, family, "histogram")
+        for m in names:
+            snap = hist_snaps[m].get(hname)
+            if snap is None:
+                continue
+            ml = escape_label_value(m)
+            for le, cum in snap["buckets"]:
+                lines.append(
+                    '%s_bucket{model="%s",le="%s"} %s'
+                    % (family, ml,
+                       "+Inf" if math.isinf(le) else format_value(le),
+                       format_value(cum))
+                )
+            lines.append('%s_sum{model="%s"} %s' % (family, ml, format_value(snap["sum"])))
+            lines.append('%s_count{model="%s"} %s' % (family, ml, format_value(snap["count"])))
+
+    # --------------------------------------------------------------- gauges
+    gauge_values = {m: models[m].gauge_values() for m in names}
+    gauge_names = sorted({g for m in names for g in gauge_values[m]})
+    for gname in gauge_names:
+        family = "flexflow_serving_%s" % sanitize_name(gname)
+        _help_type(lines, family, "gauge")
+        for m in names:
+            v = gauge_values[m].get(gname)
+            if v is None:
+                continue  # unregistered here, or the gauge callable died
+            lines.append(
+                '%s{model="%s"} %s'
+                % (family, escape_label_value(m), format_value(v))
+            )
+
+    # ---------------------------------------------------------- fault sites
+    if fault_sites:
+        _help_type(lines, "flexflow_fault_site_calls_total", "counter")
+        for site in sorted(fault_sites):
+            lines.append(
+                'flexflow_fault_site_calls_total{site="%s"} %s'
+                % (escape_label_value(site), format_value(fault_sites[site]["calls"]))
+            )
+        _help_type(lines, "flexflow_fault_site_fires_total", "counter")
+        for site in sorted(fault_sites):
+            lines.append(
+                'flexflow_fault_site_fires_total{site="%s"} %s'
+                % (escape_label_value(site), format_value(fault_sites[site]["fires"]))
+            )
+
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+( [0-9]+)?$'
+)
+
+
+def validate_exposition(text: str) -> list:
+    """Cheap structural validator for the exposition format (used by
+    tools/obsreport.py --selfcheck and the golden test): every line must
+    be a comment, blank, or a well-formed sample. Returns the list of
+    offending lines (empty = valid)."""
+    bad = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        if not _SAMPLE_RE.match(line):
+            bad.append(line)
+    return bad

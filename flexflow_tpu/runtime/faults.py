@@ -89,6 +89,17 @@ def active_plan() -> Optional["FaultPlan"]:
     return _PLAN
 
 
+def site_counters() -> Dict[str, Dict[str, int]]:
+    """Per-site hit counters of the ACTIVE plan ({} when none is
+    installed): ``{site: {"calls": times reached, "fires": rules
+    fired}}``. Rendered on ``GET /metrics`` so chaos runs are visible
+    to the same scrape as the serving counters they perturb."""
+    plan = _PLAN
+    if plan is None:
+        return {}
+    return plan.site_counters()
+
+
 def _poison(value: Any, mask: Any = None) -> Any:
     """NaN-poison array-like leaves of ``value`` (lists/tuples of arrays,
     single arrays, dicts); non-float leaves pass through unchanged.
@@ -211,6 +222,19 @@ class FaultPlan:
     def fired(self, site: str) -> int:
         with self._lock:
             return sum(1 for s, _, _ in self.events if s == site)
+
+    def site_counters(self) -> Dict[str, Dict[str, int]]:
+        """Every site this plan has seen or configured: calls (reached)
+        and fires (a rule actually triggered)."""
+        with self._lock:
+            sites = set(self._counts) | set(self._rules)
+            fires: Dict[str, int] = {}
+            for s, _, _ in self.events:
+                fires[s] = fires.get(s, 0) + 1
+            return {
+                site: {"calls": self._counts.get(site, 0), "fires": fires.get(site, 0)}
+                for site in sorted(sites)
+            }
 
     # ------------------------------------------------------------- firing
     def _rng_for(self, rule: FaultRule) -> random.Random:

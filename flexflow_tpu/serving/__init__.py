@@ -16,7 +16,7 @@ from .resilience import (
     ShuttingDownError,
 )
 from .server import InferenceServer
-from .stats import LatencyWindow, ServingStats, TokenRate
+from .stats import Histogram, LatencyWindow, ServingStats, TokenRate
 
 __all__ = [
     "CircuitBreaker",
@@ -25,6 +25,7 @@ __all__ = [
     "DynamicBatcher",
     "GenerationModel",
     "GrpcInferenceServer",
+    "Histogram",
     "InferenceModel",
     "InferenceServer",
     "LatencyWindow",

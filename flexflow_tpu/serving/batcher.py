@@ -40,6 +40,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import RequestTrace, TraceRing, next_request_id
 from ..runtime import faults
 from .model import InferenceModel
 from .resilience import (
@@ -53,7 +54,7 @@ from .resilience import (
 
 
 class _Request:
-    __slots__ = ("inputs", "future", "n", "deadline", "t_submit")
+    __slots__ = ("inputs", "future", "n", "deadline", "t_submit", "trace")
 
     def __init__(
         self,
@@ -66,6 +67,7 @@ class _Request:
         self.n = inputs[0].shape[0]
         self.deadline = deadline  # absolute, on the batcher's clock
         self.t_submit = t_submit  # for the latency stats
+        self.trace: Optional[RequestTrace] = None  # set by submit()
 
 
 def make_batcher(model: InferenceModel, kwargs: dict) -> "DynamicBatcher":
@@ -111,6 +113,10 @@ class DynamicBatcher:
 
         self.stats = ServingStats()
         self.stats.add_gauge("queue_depth", lambda: self._q.qsize())
+        # per-request traces (accept -> dispatch -> finish) for the
+        # batched-inference path; finished traces land here and on
+        # GET /v2/debug/traces next to the generation traces
+        self.trace_ring = TraceRing(64)
         # unbounded Queue; the bound is enforced in submit() via qsize so
         # control sentinels can never block behind a full queue
         self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
@@ -194,11 +200,17 @@ class DynamicBatcher:
         return self._running and not self._draining and self.breaker.ready()
 
     # ------------------------------------------------------------- submit
-    def submit(self, inputs: Sequence[np.ndarray], deadline_s: Optional[float] = None) -> Future:
+    def submit(
+        self,
+        inputs: Sequence[np.ndarray],
+        deadline_s: Optional[float] = None,
+        transport: Optional[str] = None,
+    ) -> Future:
         """Enqueue one request (batch <= max_batch); returns a Future of
         the output list. ``deadline_s`` is this request's latency budget:
         if it expires before the request reaches the device, the request
-        fails with DeadlineExceededError instead of wasting batch space."""
+        fails with DeadlineExceededError instead of wasting batch space.
+        ``transport`` annotates the request's trace ("http"/"grpc")."""
         # draining outranks stopped: a wedged drain leaves _running False
         # with _draining set, and those submits must stay 503, not 500
         if self._draining:
@@ -233,6 +245,14 @@ class DynamicBatcher:
             raise CircuitOpenError(f"model {self.model.name!r}: circuit open")
         deadline = None if deadline_s is None else self.clock() + deadline_s
         req = _Request(arrays, deadline=deadline, t_submit=self.clock())
+        # ids come from the process-wide obs counter shared with the
+        # generation path, so /v2/debug/traces?id=N is unambiguous
+        req.trace = RequestTrace(
+            next_request_id(), clock=self.clock, model=self.model.name
+        )
+        req.trace.mark_accept(batch=n, deadline_s=deadline_s)
+        if transport is not None:
+            req.trace.mark_transport(transport)
         self.stats.incr("admitted")
         self._q.put(req)
         # close the submit/stop race: if stop() ran to completion between
@@ -259,6 +279,12 @@ class DynamicBatcher:
             raise
 
     # ------------------------------------------------------------ internals
+    def _trace_done(self, req: _Request, outcome: str, err=None) -> None:
+        if req.trace is None:
+            return
+        req.trace.mark_finish(outcome, err)
+        self.trace_ring.add(req.trace)
+
     def _admit(self, req: _Request) -> bool:
         """Called once when a request is pulled for batching. Drops
         abandoned (cancelled/already-failed) requests and fails expired
@@ -272,16 +298,22 @@ class DynamicBatcher:
         if req.deadline is not None and self.clock() >= req.deadline:
             if not req.future.done():
                 self.stats.incr("expired")
-                req.future.set_exception(
-                    DeadlineExceededError("deadline expired before dispatch")
-                )
+                err = DeadlineExceededError("deadline expired before dispatch")
+                # trace closes BEFORE the future settles: the client
+                # thread wakes on set_exception and may read the trace
+                self._trace_done(req, "DeadlineExceededError", err)
+                req.future.set_exception(err)
             return False
         # flips PENDING->RUNNING so infer()-timeout cancels can no longer
         # race with result scatter; returns False if already cancelled
         try:
-            return req.future.set_running_or_notify_cancel()
+            admitted = req.future.set_running_or_notify_cancel()
         except RuntimeError:  # FINISHED in the window since the check above
             return False
+        if admitted and req.trace is not None:
+            req.trace.mark_admit()
+            self.stats.observe("queue_time", max(0.0, self.clock() - req.t_submit))
+        return admitted
 
     def _collect(self) -> List[_Request]:
         """Block for the first live request, then drain until the batch
@@ -350,6 +382,7 @@ class DynamicBatcher:
                 r = batch[0]
                 if not r.future.done():
                     self.stats.incr("failed")
+                    self._trace_done(r, type(e).__name__, e)
                     r.future.set_exception(e)
             return
         self.breaker.record_success()
@@ -357,9 +390,10 @@ class DynamicBatcher:
         now = self.clock()
         for r in batch:
             if not r.future.done():
-                r.future.set_result([o[off : off + r.n] for o in outs])
                 self.stats.incr("completed")
                 self.stats.latency.record(max(0.0, now - r.t_submit))
+                self._trace_done(r, "completed")
+                r.future.set_result([o[off : off + r.n] for o in outs])
             off += r.n
 
     def _loop(self):
@@ -377,9 +411,9 @@ class DynamicBatcher:
                 if r.deadline is not None and now >= r.deadline:
                     if not r.future.done():
                         self.stats.incr("expired")
-                        r.future.set_exception(
-                            DeadlineExceededError("deadline expired before dispatch")
-                        )
+                        err = DeadlineExceededError("deadline expired before dispatch")
+                        self._trace_done(r, "DeadlineExceededError", err)
+                        r.future.set_exception(err)
                 else:
                     live.append(r)
             if not live:

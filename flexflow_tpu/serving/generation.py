@@ -67,6 +67,16 @@ class GenerationModel:
     def recovery_stats(self):
         return self.scheduler.recovery_stats
 
+    @property
+    def trace_ring(self):
+        """Recently finished RequestTraces (GET /v2/debug/traces)."""
+        return self.scheduler.trace_ring
+
+    @property
+    def flight(self):
+        """The engine flight recorder (GET /v2/debug/timeline)."""
+        return self.scheduler.flight
+
     # --------------------------------------------------------------- run
     def submit(
         self,
@@ -74,9 +84,11 @@ class GenerationModel:
         sampling: Optional[SamplingParams] = None,
         deadline_s: Optional[float] = None,
         speculation: Optional[SpeculationConfig] = None,
+        transport: Optional[str] = None,
     ) -> GenerationHandle:
         return self.scheduler.submit(
-            prompt, sampling, deadline_s=deadline_s, speculation=speculation
+            prompt, sampling, deadline_s=deadline_s, speculation=speculation,
+            transport=transport,
         )
 
     def generate(
@@ -139,6 +151,12 @@ class GenerationModel:
                 "watchdog_enabled": wd.policy.enabled,
                 "stall_timeout_s": wd.policy.stall_timeout_s,
                 "engine_resets": self.engine.resets,
+            },
+            "observability": {
+                "enabled": self.scheduler.obs_enabled,
+                "trace_ring": self.scheduler.trace_ring.capacity,
+                "flight_capacity": self.scheduler.flight.capacity,
+                "progress_every": self.scheduler.trace_progress_every,
             },
             "max_batch_slots": self.engine.max_batch_slots,
             "max_spec_tokens": self.engine.max_spec_tokens,

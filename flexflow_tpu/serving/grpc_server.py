@@ -335,7 +335,7 @@ class GrpcInferenceServer:
             # propagate the client's gRPC deadline into the batcher so a
             # request that expires while queued never reaches the device
             remaining = context.time_remaining()
-            fut = batcher.submit(arrays, deadline_s=remaining)
+            fut = batcher.submit(arrays, deadline_s=remaining, transport="grpc")
         except ResilienceError as e:  # backpressure/deadline/breaker/drain
             self._abort(context, grpc_code(e, grpc), str(e))
         except RuntimeError as e:  # batcher stopped
@@ -405,7 +405,9 @@ class GrpcInferenceServer:
                 params[key] = getattr(p, kind) if kind else None
             sampling = gen.sampling_from(params)
             remaining = context.time_remaining()
-            handle = gen.submit(prompt, sampling, deadline_s=remaining)
+            handle = gen.submit(
+                prompt, sampling, deadline_s=remaining, transport="grpc"
+            )
         except ResilienceError as e:
             self._abort(context, grpc_code(e, grpc), str(e))
         except Exception as e:

@@ -16,6 +16,17 @@ API. This server implements the same surface directly (stdlib only):
                                               self-healing counters:
                                               recoveries, replayed_tokens,
                                               quarantined, watchdog_trips)
+  GET  /metrics                            -> Prometheus text exposition:
+                                              every per-model counter,
+                                              gauge, latency window and
+                                              the TTFT/TPOT/queue-time
+                                              histograms (obs/prom.py)
+  GET  /v2/debug/traces[?id=N&model=M&n=K] -> recent per-request traces
+                                              (queue time, TTFT, TPOT,
+                                              event waterfall)
+  GET  /v2/debug/timeline[?model=M]        -> engine flight recorder as
+                                              chrome://tracing JSON
+                                              (+ recent incident dumps)
   GET  /v2/models/{name}                   -> model metadata
   GET  /v2/models/{name}/ready             -> per-model readiness
   POST /v2/models/{name}/infer             -> run inference
@@ -23,6 +34,11 @@ API. This server implements the same surface directly (stdlib only):
                                               (GenerationModel); JSON
                                               response, or SSE token
                                               stream with "stream": true
+
+Failed generation requests embed their RequestTrace (and, for
+quarantines/restarts, the flight-recorder snapshot riding the error) in
+the error response body — the client holds the postmortem without a
+second round trip.
 
 Infer request JSON: {"inputs": [{"name", "shape", "datatype", "data"}]},
 response mirrors it — the v2 tensor format with row-major flat data. A
@@ -40,9 +56,12 @@ import threading
 from concurrent.futures import TimeoutError as _FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from ..obs import render_prometheus
+from ..runtime import faults
 from .batcher import DynamicBatcher, make_batcher
 from .model import InferenceModel
 from .resilience import ResilienceError, http_status
@@ -154,6 +173,68 @@ class InferenceServer:
             },
         }
 
+    # ------------------------------------------------------ observability
+    def _all_stats(self) -> Dict:
+        """model name -> ServingStats across both serving paths (the
+        /metrics scrape set). Snapshots the dicts: repository load/
+        unload mutates them concurrently."""
+        out = {n: b.stats for n, b in list(self.batchers.items())}
+        out.update({n: g.stats for n, g in list(self.generators.items())})
+        return out
+
+    def metrics_text(self) -> str:
+        return render_prometheus(self._all_stats(), fault_sites=faults.site_counters())
+
+    def debug_traces(
+        self,
+        request_id: Optional[int] = None,
+        model: Optional[str] = None,
+        n: int = 32,
+    ) -> Dict:
+        """Recent finished request traces, most recent first, across the
+        generation schedulers and the dynamic batchers."""
+        rings = []
+        for name, g in list(self.generators.items()):
+            if model is None or name == model:
+                rings.append((name, g.trace_ring))
+        for name, b in list(self.batchers.items()):
+            if model is None or name == model:
+                rings.append((name, b.trace_ring))
+        traces = []
+        for name, ring in rings:
+            if request_id is not None:
+                tr = ring.get(request_id)
+                if tr is not None:
+                    d = tr.to_dict()
+                    d["model"] = d["model"] or name
+                    traces.append(d)
+                continue
+            for tr in ring.recent(n):
+                d = tr.to_dict()
+                d["model"] = d["model"] or name
+                traces.append(d)
+        traces.sort(key=lambda d: d.get("t_finish") or 0, reverse=True)
+        return {"traces": traces[:n]}
+
+    def debug_timeline(self, model: Optional[str] = None) -> Dict:
+        """Flight-recorder dump as chrome://tracing JSON (one pid per
+        generation model), plus the recent incident snapshots under a
+        non-standard ``incidents`` key chrome ignores."""
+        events, incidents = [], []
+        for pid, (name, g) in enumerate(sorted(self.generators.items()), start=1):
+            if model is not None and name != model:
+                continue
+            trace = g.flight.to_chrome_trace(pid=pid, name=name)
+            events.extend(trace["traceEvents"])
+            incidents.extend(
+                {**inc, "model": name} for inc in list(g.flight.incidents)
+            )
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "incidents": incidents,
+        }
+
     # ------------------------------------------------------------ control
     def start(self):
         server = self
@@ -166,6 +247,14 @@ class InferenceServer:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _text(self, code: int, text: str, content_type: str):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -198,20 +287,45 @@ class InferenceServer:
                 return self._json(404, {"error": "not found"})
 
             def do_GET(self):
-                if self.path == "/v2/health/live":
+                url = urlparse(self.path)
+                path, query = url.path, parse_qs(url.query)
+
+                def qint(key):
+                    try:
+                        return int(query[key][0])
+                    except (KeyError, IndexError, ValueError):
+                        return None
+
+                if path == "/v2/health/live":
                     return self._json(200, {"live": server.live()})
-                if self.path == "/v2/health/ready":
+                if path == "/v2/health/ready":
                     ok = server.ready()
                     return self._json(200 if ok else 503, {"ready": ok})
-                if self.path == "/v2/stats":
+                if path == "/v2/stats":
                     return self._json(200, server.stats())
-                if self.path == "/v2/models":
+                if path == "/metrics":
+                    try:
+                        text = server.metrics_text()
+                    except Exception as e:  # a scrape must fail loudly, not 200-empty
+                        return self._json(500, {"error": str(e)})
+                    return self._text(200, text, "text/plain; version=0.0.4; charset=utf-8")
+                if path == "/v2/debug/traces":
+                    return self._json(200, server.debug_traces(
+                        request_id=qint("id"),
+                        model=(query.get("model") or [None])[0],
+                        n=qint("n") or 32,
+                    ))
+                if path == "/v2/debug/timeline":
+                    return self._json(200, server.debug_timeline(
+                        model=(query.get("model") or [None])[0]
+                    ))
+                if path == "/v2/models":
                     return self._json(
                         200,
                         {"models": sorted(set(server.models) | set(server.generators))},
                     )
-                if self.path.startswith("/v2/models/"):
-                    parts = self.path.split("/")
+                if path.startswith("/v2/models/"):
+                    parts = path.split("/")
                     name = parts[3]
                     m = server.models.get(name) or server.generators.get(name)
                     if m is None:
@@ -246,23 +360,38 @@ class InferenceServer:
                     deadline_s = None if timeout_ms is None else float(timeout_ms) / 1000.0
                     speculation = gen.speculation_from(req)
                     handle = gen.submit(
-                        prompt, sampling, deadline_s=deadline_s, speculation=speculation
+                        prompt, sampling, deadline_s=deadline_s,
+                        speculation=speculation, transport="http",
                     )
                 except ResilienceError as e:
                     return self._json(http_status(e), {"error": str(e)})
                 except Exception as e:
                     return self._json(400, {"error": str(e)})
+
+                def error_payload(e):
+                    """Failed generations ship their postmortem: the
+                    request's trace, and (quarantine/engine-failure) the
+                    flight-recorder snapshot riding the exception."""
+                    payload = {"error": str(e), "type": type(e).__name__}
+                    tr = handle.trace_dict()
+                    if tr:
+                        payload["trace"] = tr
+                    flight = getattr(e, "flight_snapshot", None)
+                    if flight:
+                        payload["flight"] = flight
+                    return payload
+
                 wait = deadline_s if deadline_s is not None else 300.0
                 if not stream:
                     try:
                         tokens = handle.result(timeout=wait)
                     except ResilienceError as e:
-                        return self._json(http_status(e), {"error": str(e)})
+                        return self._json(http_status(e), error_payload(e))
                     except (TimeoutError, _FuturesTimeout):
                         handle.cancel()
                         return self._json(504, {"error": "generation timed out"})
                     except Exception as e:
-                        return self._json(500, {"error": str(e)})
+                        return self._json(500, error_payload(e))
                     return self._json(
                         200, {"model_name": name, "tokens": tokens, "num_generated": len(tokens)}
                     )
@@ -287,7 +416,7 @@ class InferenceServer:
                 except Exception as e:
                     handle.cancel()
                     try:
-                        event({"error": str(e), "done": True})
+                        event({**error_payload(e), "done": True})
                     except OSError:
                         pass  # client went away mid-stream
 
@@ -323,7 +452,7 @@ class InferenceServer:
                             raise ValueError(f"missing input {meta.name}")
                         dt = _V2_DTYPES.get(t.get("datatype", "FP32"), np.float32)
                         arrays.append(np.asarray(t["data"], dtype=dt).reshape(t["shape"]))
-                    fut = batcher.submit(arrays, deadline_s=deadline_s)
+                    fut = batcher.submit(arrays, deadline_s=deadline_s, transport="http")
                 except ResilienceError as e:  # backpressure/deadline/breaker/drain
                     return self._json(http_status(e), {"error": str(e)})
                 except RuntimeError as e:  # batcher stopped: server-side

@@ -14,9 +14,11 @@ snapshots are consistent-enough reads for monitoring, not transactions.
 """
 from __future__ import annotations
 
+import bisect
+import math
 import threading
 from collections import deque
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 
 class LatencyWindow:
@@ -42,9 +44,13 @@ class LatencyWindow:
         with self._lock:
             window = sorted(self._window)
             n = len(window)
-            pct = lambda p: window[min(n - 1, int(p * n))] if n else 0.0
+            # nearest-rank: ceil(p*n) is the 1-based rank of the p-th
+            # percentile sample (int(p*n) biased high on small windows:
+            # p50 of 2 samples returned the max)
+            pct = lambda p: window[min(n - 1, math.ceil(p * n) - 1)] if n else 0.0
             return {
                 "count": self.count,
+                "sum_s": self.total_s,
                 "mean_s": self.total_s / self.count if self.count else 0.0,
                 "max_s": self.max_s,
                 "p50_s": pct(0.50),
@@ -53,8 +59,54 @@ class LatencyWindow:
             }
 
 
+class Histogram:
+    """Fixed-bucket latency histogram in the Prometheus shape:
+    cumulative bucket counts keyed by upper bound (``le``), plus running
+    sum and count. Buckets are chosen once (seconds, spanning sub-ms
+    TTFT on warm engines to multi-second cold paths); observations are
+    a bisect + three increments under a lock."""
+
+    DEFAULT_BUCKETS: Tuple[float, ...] = (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+        0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    )
+
+    def __init__(self, buckets: Optional[Sequence[float]] = None):
+        bounds = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
+        if not bounds or bounds[-1] != math.inf:
+            bounds = bounds + (math.inf,)
+        self.bounds = bounds
+        self._lock = threading.Lock()
+        self._counts = [0] * len(bounds)  # per-bucket (non-cumulative)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        # bucket i is the first bound >= value (the last bound is +Inf,
+        # so the index always lands in range)
+        i = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            self._counts[i] += 1
+
+    def snapshot(self) -> Dict:
+        """Cumulative (le, count) pairs the exposition format wants."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self.count, self.sum
+        cum, buckets = 0, []
+        for b, c in zip(self.bounds, counts):
+            cum += c
+            buckets.append((b, cum))
+        return {"count": total, "sum": s, "buckets": buckets}
+
+
 class ServingStats:
-    """Counters + latency + live gauges for one served model."""
+    """Counters + latency windows + histograms + live gauges for one
+    served model. ``observe(name, s)`` feeds a named window (rolling
+    percentiles on /v2/stats) AND a Prometheus histogram (/metrics)
+    under the same name — queue_time / ttft / tpot in generation."""
 
     COUNTERS = ("admitted", "rejected", "expired", "completed", "failed", "cancelled")
 
@@ -62,9 +114,14 @@ class ServingStats:
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {c: 0 for c in self.COUNTERS}
         self.latency = LatencyWindow(latency_window)
+        self._window_len = latency_window
         # name -> zero-arg callable returning a number (queue depth,
-        # cache occupancy, tokens/s ...), evaluated at snapshot time
+        # cache occupancy, tokens/s ...), evaluated at snapshot time.
+        # Registration and iteration share self._lock: a model loading
+        # mid-scrape must not mutate the dict under snapshot()'s feet.
         self.gauges: Dict[str, Callable[[], float]] = {}
+        self._windows: Dict[str, LatencyWindow] = {}
+        self._histograms: Dict[str, Histogram] = {}
 
     def incr(self, counter: str, n: int = 1) -> None:
         with self._lock:
@@ -74,19 +131,55 @@ class ServingStats:
         with self._lock:
             return self._counts.get(counter, 0)
 
-    def add_gauge(self, name: str, fn: Callable[[], float]) -> None:
-        self.gauges[name] = fn
-
-    def snapshot(self) -> Dict:
+    def counters(self) -> Dict[str, int]:
         with self._lock:
-            counts = dict(self._counts)
-        out: Dict = dict(counts)
-        out["latency"] = self.latency.snapshot()
-        for name, fn in self.gauges.items():
+            return dict(self._counts)
+
+    def add_gauge(self, name: str, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self.gauges[name] = fn
+
+    def observe(self, name: str, seconds: float) -> None:
+        """Record one observation into the named window + histogram
+        (created on first use)."""
+        with self._lock:
+            w = self._windows.get(name)
+            if w is None:
+                w = self._windows[name] = LatencyWindow(self._window_len)
+                self._histograms[name] = Histogram()
+            h = self._histograms[name]
+        w.record(seconds)
+        h.observe(seconds)
+
+    def window_snapshots(self) -> Dict[str, Dict]:
+        with self._lock:
+            windows = dict(self._windows)
+        return {name: w.snapshot() for name, w in windows.items()}
+
+    def histogram_snapshots(self) -> Dict[str, Dict]:
+        with self._lock:
+            hists = dict(self._histograms)
+        return {name: h.snapshot() for name, h in hists.items()}
+
+    def gauge_values(self) -> Dict[str, Optional[float]]:
+        """Evaluate every gauge (None for a dying gauge) — the shared
+        read path for /v2/stats and /metrics."""
+        with self._lock:
+            gauges = list(self.gauges.items())
+        out: Dict[str, Optional[float]] = {}
+        for name, fn in gauges:
             try:
                 out[name] = fn()
-            except Exception:  # a dying gauge must not kill /v2/stats
+            except Exception:  # a dying gauge must not kill a scrape
                 out[name] = None
+        return out
+
+    def snapshot(self) -> Dict:
+        out: Dict = dict(self.counters())
+        out["latency"] = self.latency.snapshot()
+        for name, snap in self.window_snapshots().items():
+            out[name] = snap
+        out.update(self.gauge_values())
         return out
 
 

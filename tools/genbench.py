@@ -27,10 +27,19 @@ tokens-per-engine-step speedup vs the baseline. The retrace guard
 extends to the verify program (exactly one compile), and the run fails
 below ``--min-speedup`` (default 1.5x).
 
+``--trace-out FILE`` benchmarks the OBSERVABILITY layer instead: the
+same steady-state request stream runs with tracing disabled and enabled
+(interleaved, best-of-``--trace-repeats``), asserting that per-request
+traces + the flight recorder cost < ``--max-trace-overhead`` (default
+3%) of decode throughput and add ZERO retraces; the file receives the
+overhead report, the flight-recorder chrome://tracing dump, and a
+sample request trace.
+
 Usage:
   python tools/genbench.py [--out genbench.json] [--requests 12]
       [--max-new 16] [--layers 2] [--hidden 64] [--heads 4] [--vocab 128]
       [--speculate] [--spec-k 4] [--min-speedup 1.5]
+      [--trace-out trace.json] [--max-trace-overhead 0.03]
 """
 from __future__ import annotations
 
@@ -198,6 +207,100 @@ def speculate_bench(args, cfg, params) -> tuple:
     return report, ok
 
 
+def trace_overhead_bench(args, cfg, params) -> tuple:
+    """Tracing-overhead guard: the same steady-state stream with
+    observability off vs on, interleaved best-of-N. Returns
+    (report dict, ok bool)."""
+    rs = np.random.RandomState(0)
+    lengths = [int(rs.randint(4, args.seq_len - args.max_new)) for _ in range(args.requests)]
+    prompts = [rs.randint(0, args.vocab, n).tolist() for n in lengths]
+    sampling = SamplingParams(max_new_tokens=args.max_new)
+
+    engine = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16)
+    # warm every bucket + the decode program: the measured streams must
+    # be pure steady state or compile time drowns the comparison
+    engine.generate([prompts[0]], SamplingParams(max_new_tokens=2))
+    for b in sorted({engine.bucket_for(n) for n in lengths}):
+        engine.generate([[1] * min(b, args.seq_len - 2)], SamplingParams(max_new_tokens=1))
+    traces_after_warmup = dict(engine.trace_counts)
+
+    def one_run(observability: bool):
+        sched = ContinuousBatchingScheduler(engine, observability=observability)
+        t0 = time.perf_counter()
+        handles = [sched.submit(p, sampling) for p in prompts]
+        while any(not h.done() for h in handles):
+            if not sched.step():
+                break
+        elapsed = time.perf_counter() - t0
+        outs = [h.result(timeout=0) for h in handles]
+        return elapsed, outs, sched
+
+    # interleave so drift (thermal, other load) hits both arms equally;
+    # best-of-N is the standard noise-robust wall-clock estimator. A
+    # reading over budget escalates once with doubled repeats before
+    # failing: the overhead under test is ~2%, well inside one noisy
+    # scheduler quantum on a loaded host
+    plain_s, traced_s = [], []
+    outs_plain = outs_traced = None
+    traced_sched = None
+
+    def measure(repeats):
+        nonlocal outs_plain, outs_traced, traced_sched
+        for _ in range(repeats):
+            e, outs_plain, _s = one_run(observability=False)
+            plain_s.append(e)
+            e, outs_traced, traced_sched = one_run(observability=True)
+            traced_s.append(e)
+        return min(traced_s) / max(min(plain_s), 1e-9) - 1.0
+
+    overhead = measure(args.trace_repeats)
+    if overhead > args.max_trace_overhead:
+        overhead = measure(args.trace_repeats * 2)
+    steady_retraces = {
+        k: engine.trace_counts[k] - traces_after_warmup.get(k, 0)
+        for k in engine.trace_counts
+        if engine.trace_counts[k] - traces_after_warmup.get(k, 0) > 0
+    }
+    sample = traced_sched.trace_ring.recent(1)
+    report = {
+        "requests": args.requests,
+        "generated_tokens": sum(len(o) for o in outs_traced),
+        "repeats": args.trace_repeats,
+        "untraced_best_s": round(min(plain_s), 4),
+        "traced_best_s": round(min(traced_s), 4),
+        "untraced_runs_s": [round(x, 4) for x in plain_s],
+        "traced_runs_s": [round(x, 4) for x in traced_s],
+        "tracing_overhead": round(overhead, 4),
+        "max_trace_overhead": args.max_trace_overhead,
+        "steady_state_retraces": steady_retraces,
+        "flight_records": len(traced_sched.flight.snapshot()),
+        "backend": jax.default_backend(),
+    }
+    ok = True
+    if outs_plain != outs_traced:
+        print("FAIL: tracing changed the generated streams", file=sys.stderr)
+        ok = False
+    if steady_retraces:
+        print(f"FAIL: tracing run retraced: {steady_retraces}", file=sys.stderr)
+        ok = False
+    if overhead > args.max_trace_overhead:
+        print(
+            f"FAIL: tracing overhead {overhead * 100:.2f}% > "
+            f"{args.max_trace_overhead * 100:.1f}% budget",
+            file=sys.stderr,
+        )
+        ok = False
+    payload = {
+        "report": report,
+        "timeline": traced_sched.flight.to_chrome_trace(),
+        "sample_trace": sample[0].to_dict() if sample else None,
+    }
+    with open(args.trace_out, "w") as f:
+        json.dump(payload, f, indent=2)
+    print(json.dumps(report, indent=2))
+    return report, ok
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="")
@@ -214,6 +317,11 @@ def main() -> int:
                     help="benchmark n-gram speculative decoding vs baseline")
     ap.add_argument("--spec-k", type=int, default=4)
     ap.add_argument("--min-speedup", type=float, default=1.5)
+    ap.add_argument("--trace-out", default="",
+                    help="benchmark tracing overhead; write report + "
+                         "chrome timeline + sample trace to this file")
+    ap.add_argument("--max-trace-overhead", type=float, default=0.03)
+    ap.add_argument("--trace-repeats", type=int, default=3)
     args = ap.parse_args()
     args.max_new_set = args.max_new is not None
     if args.max_new is None:
@@ -225,6 +333,16 @@ def main() -> int:
         causal=True,
     )
     params = init_decoder_params(jax.random.key(0), cfg)
+
+    if args.trace_out:
+        report, ok = trace_overhead_bench(args, cfg, params)
+        if not ok:
+            return 1
+        print(
+            f"OK: tracing overhead {report['tracing_overhead'] * 100:.2f}% "
+            f"(< {args.max_trace_overhead * 100:.1f}%), zero additional retraces"
+        )
+        return 0
 
     if args.speculate:
         report, ok = speculate_bench(args, cfg, params)
