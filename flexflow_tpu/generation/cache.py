@@ -145,12 +145,26 @@ class KVCache:
 class BlockAllocator:
     """Host-side free list over the cache's blocks. Thread-safe: the
     scheduler's admission path and the serving layer's cancellation path
-    may free concurrently. Block 0 (scratch) is never handed out."""
+    may free concurrently. Block 0 (scratch) is never handed out.
+
+    Telemetry (obs/capacity.py reads these; all maintained under the
+    existing lock so they cost a few integer ops): cumulative
+    ``total_allocated`` / ``total_freed`` block counts,
+    ``total_reset_reclaimed`` (blocks reclaimed wholesale by
+    :meth:`reset` — NOT counted in ``total_freed``, so conservation is
+    ``total_allocated == total_freed + total_reset_reclaimed +
+    outstanding``), and free-list ``low_water`` / ``high_water`` marks.
+    """
 
     def __init__(self, config: CacheConfig):
         self.config = config
         self._lock = threading.Lock()
         self._free: List[int] = list(range(config.num_blocks - 1, 0, -1))
+        self.total_allocated = 0
+        self.total_freed = 0
+        self.total_reset_reclaimed = 0
+        self.low_water = len(self._free)
+        self.high_water = len(self._free)
 
     def reset(self) -> None:
         """Restore the full free list (engine crash recovery): every
@@ -158,7 +172,10 @@ class BlockAllocator:
         frees — which would double-free against the fresh list — must
         not follow."""
         with self._lock:
+            outstanding = (self.config.num_blocks - 1) - len(self._free)
+            self.total_reset_reclaimed += outstanding
             self._free = list(range(self.config.num_blocks - 1, 0, -1))
+            self.high_water = len(self._free)
 
     @property
     def num_free(self) -> int:
@@ -180,6 +197,9 @@ class BlockAllocator:
             if len(self._free) < n:
                 return None
             taken, self._free = self._free[:n], self._free[n:]
+            self.total_allocated += n
+            if len(self._free) < self.low_water:
+                self.low_water = len(self._free)
             return taken
 
     def free(self, blocks: List[int]) -> None:
@@ -190,6 +210,9 @@ class BlockAllocator:
                 if b in self._free:
                     raise ValueError(f"double free of block {b}")
                 self._free.append(b)
+            self.total_freed += len(blocks)
+            if len(self._free) > self.high_water:
+                self.high_water = len(self._free)
 
 
 def slot_mapping(

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import TransformerConfig
+from ..obs.capacity import ProgramRegistry, ServingFlops
 from ..runtime import faults
 from .cache import BlockAllocator, CacheConfig, KVCache, slot_mapping
 from .decoder import DecoderParams, decode_step, prefill, verify_step
@@ -169,6 +170,17 @@ class GenerationEngine:
         # cumulative wall seconds inside each step kind's host API call
         # (dispatch + device + result sync) — the device_time_s gauge
         self.device_time_s: Dict[str, float] = {"prefill": 0.0, "decode": 0.0, "verify": 0.0}
+        # serving FLOPs accounting (obs/capacity.py): model-shaped FLOPs
+        # per step kind — true prompt lengths and live context only, so
+        # MFU = flops / device_time_s / chip peak is padding-honest.
+        # Recovery replay / bisection probes accrue in BOTH terms (they
+        # are real device work); goodput_ratio is the client-useful view
+        self.flops_model = ServingFlops.from_config(cfg, dtype=cache_config.dtype)
+        self.flops_by_kind: Dict[str, float] = {"prefill": 0.0, "decode": 0.0, "verify": 0.0}
+        # jit program registry: every traced program's static signature,
+        # trace count, and compile wall time; retraces carry blame
+        # strings (GET /v2/debug/programs)
+        self.programs = ProgramRegistry()
         # per-slot finiteness of the last step's logits (the supervisor's
         # NaN blame vector: a cheap in-jit isfinite reduce, so a poisoned
         # request is pinned to its slot without extra device calls);
@@ -210,6 +222,11 @@ class GenerationEngine:
     def _prefill_impl(self, params, tokens, length, cache_k, cache_v, block_table, temp, top_k, key):
         s = tokens.shape[1]
         self.trace_counts[f"prefill[{s}]"] = self.trace_counts.get(f"prefill[{s}]", 0) + 1
+        self.programs.note_trace(f"prefill[{s}]", {
+            "params": params, "tokens": tokens, "length": length,
+            "cache_k": cache_k, "block_table": block_table,
+            "temp": temp, "top_k": top_k, "key": key,
+        })
         nb, bs = cache_k.shape[1], cache_k.shape[2]
         logits, ks, vs = prefill(params, tokens, jnp.full((1,), length, jnp.int32))
         positions = jnp.arange(s, dtype=jnp.int32)
@@ -231,6 +248,12 @@ class GenerationEngine:
         self, params, tokens, positions, cache_k, cache_v, block_tables, context_lens, temps, top_ks, bias, keys
     ):
         self.trace_counts["decode"] = self.trace_counts.get("decode", 0) + 1
+        self.programs.note_trace("decode", {
+            "params": params, "tokens": tokens, "positions": positions,
+            "cache_k": cache_k, "block_tables": block_tables,
+            "context_lens": context_lens, "temps": temps, "top_ks": top_ks,
+            "bias": bias, "keys": keys,
+        })
         logits, cache_k, cache_v = decode_step(
             params, tokens, positions, cache_k, cache_v, block_tables,
             context_lens, backend=self.backend,
@@ -252,6 +275,12 @@ class GenerationEngine:
         from .speculative.sampling import speculative_accept
 
         self.trace_counts["verify"] = self.trace_counts.get("verify", 0) + 1
+        self.programs.note_trace("verify", {
+            "params": params, "tokens": tokens, "start": start,
+            "n_draft": n_draft, "cache_k": cache_k,
+            "block_tables": block_tables, "temps": temps, "top_ks": top_ks,
+            "bias": bias, "keys": keys,
+        })
         w = tokens.shape[1]
         offs = jnp.arange(w, dtype=jnp.int32)[None, :]
         # window token j sits at cache position start + j; slots past the
@@ -290,6 +319,7 @@ class GenerationEngine:
         t0 = time.perf_counter()
         n = len(prompt)
         bucket = self.bucket_for(n)
+        traces_before = self.trace_counts.get(f"prefill[{bucket}]", 0)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = prompt
         table = np.zeros((self.max_blocks_per_seq,), np.int32)
@@ -308,7 +338,16 @@ class GenerationEngine:
         self.cache.update(ck, cv)
         self.last_finite = np.asarray(ok).reshape(1)
         out = int(token)  # forces the result sync before the clock stops
-        self.device_time_s["prefill"] += time.perf_counter() - t0
+        elapsed = time.perf_counter() - t0
+        # FLOPs accrue only on SUCCESS, next to the time they pair with:
+        # a step that raises (and is retried by the supervisor) must not
+        # count its FLOPs without its time, or MFU inflates under faults
+        self.flops_by_kind["prefill"] += self.flops_model.prefill_flops(n)
+        self.device_time_s["prefill"] += elapsed
+        if self.trace_counts.get(f"prefill[{bucket}]", 0) > traces_before:
+            # this call traced (first compile or a retrace): its wall
+            # time is the program's compile cost, registry-stamped
+            self.programs.set_compile_time(f"prefill[{bucket}]", elapsed)
         return out
 
     def decode(
@@ -330,6 +369,7 @@ class GenerationEngine:
         masked, bias = faults.inject("generation.decode_step", (masked, self._zero_bias))
         self.step_counts["decode"] += 1
         t0 = time.perf_counter()
+        traces_before = self.trace_counts.get("decode", 0)
         context_lens = np.where(active, positions + 1, 0).astype(np.int32)
         safe_pos = np.where(active, positions, 0).astype(np.int32)
         # scratch-mask inactive slots' tables too: an inactive slot with
@@ -353,7 +393,14 @@ class GenerationEngine:
         self.cache.update(ck, cv)
         self.last_finite = np.asarray(ok)
         result = np.asarray(out)  # result sync included in the timing
-        self.device_time_s["decode"] += time.perf_counter() - t0
+        elapsed = time.perf_counter() - t0
+        # success-only, paired with the time below (see prefill())
+        self.flops_by_kind["decode"] += self.flops_model.decode_flops(
+            int(active.sum()), int(context_lens.sum())
+        )
+        self.device_time_s["decode"] += elapsed
+        if self.trace_counts.get("decode", 0) > traces_before:
+            self.programs.set_compile_time("decode", elapsed)
         return result
 
     def _bias_arg(self, bias) -> jax.Array:
@@ -388,7 +435,17 @@ class GenerationEngine:
         window = window_tokens.astype(np.int32)
         window, bias = faults.inject("generation.verify", (window, self._zero_bias))
         self.step_counts["verify"] += 1
+        # useful verify work: per live slot, n_draft+1 window tokens;
+        # window token j at position start+j attends to start+j+1 live
+        # context positions -> (nd+1)(start+1) + nd(nd+1)/2. Computed
+        # BEFORE the clock starts: device_time_s is wall seconds inside
+        # the step's host API call only, same as prefill/decode
+        nd = np.maximum(n_draft, 0).astype(np.int64)
+        live = n_draft >= 0
+        w_tok = np.where(live, nd + 1, 0)
+        ctx = np.where(live, w_tok * (start.astype(np.int64) + 1) + nd * (nd + 1) // 2, 0)
         t0 = time.perf_counter()
+        traces_before = self.trace_counts.get("verify", 0)
         out, n_emitted, ok, ck, cv = self._verify_jit(
             self.params,
             jnp.asarray(window),
@@ -405,7 +462,14 @@ class GenerationEngine:
         self.cache.update(ck, cv)
         self.last_finite = np.asarray(ok)
         result = (np.asarray(out), np.asarray(n_emitted))
-        self.device_time_s["verify"] += time.perf_counter() - t0
+        elapsed = time.perf_counter() - t0
+        # success-only, paired with the time below (see prefill())
+        self.flops_by_kind["verify"] += self.flops_model.verify_flops(
+            int(w_tok.sum()), int(ctx.sum())
+        )
+        self.device_time_s["verify"] += elapsed
+        if self.trace_counts.get("verify", 0) > traces_before:
+            self.programs.set_compile_time("verify", elapsed)
         return result
 
     def generate(
@@ -432,3 +496,19 @@ class GenerationEngine:
     def recompiles(self) -> Dict[str, int]:
         """Retraces beyond the first compile, per program."""
         return {k: v - 1 for k, v in self.trace_counts.items() if v > 1}
+
+    def total_flops(self) -> float:
+        """Cumulative useful model FLOPs across all step kinds."""
+        return sum(self.flops_by_kind.values())
+
+    def total_device_time_s(self) -> float:
+        return sum(self.device_time_s.values())
+
+    def mfu(self) -> float:
+        """Serving model-FLOPs utilization: useful FLOPs over device
+        seconds against the chip's peak for the cache dtype. 0 before
+        any step ran."""
+        t = self.total_device_time_s()
+        if t <= 0:
+            return 0.0
+        return self.total_flops() / t / self.flops_model.peak_flops

@@ -76,7 +76,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..obs import NULL_TRACE, FlightRecorder, RequestTrace, TraceRing, next_request_id
+from ..obs import (
+    NULL_TRACE,
+    CacheTelemetry,
+    FlightRecorder,
+    RequestTrace,
+    SLOMonitor,
+    TraceRing,
+    next_request_id,
+)
 from ..runtime import faults
 from ..serving.resilience import (
     CircuitBreaker,
@@ -86,7 +94,13 @@ from ..serving.resilience import (
     RetryPolicy,
     ShuttingDownError,
 )
-from ..serving.stats import RecoveryStats, ServingStats, SpeculationStats, TokenRate
+from ..serving.stats import (
+    GoodputStats,
+    RecoveryStats,
+    ServingStats,
+    SpeculationStats,
+    TokenRate,
+)
 from .engine import GenerationEngine, SamplingParams
 from .recovery import (
     EngineFailedError,
@@ -244,6 +258,12 @@ class Request:
         self.acc_ema: Optional[float] = None
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # capacity observability: admission-wait blame (set while the
+        # FCFS head is blocked on cache blocks) and the terminal
+        # SLO/goodput sink (set by the scheduler when tracing is on)
+        self.cache_wait_start: Optional[float] = None
+        self.cache_wait_short = 0
+        self.slo_sink = None
 
     @property
     def n_generated(self) -> int:
@@ -257,6 +277,11 @@ class Request:
         self.trace.mark_finish(outcome, err)
         if self.trace_ring is not None:
             self.trace_ring.add(self.trace)
+        if self.slo_sink is not None:
+            try:
+                self.slo_sink(self)
+            except Exception:
+                pass  # SLO accounting must never poison a settle path
 
     def sample_key(self) -> jax.Array:
         """Key for the NEXT token: indexed by generated count, so a
@@ -331,6 +356,8 @@ class ContinuousBatchingScheduler:
         trace_ring_size: int = 256,
         flight_capacity: int = 512,
         trace_progress_every: int = 8,
+        slo_objectives=None,
+        pressure_threshold: float = 0.10,
     ):
         self.engine = engine
         # scheduler-wide default speculation policy (a request's own
@@ -384,12 +411,42 @@ class ContinuousBatchingScheduler:
         self.obs_enabled = observability
         self.trace_progress_every = trace_progress_every
         self.trace_ring = TraceRing(trace_ring_size)
-        self.flight = FlightRecorder(capacity=flight_capacity, enabled=observability)
+        # dual-clock stamps: records carry t (perf_counter, the
+        # timeline's single rendering clock) AND t_sched (this
+        # scheduler's possibly-virtual clock) for trace correlation
+        self.flight = FlightRecorder(
+            capacity=flight_capacity, enabled=observability, sched_clock=self.clock
+        )
         self._step_phases: Dict[str, float] = {}
         self._step_info: Dict = {}
         self._step_recorded = False
         self.spec_stats = SpeculationStats()
         self.spec_stats.register_gauges(self.stats)
+        # capacity & compute observability (obs/capacity.py, obs/slo.py):
+        # block telemetry, MFU/goodput, retrace blame, SLO burn rates —
+        # all surfaced as gauges here and on the /v2 debug endpoints
+        self.capacity = CacheTelemetry(
+            engine.allocator, clock=self.clock,
+            pressure_threshold=pressure_threshold, enabled=observability,
+        )
+        self.capacity.register_gauges(self.stats, lambda: list(self._running.values()))
+        self.goodput = GoodputStats()
+        self.goodput.register_gauges(self.stats)
+        self.slo = SLOMonitor(slo_objectives, clock=self.clock)
+        self.slo.register_gauges(self.stats)
+        self.stats.add_gauge("mfu", self.engine.mfu)
+        self.stats.add_gauge(
+            "model_tflops_total", lambda: self.engine.total_flops() / 1e12
+        )
+        self.stats.add_gauge(
+            "achieved_tflops",
+            lambda: self.engine.total_flops()
+            / max(1e-9, self.engine.total_device_time_s()) / 1e12,
+        )
+        self.stats.add_gauge("retraces_blamed", self.engine.programs.total_retraces)
+        # steady-state retrace blame rides the flight ring next to the
+        # step that caused it ("decode retraced: batch 8 -> 9")
+        self.engine.programs.on_retrace = self._note_retrace
         self._dummy_keys = None  # inactive-slot key rows, built once
         # self-healing (recovery.py): journal + supervisor + watchdog.
         # _heartbeat is (seq, started_at) while a device call is in
@@ -403,8 +460,11 @@ class ContinuousBatchingScheduler:
         self._hb_seq = 0
         # the request popped for admission but not yet slot-resident:
         # visible to the watchdog's deadline reaper, which otherwise
-        # could not see it while its prefill is wedged
+        # could not see it while its prefill is wedged. _admitting_blocks
+        # mirrors its allocation so cache_report can show a provisional
+        # residency row while the prefill (possibly a cold compile) runs
         self._admitting: Optional[Request] = None
+        self._admitting_blocks: Optional[List[int]] = None
 
     # ------------------------------------------------------------- submit
     def submit(
@@ -479,6 +539,7 @@ class ContinuousBatchingScheduler:
                     progress_every=self.trace_progress_every,
                 )
                 req.trace_ring = self.trace_ring
+                req.slo_sink = self._slo_record
                 req.trace.mark_accept(
                     prompt_len=len(prompt),
                     deadline_s=deadline_s,
@@ -673,6 +734,46 @@ class ContinuousBatchingScheduler:
     def has_work(self) -> bool:
         return bool(self._queue) or bool(self._running)
 
+    # ------------------------------------------- capacity / SLO reporting
+    def _note_retrace(self, name: str, blame: str) -> None:
+        """Program-registry retrace hook: the blame string lands on the
+        flight ring in true order with the step that retraced."""
+        self.flight.record_event("retrace", program=name, blame=blame)
+
+    def _slo_record(self, req: Request) -> None:
+        """Terminal SLO/goodput sink (exactly once per request, via the
+        handle's settle-race winner). Deadline-goodput counts a token as
+        good only when its request COMPLETED in-deadline; the SLO
+        windows see every outcome."""
+        tr = req.trace
+        in_deadline = req.deadline is None or (
+            tr.t_finish is not None and tr.t_finish <= req.deadline
+        )
+        self.goodput.record(
+            req.n_generated, good=(tr.outcome == "completed" and in_deadline)
+        )
+        self.slo.observe(tr.outcome or "unknown", ttft_s=tr.ttft_s, tpot_s=tr.tpot_s)
+
+    def cache_report(self) -> Dict:
+        """The ``GET /v2/debug/cache`` payload: allocator state +
+        per-request block residency (obs/capacity.py). Read order
+        matters for concurrent scrapes: the free count FIRST (so a
+        request finishing mid-scrape leaves the residency table at
+        worst undercounting ``used``, never claiming freed blocks),
+        then the running snapshot, then the in-flight admission — with
+        id-dedup in report(), a request can never be counted twice,
+        and the undercount window shrinks from the whole prefill to
+        the register-then-clear gap."""
+        free = self.engine.allocator.num_free
+        running = list(self._running.values())
+        adm_req, adm_blocks = self._admitting, self._admitting_blocks
+        return self.capacity.report(
+            running, queue_depth=len(self._queue),
+            admitting=(adm_req, adm_blocks)
+            if adm_req is not None and adm_blocks else None,
+            free=free,
+        )
+
     def _loop(self) -> None:
         while (self._alive or (self._draining and self.has_work())) and not self._hard_stop:
             if not self.step():
@@ -769,6 +870,7 @@ class ContinuousBatchingScheduler:
         if not victims:
             return False
         victim = max(victims, key=lambda s: s.admitted_seq)
+        self.capacity.note_preempt(len(victim.blocks))
         self._release(victim)
         req = victim.req
         req.prompt = req.original_prompt + list(req.generated)
@@ -796,9 +898,27 @@ class ContinuousBatchingScheduler:
             need = self.engine.cache_config.blocks_for(len(req.prompt) + 1)
             blocks = self.engine.allocator.allocate(need)
             if blocks is None:
+                # admission-rejection blame: remember when the FCFS head
+                # first stalled on blocks and how many it is short — the
+                # eventual admit stamps "queued Nms waiting for K
+                # block(s)" on the request's trace
+                if self.obs_enabled and req.cache_wait_start is None:
+                    req.cache_wait_start = self.clock()
+                req.cache_wait_short = need - self.engine.allocator.num_free
                 return False
             self._queue.popleft()
             slot = self._free_slots.pop()
+        if req.cache_wait_start is not None:
+            wait_s = max(0.0, self.clock() - req.cache_wait_start)
+            blame = self.capacity.note_admission_wait(wait_s, req.cache_wait_short)
+            req.trace.event(
+                "cache_wait", wait_s=wait_s,
+                blocks_short=req.cache_wait_short, blame=blame,
+            )
+            req.cache_wait_start = None
+        # blocks first, then the request: cache_report treats a set
+        # _admitting as implying its blocks are readable
+        self._admitting_blocks = blocks
         self._admitting = req
         t_dev = time.perf_counter()
         try:
@@ -809,6 +929,7 @@ class ContinuousBatchingScheduler:
             )
         except Exception as e:
             self._admitting = None
+            self._admitting_blocks = None
             self.engine.allocator.free(blocks)
             self._free_slots.append(slot)
             if self.supervisor.failed:
@@ -833,11 +954,12 @@ class ContinuousBatchingScheduler:
             if req.handle._fail(e):
                 self.stats.incr("failed")
             return True  # did work (and must not spin on the same head)
-        self._admitting = None
         dev_s = time.perf_counter() - t_dev
         if not bool(self.engine.last_finite[0]):
             # poisoned prompt: the prefill's logits went non-finite, and
             # a single-sequence step needs no bisection to assign blame
+            self._admitting = None
+            self._admitting_blocks = None
             self.engine.allocator.free(blocks)
             self._free_slots.append(slot)
             err = PoisonedRequestError(
@@ -855,6 +977,13 @@ class ContinuousBatchingScheduler:
             return True
         state = _Running(req, slot, blocks, cached_len=len(req.prompt), admitted_seq=next(self._admitted_seq))
         self._running[slot] = state
+        # clear only AFTER slot registration: cache_report reads
+        # _running first and dedupes by request id, so the blocks are
+        # visible (as a provisional or real row, never both) for the
+        # whole admission — residency keeps summing to used under
+        # concurrent scrapes
+        self._admitting = None
+        self._admitting_blocks = None
         if self.supervisor.failed:  # a dead engine just served a prefill
             self.supervisor.note_engine_recovered()
         self.journal.record(req, state.admitted_seq)
@@ -936,6 +1065,7 @@ class ContinuousBatchingScheduler:
                     break
 
     def _preempt_self(self, state: _Running) -> None:
+        self.capacity.note_preempt(len(state.blocks))
         self._release(state)
         req = state.req
         req.prompt = req.original_prompt + list(req.generated)
@@ -1070,6 +1200,7 @@ class ContinuousBatchingScheduler:
             extra = state.blocks[keep:]
             del state.blocks[keep:]
             self.engine.allocator.free(extra)
+            self.capacity.note_trim(len(extra))
 
     def _verify_once(self) -> bool:
         """One speculative verification step across all running slots:
@@ -1228,4 +1359,8 @@ class ContinuousBatchingScheduler:
         did = stepped or admitted > 0
         if did:
             self._flight_step()
+        # integrate time-at-pressure AFTER the step's allocations, so
+        # the pressure flag reflects the state the next interval runs in
+        # (injectable clock: virtual-clock tests integrate exactly)
+        self.capacity.tick()
         return did

@@ -15,9 +15,26 @@ Three low-overhead pieces threaded through the serving path:
 * :mod:`prom` — Prometheus text exposition for every ServingStats
   counter / gauge / latency window / histogram on ``GET /metrics``.
 
+PR 6 adds the *resource* dimension:
+
+* :mod:`capacity` — KV-cache block telemetry (:class:`CacheTelemetry`,
+  ``GET /v2/debug/cache``), the serving FLOPs model behind the MFU /
+  goodput gauges (:class:`ServingFlops`), and the jit
+  :class:`ProgramRegistry` with retrace blame
+  (``GET /v2/debug/programs``);
+* :mod:`slo` — declarative per-model objectives evaluated as
+  multi-window burn rates on the scheduler's injectable clock
+  (:class:`SLOMonitor`, ``GET /v2/slo``).
+
 See tools/obsreport.py for the CLI (summaries, trace waterfalls,
-timeline dumps, and the CI ``--selfcheck``).
+timeline dumps, cache/SLO views, and the CI ``--selfcheck``).
 """
+from .capacity import (
+    GLOBAL_PROGRAMS,
+    CacheTelemetry,
+    ProgramRegistry,
+    ServingFlops,
+)
 from .flight import FlightRecorder
 from .prom import (
     escape_label_value,
@@ -26,10 +43,18 @@ from .prom import (
     sanitize_name,
     validate_exposition,
 )
+from .slo import DEFAULT_OBJECTIVES, SLObjective, SLOMonitor
 from .trace import NULL_TRACE, RequestTrace, TraceRing, next_request_id
 
 __all__ = [
+    "CacheTelemetry",
+    "DEFAULT_OBJECTIVES",
     "FlightRecorder",
+    "GLOBAL_PROGRAMS",
+    "ProgramRegistry",
+    "SLOMonitor",
+    "SLObjective",
+    "ServingFlops",
     "NULL_TRACE",
     "RequestTrace",
     "TraceRing",

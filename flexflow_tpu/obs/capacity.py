@@ -1,0 +1,494 @@
+"""Capacity & compute observability: where the HBM blocks go and how
+much of the chip serving actually uses.
+
+PR 5 answered the *time* dimension (traces, flight recorder, /metrics);
+this module answers the *resource* dimension with three pieces:
+
+* :class:`CacheTelemetry` — KV-cache block accounting beyond the
+  occupancy gauge: per-request block residency (built on demand from
+  the scheduler's slot state, so the hot path pays nothing), internal
+  fragmentation (allocated slots minus live tokens — blocks held for
+  lookahead and block-rounding), preempt-reclaim / trim counters,
+  time-at-pressure integrated on the scheduler's injectable clock, and
+  admission-wait blame ("queued 120ms waiting for 3 blocks") threaded
+  into request traces. Served on ``GET /v2/debug/cache`` and as
+  ``flexflow_serving_cache_*`` Prometheus series.
+
+* :class:`ServingFlops` — the serving-side analog of the search cost
+  model's roofline accounting (search/cost_model.py): per-step *model*
+  FLOPs for prefill / decode / verify derived from the decoder config,
+  measured against :class:`~flexflow_tpu.parallel.machine.TPUChipSpec`
+  peaks. Convention follows MFU literature: only model-shaped work
+  counts — true prompt lengths and live context positions, never bucket
+  padding or inactive slots — so serving MFU is comparable to the
+  training MFU in MFU_PROFILE.json. Work the device executed but
+  clients never benefited from (recovery replay, bisection probes, step
+  retries) DOES count, in both the FLOPs numerator and the device-time
+  denominator: MFU measures hardware utilization, not client benefit —
+  the client-useful fraction is ``goodput_ratio``, and replay volume is
+  visible as ``replayed_tokens``/``step_retries``.
+
+* :class:`ProgramRegistry` — every traced jit program (engine prefill
+  buckets, decode, verify, plus the executor's train/eval programs via
+  :data:`GLOBAL_PROGRAMS`) with its static argument signature, trace
+  count, and compile wall time. A steady-state retrace diffs the new
+  abstract arguments against the registered signature and produces a
+  human-readable *blame* string ("decode retraced: tokens int32[4] ->
+  int32[5]") — attached to the flight recorder and served on
+  ``GET /v2/debug/programs``. The genbench retrace guard says *that* a
+  program retraced; the registry says *why*.
+
+Everything here is host-side Python arithmetic: no device calls, no
+extra dispatches, and the per-step cost is a handful of integer adds
+(enforced by genbench's 3% tracing-overhead budget, which runs with
+capacity telemetry enabled).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..core.types import DataType
+from ..parallel.machine import TPUChipSpec
+
+# --------------------------------------------------------------------------
+# KV-cache block telemetry
+# --------------------------------------------------------------------------
+
+
+class CacheTelemetry:
+    """Block-level cache accounting for one continuous-batching
+    scheduler.
+
+    The scheduler calls the ``note_*`` hooks from its loop thread only
+    (plain int arithmetic, no locks needed under the GIL); ``report``
+    builds the residency table on demand from the live slot states, so
+    steady-state steps never touch per-request dicts.
+
+    ``pressure_threshold``: the free-block fraction at or below which
+    the cache counts as "under pressure"; :meth:`tick` integrates the
+    time spent there on the scheduler's (possibly virtual) clock.
+    """
+
+    def __init__(
+        self,
+        allocator,
+        clock: Callable[[], float] = time.monotonic,
+        pressure_threshold: float = 0.10,
+        enabled: bool = True,
+    ):
+        self.allocator = allocator
+        self.clock = clock
+        self.enabled = enabled
+        self.pressure_threshold = pressure_threshold
+        # cumulative counters (loop-thread writes only)
+        self.preempt_reclaimed_blocks = 0
+        self.preempt_reclaims = 0
+        self.trimmed_blocks = 0
+        self.trims = 0
+        self.admission_waits = 0  # distinct blocked->admitted episodes
+        self.admission_wait_s = 0.0  # total time requests sat blocked on blocks
+        self.last_wait_blame: Optional[str] = None
+        self.time_at_pressure_s = 0.0
+        self._last_tick: Optional[float] = None
+        self._was_under = False
+
+    # ------------------------------------------------------------- hooks
+    def tick(self) -> None:
+        """Integrate time-at-pressure; called once per scheduler step."""
+        if not self.enabled:
+            return
+        now = self.clock()
+        if self._last_tick is not None and self._was_under:
+            self.time_at_pressure_s += max(0.0, now - self._last_tick)
+        total = self.allocator.num_total
+        self._was_under = self.allocator.num_free <= total * self.pressure_threshold
+        self._last_tick = now
+
+    def note_preempt(self, n_blocks: int) -> None:
+        if not self.enabled:
+            return
+        self.preempt_reclaims += 1
+        self.preempt_reclaimed_blocks += n_blocks
+
+    def note_trim(self, n_blocks: int) -> None:
+        if not self.enabled:
+            return
+        self.trims += 1
+        self.trimmed_blocks += n_blocks
+
+    def note_admission_wait(self, wait_s: float, blocks_short: int) -> str:
+        """One blocked->admitted episode completed; returns the blame
+        string the scheduler attaches to the request's trace."""
+        blame = (
+            f"queued {wait_s * 1e3:.0f}ms waiting for "
+            f"{max(1, blocks_short)} block(s)"
+        )
+        if not self.enabled:
+            return blame
+        self.admission_waits += 1
+        self.admission_wait_s += max(0.0, wait_s)
+        self.last_wait_blame = blame
+        return blame
+
+    # ------------------------------------------------------------ reports
+    def fragmentation_slots(self, running: Sequence) -> int:
+        """Internal fragmentation: token slots allocated but not holding
+        live cache entries (lookahead + block rounding), summed over the
+        running set."""
+        bs = self.allocator.config.block_size
+        return sum(max(0, len(s.blocks) * bs - s.cached_len) for s in running)
+
+    def register_gauges(self, stats, running_fn: Callable[[], List]) -> None:
+        """Prometheus series (``flexflow_serving_cache_*``): counters
+        ride as gauges like the scheduler's other cumulative metrics."""
+        alloc = self.allocator
+        stats.add_gauge(
+            "cache_frag_slots", lambda: self.fragmentation_slots(running_fn())
+        )
+        stats.add_gauge("cache_free_low_water", lambda: alloc.low_water)
+        stats.add_gauge("cache_free_high_water", lambda: alloc.high_water)
+        stats.add_gauge("cache_blocks_allocated_total", lambda: alloc.total_allocated)
+        stats.add_gauge("cache_blocks_freed_total", lambda: alloc.total_freed)
+        stats.add_gauge(
+            "cache_preempt_reclaimed_blocks", lambda: self.preempt_reclaimed_blocks
+        )
+        stats.add_gauge("cache_trimmed_blocks", lambda: self.trimmed_blocks)
+        stats.add_gauge("cache_pressure_time_s", lambda: self.time_at_pressure_s)
+        stats.add_gauge("cache_admission_waits", lambda: self.admission_waits)
+        stats.add_gauge("cache_admission_wait_s", lambda: self.admission_wait_s)
+
+    def report(
+        self, running: Sequence, queue_depth: int = 0, admitting=None,
+        free: Optional[int] = None,
+    ) -> Dict:
+        """The ``GET /v2/debug/cache`` payload: allocator state,
+        watermarks, counters, and the per-request residency table.
+
+        Residency invariant (tests/test_capacity.py): the table's block
+        counts sum to exactly ``used``. That includes an admission in
+        flight — blocks are allocated BEFORE the prefill device call
+        (seconds, on a cold compile), so ``admitting`` = (request,
+        blocks) renders as a provisional ``"admitting": True`` row
+        rather than a phantom block leak. Deduped by request id against
+        ``running`` so a request is never counted twice. The invariant
+        is exact whenever the loop thread is between transitions;
+        callers racing the loop pass ``free`` read BEFORE snapshotting
+        ``running`` so a request finishing mid-scrape makes the table
+        at worst UNDERcount ``used`` by that one request's blocks
+        (blocks counted used, row already gone) — never report freed
+        blocks as still resident."""
+        alloc = self.allocator
+        cfg = alloc.config
+        bs = cfg.block_size
+        if free is None:
+            free = alloc.num_free
+        residency = []
+        for s in sorted(running, key=lambda s: s.slot):
+            allocated_slots = len(s.blocks) * bs
+            residency.append({
+                "request_id": s.req.id,
+                "slot": s.slot,
+                "blocks": len(s.blocks),
+                "allocated_slots": allocated_slots,
+                "live_tokens": s.cached_len,
+                "frag_slots": max(0, allocated_slots - s.cached_len),
+                "n_generated": s.req.n_generated,
+                "preemptions": s.req.preemptions,
+            })
+        if admitting is not None:
+            adm_req, adm_blocks = admitting
+            if adm_req.id not in {r["request_id"] for r in residency}:
+                allocated_slots = len(adm_blocks) * bs
+                residency.append({
+                    "request_id": adm_req.id,
+                    "slot": None,
+                    "blocks": len(adm_blocks),
+                    "allocated_slots": allocated_slots,
+                    "live_tokens": 0,  # prefill still running
+                    "frag_slots": allocated_slots,
+                    "n_generated": adm_req.n_generated,
+                    "preemptions": adm_req.preemptions,
+                    "admitting": True,
+                })
+        total = alloc.num_total
+        return {
+            "config": {
+                "num_blocks": cfg.num_blocks,
+                "block_size": bs,
+                "usable_tokens": cfg.usable_tokens,
+                "bytes_per_block": cfg.bytes_per_block,
+                "total_bytes": cfg.total_bytes,
+            },
+            "blocks": {
+                "total": total,
+                "free": free,
+                "used": total - free,
+                "low_water": alloc.low_water,
+                "high_water": alloc.high_water,
+                "allocated_total": alloc.total_allocated,
+                "freed_total": alloc.total_freed,
+                "reset_reclaimed_total": alloc.total_reset_reclaimed,
+            },
+            "fragmentation_slots": sum(r["frag_slots"] for r in residency),
+            "occupancy": (total - free) / max(1, total),
+            "pressure": {
+                "threshold": self.pressure_threshold,
+                "under_pressure": self._was_under,
+                "time_at_pressure_s": self.time_at_pressure_s,
+            },
+            "counters": {
+                "preempt_reclaims": self.preempt_reclaims,
+                "preempt_reclaimed_blocks": self.preempt_reclaimed_blocks,
+                "trims": self.trims,
+                "trimmed_blocks": self.trimmed_blocks,
+                "admission_waits": self.admission_waits,
+                "admission_wait_s": self.admission_wait_s,
+                "last_wait_blame": self.last_wait_blame,
+            },
+            "queue_depth": queue_depth,
+            "residency": residency,
+        }
+
+
+# --------------------------------------------------------------------------
+# Serving FLOPs model (MFU / achieved TFLOP/s)
+# --------------------------------------------------------------------------
+
+
+class ServingFlops:
+    """Analytic per-step FLOPs for the generation engine's three
+    programs, in the cost model's roofline idiom (search/cost_model.py
+    counts the same matmul terms per op; here they are folded into one
+    decoder-layer constant so the hot path pays two multiplies).
+
+    Per useful token (matmuls only, fwd):
+      qkv + out projections  8 * E^2          per layer
+      FFN (two matmuls)      4 * E * F        per layer
+      LM head                2 * E * V        once
+    Per (token, live context position):
+      QK^T + AV              4 * E            per layer
+
+    MFU = model FLOPs / device seconds / chip peak for the cache dtype
+    (bf16 vs f32 peak, exactly the cost model's dtype dispatch).
+    """
+
+    def __init__(
+        self,
+        num_layers: int,
+        hidden_size: int,
+        ff_size: int,
+        vocab_size: int,
+        dtype: DataType = DataType.FLOAT,
+        chip: Optional[TPUChipSpec] = None,
+    ):
+        e, f, l, v = hidden_size, ff_size, num_layers, vocab_size
+        self.per_token_flops = l * (8 * e * e + 4 * e * f) + 2 * e * v
+        self.per_ctx_flops = l * 4 * e
+        self.chip = chip or TPUChipSpec()
+        self.peak_flops = (
+            self.chip.bf16_flops
+            if dtype in (DataType.BFLOAT16, DataType.HALF)
+            else self.chip.f32_flops
+        )
+
+    @classmethod
+    def from_config(cls, cfg, dtype: DataType = DataType.FLOAT, chip=None) -> "ServingFlops":
+        """Build from a TransformerConfig (the engine's ``cfg``)."""
+        return cls(
+            num_layers=cfg.num_layers,
+            hidden_size=cfg.hidden_size,
+            ff_size=cfg.ff_size,
+            vocab_size=cfg.vocab_size,
+            dtype=dtype,
+            chip=chip,
+        )
+
+    def prefill_flops(self, prompt_len: int) -> float:
+        """One prompt of ``prompt_len`` true tokens (bucket padding is
+        not useful work); causal context sum = n(n+1)/2."""
+        n = max(0, prompt_len)
+        return n * self.per_token_flops + self.per_ctx_flops * (n * (n + 1) // 2)
+
+    def decode_flops(self, n_active: int, context_sum: int) -> float:
+        """One decode step: ``n_active`` live tokens attending to
+        ``context_sum`` total live context positions."""
+        return n_active * self.per_token_flops + self.per_ctx_flops * context_sum
+
+    def verify_flops(self, n_tokens: int, context_sum: int) -> float:
+        """One verify step: ``n_tokens`` live window tokens (committed +
+        drafts across slots) with ``context_sum`` live attended
+        positions (window token j at position p attends to p+1)."""
+        return n_tokens * self.per_token_flops + self.per_ctx_flops * context_sum
+
+
+# --------------------------------------------------------------------------
+# Jit program registry + retrace blame
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ProgramEntry:
+    name: str
+    signature: Dict[str, str]
+    traces: int = 1
+    compile_s: Optional[float] = None
+    last_blame: Optional[str] = None
+
+
+def _summarize(x) -> str:
+    """Compact signature for one traced argument: ``dtype[shape]`` for
+    arrays, a leaf-count/element-count digest for pytrees, ``repr`` for
+    static scalars."""
+    shape = getattr(x, "shape", None)
+    if shape is not None:
+        dt = getattr(x, "dtype", "?")
+        return f"{dt}[{','.join(str(d) for d in shape)}]"
+    try:
+        import jax
+
+        leaves = [l for l in jax.tree_util.tree_leaves(x) if hasattr(l, "shape")]
+    except Exception:
+        leaves = []
+    if leaves:
+        elems = sum(int(_prod(l.shape)) for l in leaves)
+        return f"pytree({len(leaves)} leaves, {elems} elems)"
+    return repr(x)[:40]
+
+
+def _prod(shape) -> int:
+    out = 1
+    for d in shape:
+        out *= int(d)
+    return out
+
+
+class ProgramRegistry:
+    """Registry of traced jit programs with retrace blame.
+
+    ``note_trace(name, args)`` is called from INSIDE the traced Python
+    body (it only runs when XLA traces, the same property the engine's
+    ``trace_counts`` relies on). The first trace registers the
+    program's argument signature; any later trace diffs against it and
+    produces a blame string naming exactly which argument changed shape
+    or dtype — the answer "decode retraced: tokens int32[8] ->
+    int32[9]" instead of a bare retrace counter.
+
+    ``on_retrace(name, blame)`` (optional) fires on every retrace; the
+    scheduler points it at the flight recorder. Exceptions in the
+    callback are swallowed: a logging hook must never break tracing.
+    """
+
+    def __init__(self, max_retraces: int = 64):
+        self._lock = threading.Lock()
+        self.entries: Dict[str, ProgramEntry] = {}
+        self.retraces: deque = deque(maxlen=max_retraces)
+        self.on_retrace: Optional[Callable[[str, str], None]] = None
+
+    def note_trace(self, name: str, args: Dict[str, object]) -> Optional[str]:
+        """Record one trace of ``name``; returns the blame string when
+        this is a retrace, else None."""
+        sig = {k: _summarize(v) for k, v in args.items()}
+        with self._lock:
+            entry = self.entries.get(name)
+            if entry is None:
+                self.entries[name] = ProgramEntry(name=name, signature=sig)
+                return None
+            entry.traces += 1
+            diffs = []
+            for k in sig:
+                old = entry.signature.get(k)
+                if old != sig[k]:
+                    diffs.append(f"{k} {old if old is not None else '<absent>'} -> {sig[k]}")
+            for k in entry.signature:
+                if k not in sig:
+                    diffs.append(f"{k} {entry.signature[k]} -> <absent>")
+            if diffs:
+                blame = f"{name} retraced: " + ", ".join(diffs)
+            else:
+                blame = (
+                    f"{name} retraced: identical signature "
+                    "(jit cache eviction or weak-type change)"
+                )
+            entry.signature = sig
+            entry.last_blame = blame
+            self.retraces.append({
+                "t": time.time(),
+                "program": name,
+                "blame": blame,
+                "traces": entry.traces,
+            })
+            cb = self.on_retrace
+        if cb is not None:
+            try:
+                cb(name, blame)
+            except Exception:
+                pass  # observability must never break tracing
+        return blame
+
+    def set_compile_time(self, name: str, seconds: float) -> None:
+        """Stamp the wall time of the host call that triggered the
+        program's (re)trace — trace + lower + compile + first run."""
+        with self._lock:
+            entry = self.entries.get(name)
+            if entry is not None:
+                entry.compile_s = seconds
+
+    def instrument(self, name: str, fn: Callable) -> Callable:
+        """Wrap ``fn`` for ``jax.jit`` so every trace self-registers
+        (the wrapper body runs at trace time only — zero steady-state
+        cost). Used for the executor's train/eval programs, where
+        arguments are anonymous pytrees."""
+
+        def traced(*args, **kwargs):
+            sig = {f"arg{i}": a for i, a in enumerate(args)}
+            sig.update(kwargs)
+            self.note_trace(name, sig)
+            return fn(*args, **kwargs)
+
+        return traced
+
+    def remove_namespace(self, prefix: str) -> None:
+        """Drop every program named ``prefix`` or ``prefix.*`` (and its
+        retrace records). Executors register under per-instance
+        namespaces and evict them via a weakref finalizer, so a process
+        that builds executors in a loop does not grow the global
+        registry without bound."""
+        dot = prefix + "."
+        with self._lock:
+            for name in [n for n in self.entries
+                         if n == prefix or n.startswith(dot)]:
+                del self.entries[name]
+            kept = [r for r in self.retraces
+                    if not (r["program"] == prefix or r["program"].startswith(dot))]
+            self.retraces.clear()
+            self.retraces.extend(kept)
+
+    def snapshot(self) -> List[Dict]:
+        with self._lock:
+            return [
+                {
+                    "name": e.name,
+                    "traces": e.traces,
+                    "compile_s": e.compile_s,
+                    "signature": dict(e.signature),
+                    "last_blame": e.last_blame,
+                }
+                for e in sorted(self.entries.values(), key=lambda e: e.name)
+            ]
+
+    def recent_retraces(self) -> List[Dict]:
+        with self._lock:
+            return list(self.retraces)
+
+    def total_retraces(self) -> int:
+        with self._lock:
+            return sum(max(0, e.traces - 1) for e in self.entries.values())
+
+
+# Executor programs register here (runtime/executor.py); the server
+# merges this registry into GET /v2/debug/programs under "executor".
+GLOBAL_PROGRAMS = ProgramRegistry()

@@ -26,9 +26,15 @@ recovery therefore has a postmortem without anyone scraping in time.
 ``chrome://tracing`` or https://ui.perfetto.dev): phases as duration
 events, markers as instants, occupancy/free-blocks as counter tracks.
 
-Timing uses ``time.perf_counter`` (real wall time, independent of the
-scheduler's possibly-virtual clock): phase durations are physical
-profiling data even in virtual-clock tests. Disabled recorders
+Clock discipline (the PR 6 audit): phase DURATIONS and the record's
+``t`` stamp use ``time.perf_counter`` — physical profiling data even in
+virtual-clock tests — while scheduler-plane consumers (request traces,
+SLO windows) run on the scheduler's injectable clock. Mixing the two on
+one timeline produced incoherent interleavings in virtual-clock tests,
+so every record now carries BOTH stamps: ``t`` (the recorder's physical
+clock; the timeline renders exclusively from this one) and ``t_sched``
+(the scheduler's clock, when one is supplied via ``sched_clock``) for
+correlating a flight record with trace/SLO events. Disabled recorders
 (``enabled=False``) make every method a cheap no-op.
 """
 from __future__ import annotations
@@ -47,10 +53,14 @@ class FlightRecorder:
         max_incidents: int = 8,
         incident_window: int = 64,
         enabled: bool = True,
+        sched_clock: Optional[Callable[[], float]] = None,
     ):
         self.enabled = enabled
         self.capacity = max(1, capacity)
         self.clock = clock
+        # the owner's (possibly virtual) clock: stamps ride records as
+        # t_sched so timeline entries correlate with trace/SLO events
+        self.sched_clock = sched_clock
         self.incident_window = incident_window
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=self.capacity)
@@ -75,6 +85,8 @@ class FlightRecorder:
         if not self.enabled:
             return -1
         rec = {"t": self.clock(), "kind": kind}
+        if self.sched_clock is not None:
+            rec["t_sched"] = self.sched_clock()
         if phases:
             rec["phases"] = phases
         rec.update(fields)
@@ -101,6 +113,7 @@ class FlightRecorder:
         snap = {
             "kind": kind,
             "t": self.clock(),
+            **({"t_sched": self.sched_clock()} if self.sched_clock is not None else {}),
             "seq": marker_seq,
             **fields,
             "records": records,

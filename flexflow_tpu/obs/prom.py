@@ -51,6 +51,24 @@ _HELP = {
     "cache_occupancy": "Fraction of KV-cache blocks in use.",
     "recompiles": "XLA retraces beyond the first compile, all programs.",
     "device_time_s": "Cumulative wall seconds inside device step calls.",
+    "cache_frag_slots": "Internal fragmentation: token slots allocated but not holding live cache entries.",
+    "cache_free_low_water": "Minimum free KV-cache blocks observed.",
+    "cache_free_high_water": "Maximum free KV-cache blocks observed.",
+    "cache_blocks_allocated_total": "KV-cache blocks handed out (cumulative).",
+    "cache_blocks_freed_total": "KV-cache blocks returned via free() (cumulative).",
+    "cache_preempt_reclaimed_blocks": "Blocks reclaimed by preempt-by-recompute evictions.",
+    "cache_trimmed_blocks": "Trailing blocks returned after partial speculative acceptance.",
+    "cache_pressure_time_s": "Cumulative seconds spent below the free-block pressure threshold.",
+    "cache_admission_waits": "Admissions that waited on cache blocks (episodes).",
+    "cache_admission_wait_s": "Cumulative seconds requests sat blocked on cache blocks.",
+    "mfu": "Serving model-FLOPs utilization: useful FLOPs / device seconds / chip peak.",
+    "achieved_tflops": "Achieved useful TFLOP/s over cumulative device step time.",
+    "model_tflops_total": "Cumulative useful model TFLOPs executed by generation steps.",
+    "goodput_tokens_total": "Tokens generated across all requests (goodput denominator).",
+    "goodput_tokens_good": "Tokens on requests that completed within their deadline.",
+    "goodput_ratio": "Deadline-goodput: in-deadline completed tokens / all tokens.",
+    "slo_breaching_total": "Objectives currently burning past threshold on both windows.",
+    "retraces_blamed": "Steady-state jit retraces recorded with blame by the program registry.",
     "recoveries": "Completed engine restart + journal-replay cycles.",
     "step_retries": "Failed device steps absorbed by the single step retry.",
     "replayed_tokens": "Generated tokens recomputed across recoveries.",

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import itertools
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -24,6 +26,7 @@ import jax.numpy as jnp
 
 from ..core.graph import PCGraph, Node
 from ..core.types import CompMode, LossType, MetricsType, OpType
+from ..obs.capacity import GLOBAL_PROGRAMS
 from ..ops.base import LowerCtx, get_op_def
 from ..parallel.propagation import infer_all_specs
 from ..parallel.strategy import ParallelStrategy, to_partition_spec
@@ -33,6 +36,12 @@ from .optimizers import Optimizer
 
 def _node_key(node: Node) -> str:
     return f"{node.op_type.value}_{node.guid}"
+
+
+# Per-executor program namespace in GLOBAL_PROGRAMS ("executor[N].forward"):
+# distinct executors legitimately trace distinct programs, which must not
+# read as retraces of one another in /v2/debug/programs.
+_EXECUTOR_IDS = itertools.count()
 
 
 _PIPE_KEY = "__pipe_stages__"
@@ -804,13 +813,31 @@ class CompiledExecutor:
                 mets["loss"] = loss_fn(final, label)
             return mets
 
-        self._forward = jax.jit(forward)
-        self._eval_step = jax.jit(eval_step)
+        # GLOBAL_PROGRAMS.instrument: every trace self-registers in the
+        # process-wide jit registry (obs/capacity.py) with its argument
+        # signature, so GET /v2/debug/programs and retrace blame cover
+        # the executor's programs too (the wrapper body runs at trace
+        # time only — zero steady-state cost). Each executor gets its
+        # own namespace: a second executor's first compile of "forward"
+        # is a new program, not a phantom retrace of the first one's.
+        self._prog_ns = f"executor[{next(_EXECUTOR_IDS)}]"
+        # evict this executor's registry namespace when it is collected:
+        # rebuilding executors in a loop must not grow GLOBAL_PROGRAMS
+        weakref.finalize(self, GLOBAL_PROGRAMS.remove_namespace, self._prog_ns)
+        self._forward = jax.jit(
+            GLOBAL_PROGRAMS.instrument(f"{self._prog_ns}.forward", forward)
+        )
+        self._eval_step = jax.jit(
+            GLOBAL_PROGRAMS.instrument(f"{self._prog_ns}.eval_step", eval_step)
+        )
         self._eval_step_fn = eval_step
         self._eval_window_cache = {}
         if self.optimizer is not None:
             self._train_step_fn = train_step
-            self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+            self._train_step = jax.jit(
+                GLOBAL_PROGRAMS.instrument(f"{self._prog_ns}.train_step", train_step),
+                donate_argnums=(0, 1, 2),
+            )
             self._multi_step_cache = {}
             self._window_cache = {}
 
@@ -871,7 +898,11 @@ class CompiledExecutor:
             )
             return params, opt_state, state, mets
 
-        jitted = jax.jit(program, donate_argnums=(0, 1, 2))
+        name = (f"{self._prog_ns}.train_window[{w}]" if per_step_xs
+                else f"{self._prog_ns}.train_repeat[{w}]")
+        jitted = jax.jit(
+            GLOBAL_PROGRAMS.instrument(name, program), donate_argnums=(0, 1, 2)
+        )
         cache[w] = jitted
         return jitted
 
@@ -931,7 +962,9 @@ class CompiledExecutor:
                 )
                 return mets
 
-            jitted = jax.jit(window)
+            jitted = jax.jit(
+                GLOBAL_PROGRAMS.instrument(f"{self._prog_ns}.eval_window[{w}]", window)
+            )
             self._eval_window_cache[w] = jitted
         if rng is None:
             rng = jax.random.key(0)

@@ -77,6 +77,43 @@ class GenerationModel:
         """The engine flight recorder (GET /v2/debug/timeline)."""
         return self.scheduler.flight
 
+    @property
+    def capacity(self):
+        """KV-cache block telemetry (GET /v2/debug/cache)."""
+        return self.scheduler.capacity
+
+    @property
+    def programs(self):
+        """The engine's jit program registry (GET /v2/debug/programs)."""
+        return self.engine.programs
+
+    @property
+    def slo(self):
+        """The SLO burn-rate monitor (GET /v2/slo)."""
+        return self.scheduler.slo
+
+    @property
+    def goodput(self):
+        return self.scheduler.goodput
+
+    def cache_report(self):
+        return self.scheduler.cache_report()
+
+    def readiness_rationale(self) -> Dict:
+        """Why (or why not) this model is ready: breaker state, watchdog
+        evidence, and SLO burn — the three health inputs. A breaching
+        SLO explains degradation in the rationale without flipping
+        readiness (a latency regression is not an outage)."""
+        rs = self.scheduler.recovery_stats
+        return {
+            "ready": self.ready(),
+            "breaker": self.breaker.state,
+            "draining": self.scheduler._draining,
+            "watchdog_trips": rs.watchdog_trips,
+            "engine_failures": rs.engine_failures,
+            "slo_breaching": self.scheduler.slo.breaching(),
+        }
+
     # --------------------------------------------------------------- run
     def submit(
         self,
@@ -157,6 +194,16 @@ class GenerationModel:
                 "trace_ring": self.scheduler.trace_ring.capacity,
                 "flight_capacity": self.scheduler.flight.capacity,
                 "progress_every": self.scheduler.trace_progress_every,
+            },
+            "compute": {
+                "chip": self.engine.flops_model.chip.name,
+                "peak_tflops": self.engine.flops_model.peak_flops / 1e12,
+                "mfu": self.engine.mfu(),
+                "model_tflops_total": self.engine.total_flops() / 1e12,
+            },
+            "slo": {
+                "objectives": [o.name for o in self.scheduler.slo.objectives],
+                "breaching": self.scheduler.slo.breaching(),
             },
             "max_batch_slots": self.engine.max_batch_slots,
             "max_spec_tokens": self.engine.max_spec_tokens,

@@ -27,6 +27,15 @@ API. This server implements the same surface directly (stdlib only):
   GET  /v2/debug/timeline[?model=M]        -> engine flight recorder as
                                               chrome://tracing JSON
                                               (+ recent incident dumps)
+  GET  /v2/debug/cache[?model=M]           -> KV-cache block telemetry:
+                                              per-request residency,
+                                              fragmentation, watermarks,
+                                              pressure, admission waits
+  GET  /v2/debug/programs[?model=M]        -> jit program registry:
+                                              traced signatures, compile
+                                              times, retrace blame
+  GET  /v2/slo                             -> per-model SLO objectives
+                                              with fast/slow burn rates
   GET  /v2/models/{name}                   -> model metadata
   GET  /v2/models/{name}/ready             -> per-model readiness
   POST /v2/models/{name}/infer             -> run inference
@@ -60,7 +69,7 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
-from ..obs import render_prometheus
+from ..obs import GLOBAL_PROGRAMS, render_prometheus
 from ..runtime import faults
 from .batcher import DynamicBatcher, make_batcher
 from .model import InferenceModel
@@ -163,6 +172,23 @@ class InferenceServer:
         b = self.batchers.get(name)
         return b is not None and b.ready()
 
+    def readiness(self) -> Dict:
+        """Readiness + rationale: per model, the three health inputs —
+        circuit breaker state, watchdog/recovery evidence, and SLO burn.
+        The boolean keeps the PR 1 semantics (breaker-driven); the
+        rationale explains it, and a breaching SLO shows up as degraded
+        without flipping readiness."""
+        models: Dict[str, Dict] = {}
+        for name, b in list(self.batchers.items()):
+            models[name] = {"ready": b.ready(), "breaker": b.breaker.state}
+        for name, g in list(self.generators.items()):
+            models[name] = g.readiness_rationale()
+        return {
+            "ready": self.ready(),
+            "draining": self._draining,
+            "models": models,
+        }
+
     def stats(self) -> Dict:
         """Aggregate /v2/stats payload: batcher counters + generation
         engine throughput/occupancy, one entry per model."""
@@ -235,6 +261,48 @@ class InferenceServer:
             "incidents": incidents,
         }
 
+    def debug_cache(self, model: Optional[str] = None) -> Dict:
+        """KV-cache block telemetry per generation model: residency
+        table, fragmentation, watermarks, pressure, admission waits."""
+        return {
+            "models": {
+                name: g.cache_report()
+                for name, g in sorted(self.generators.items())
+                if model is None or name == model
+            }
+        }
+
+    def debug_programs(self, model: Optional[str] = None) -> Dict:
+        """Jit program registries: per generation model (prefill
+        buckets / decode / verify) plus the process-wide executor
+        registry, each with signatures, compile times, and any retrace
+        blame."""
+        out: Dict = {
+            "models": {
+                name: {
+                    "programs": g.programs.snapshot(),
+                    "retraces": g.programs.recent_retraces(),
+                }
+                for name, g in sorted(self.generators.items())
+                if model is None or name == model
+            }
+        }
+        if model is None:
+            out["executor"] = {
+                "programs": GLOBAL_PROGRAMS.snapshot(),
+                "retraces": GLOBAL_PROGRAMS.recent_retraces(),
+            }
+        return out
+
+    def slo_report(self) -> Dict:
+        """Per-model SLO objectives with multi-window burn rates."""
+        return {
+            "models": {
+                name: g.slo.snapshot()
+                for name, g in sorted(self.generators.items())
+            }
+        }
+
     # ------------------------------------------------------------ control
     def start(self):
         server = self
@@ -299,8 +367,8 @@ class InferenceServer:
                 if path == "/v2/health/live":
                     return self._json(200, {"live": server.live()})
                 if path == "/v2/health/ready":
-                    ok = server.ready()
-                    return self._json(200 if ok else 503, {"ready": ok})
+                    payload = server.readiness()
+                    return self._json(200 if payload["ready"] else 503, payload)
                 if path == "/v2/stats":
                     return self._json(200, server.stats())
                 if path == "/metrics":
@@ -319,6 +387,16 @@ class InferenceServer:
                     return self._json(200, server.debug_timeline(
                         model=(query.get("model") or [None])[0]
                     ))
+                if path == "/v2/debug/cache":
+                    return self._json(200, server.debug_cache(
+                        model=(query.get("model") or [None])[0]
+                    ))
+                if path == "/v2/debug/programs":
+                    return self._json(200, server.debug_programs(
+                        model=(query.get("model") or [None])[0]
+                    ))
+                if path == "/v2/slo":
+                    return self._json(200, server.slo_report())
                 if path == "/v2/models":
                     return self._json(
                         200,
@@ -332,7 +410,11 @@ class InferenceServer:
                         return self._json(404, {"error": f"unknown model {name}"})
                     if len(parts) == 5 and parts[4] == "ready":
                         ok = server.model_ready(name)
-                        return self._json(200 if ok else 503, {"name": name, "ready": ok})
+                        payload = {"name": name, "ready": ok}
+                        g = server.generators.get(name)
+                        if g is not None:
+                            payload["rationale"] = g.readiness_rationale()
+                        return self._json(200 if ok else 503, payload)
                     return self._json(200, m.metadata())
                 return self._json(404, {"error": "not found"})
 

@@ -275,6 +275,43 @@ class RecoveryStats:
             stats.add_gauge(f, lambda f=f: getattr(self, f))
 
 
+class GoodputStats:
+    """Deadline-goodput accounting for one served model: tokens emitted
+    on requests that COMPLETED within their deadline vs all tokens
+    emitted (a request with no deadline counts as in-deadline when it
+    completes; failed/expired/cancelled requests contribute only to the
+    denominator). The honest throughput number — raw tokens/s includes
+    work clients never benefited from.
+
+    Written once per finished request by the scheduler's trace-done
+    hook (loop or watchdog thread), read by scrape threads.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.tokens_total = 0
+        self.tokens_good = 0
+        self.requests_total = 0
+        self.requests_good = 0
+
+    def record(self, n_tokens: int, good: bool) -> None:
+        with self._lock:
+            self.requests_total += 1
+            self.tokens_total += n_tokens
+            if good:
+                self.requests_good += 1
+                self.tokens_good += n_tokens
+
+    def ratio(self) -> float:
+        with self._lock:
+            return self.tokens_good / self.tokens_total if self.tokens_total else 0.0
+
+    def register_gauges(self, stats: "ServingStats") -> None:
+        stats.add_gauge("goodput_tokens_total", lambda: self.tokens_total)
+        stats.add_gauge("goodput_tokens_good", lambda: self.tokens_good)
+        stats.add_gauge("goodput_ratio", self.ratio)
+
+
 class TokenRate:
     """Windowed tokens/s gauge for the generation engine: record token
     batches as they are emitted; ``rate()`` is tokens over the trailing

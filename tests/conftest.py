@@ -20,3 +20,18 @@ import jax  # noqa: E402
 # any backend initializes so tests get the 8-device virtual mesh.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+
+class FakeClock:
+    """Virtual time for injectable-clock tests (deadlines, breaker
+    recovery windows, SLO burn windows, time-at-pressure). One shared
+    definition — the per-file copies diverged silently before."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt

@@ -1,0 +1,628 @@
+"""Capacity & compute observability tests (ISSUE 6): KV-cache block
+telemetry, serving MFU/goodput, the jit program registry with retrace
+blame, and the SLO burn-rate monitor.
+
+Acceptance criteria covered:
+  * allocator conservation: across a randomized admit / preempt / trim /
+    finish / crash-reset schedule, used + free == total at every step
+    and per-request residency sums to used blocks
+  * a forced bucket-boundary retrace yields a correct blame string
+  * SLO burn-rate tests run entirely on the virtual clock
+  * capacity telemetry adds zero steady-state retraces
+  * flight records carry both clocks; the timeline renders from one
+"""
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.generation import (
+    CacheConfig,
+    ContinuousBatchingScheduler,
+    GenerationEngine,
+    RecoveryPolicy,
+    SamplingParams,
+    SpeculationConfig,
+    init_decoder_params,
+)
+from flexflow_tpu.models.transformer import TransformerConfig
+from flexflow_tpu.obs import FlightRecorder, SLOMonitor, SLObjective
+from flexflow_tpu.obs.capacity import ProgramRegistry, ServingFlops
+from flexflow_tpu.runtime.faults import FaultInjected, FaultPlan
+from flexflow_tpu.serving import InferenceServer
+from flexflow_tpu.serving.generation import GenerationModel
+from flexflow_tpu.serving.stats import GoodputStats
+
+pytestmark = pytest.mark.observability
+
+CFG = TransformerConfig(
+    num_layers=2, hidden_size=32, num_heads=4, ff_size=64,
+    seq_length=64, vocab_size=50, causal=True,
+)
+
+
+from conftest import FakeClock  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def decoder_params():
+    return init_decoder_params(jax.random.key(0), CFG)
+
+
+def small_engine(decoder_params, num_blocks=None, slots=3, block_size=8, **kw):
+    cache = None
+    if num_blocks is not None:
+        cache = CacheConfig(
+            num_layers=CFG.num_layers, num_heads=CFG.num_heads,
+            head_dim=CFG.hidden_size // CFG.num_heads,
+            num_blocks=num_blocks, block_size=block_size,
+        )
+    return GenerationEngine(
+        decoder_params, CFG, cache_config=cache, max_batch_slots=slots,
+        block_size=block_size, prompt_buckets=(8, 16, 32, 64), **kw,
+    )
+
+
+def check_conservation(sched):
+    """The tentpole's accounting invariants, asserted from the public
+    debug report."""
+    rep = sched.cache_report()
+    blocks = rep["blocks"]
+    assert blocks["used"] + blocks["free"] == blocks["total"], blocks
+    assert sum(r["blocks"] for r in rep["residency"]) == blocks["used"], rep
+    assert all(r["frag_slots"] >= 0 for r in rep["residency"])
+    assert rep["fragmentation_slots"] == sum(r["frag_slots"] for r in rep["residency"])
+
+
+# ------------------------------------------------------------ conservation
+def test_allocator_conservation_property(decoder_params):
+    """Randomized admit/preempt/trim/finish/cancel/crash-reset schedule:
+    used + free == total at every step, and the residency table sums to
+    used blocks throughout."""
+    # tiny cache (8 usable blocks, 4-token blocks) so admission pressure,
+    # preemption, and speculative trim all actually fire
+    eng = small_engine(decoder_params, num_blocks=9, block_size=4)
+    sched = ContinuousBatchingScheduler(
+        eng, recovery=RecoveryPolicy(sleep=lambda _s: None)
+    )
+    rs = np.random.RandomState(7)
+    handles = []
+    spec = SpeculationConfig(k=2, method="ngram")
+    for step_i in range(120):
+        if len(handles) < 10 and rs.rand() < 0.4:
+            n = int(rs.randint(2, 9))
+            prompt = rs.randint(0, CFG.vocab_size, n).tolist()
+            handles.append(sched.submit(
+                prompt,
+                SamplingParams(max_new_tokens=int(rs.randint(1, 8))),
+                speculation=spec if rs.rand() < 0.5 else None,
+            ))
+        if handles and rs.rand() < 0.08:
+            rs.choice(handles).cancel()
+        sched.step()
+        check_conservation(sched)
+    # crash-reset mid-flight: journal replay must restore a conserving
+    # state (reset reclaims wholesale, no double frees)
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=RuntimeError("injected crash"), nth=(0, 1))
+    with plan.active():
+        handles.append(sched.submit([1, 2, 3], SamplingParams(max_new_tokens=6)))
+        for _ in range(30):
+            sched.step()
+            check_conservation(sched)
+    # drain everything; terminal state is fully free
+    for _ in range(400):
+        if all(h.done() for h in handles):
+            break
+        if not sched.step():
+            break
+        check_conservation(sched)
+    rep = sched.cache_report()
+    assert rep["blocks"]["used"] == 0
+    assert rep["residency"] == []
+    alloc = eng.allocator
+    # cumulative conservation: every block handed out came back through
+    # free() or a wholesale reset reclaim
+    assert alloc.total_allocated == alloc.total_freed + alloc.total_reset_reclaimed
+    assert alloc.low_water < alloc.num_total  # pressure actually happened
+
+
+def test_fragmentation_and_watermarks(decoder_params):
+    eng = small_engine(decoder_params)
+    sched = ContinuousBatchingScheduler(eng)
+    h = sched.submit([1] * 10, SamplingParams(max_new_tokens=4))
+    # one step = admit (blocks for 11 positions @ block_size 8 -> 2
+    # blocks, prefill caches the 10 prompt tokens) + one decode (11th)
+    sched.step()
+    rep = sched.cache_report()
+    (row,) = rep["residency"]
+    assert row["blocks"] == 2
+    assert row["allocated_slots"] == 16
+    assert row["live_tokens"] == 11
+    assert row["frag_slots"] == 5
+    assert rep["fragmentation_slots"] == 5
+    assert rep["blocks"]["low_water"] <= rep["blocks"]["total"] - 2
+    while not h.done():
+        if not sched.step():
+            break
+    rep = sched.cache_report()
+    assert rep["blocks"]["used"] == 0 and rep["fragmentation_slots"] == 0
+    assert eng.allocator.high_water == eng.allocator.num_total
+
+
+def test_cache_report_shows_inflight_admission(decoder_params):
+    """Blocks allocated for an admission whose prefill is still running
+    (seconds, on a cold compile) appear as a provisional residency row
+    ('admitting': True), so 'residency sums to used' holds for scrapes
+    concurrent with admission — not just between loop steps."""
+    import types
+
+    eng = small_engine(decoder_params)
+    sched = ContinuousBatchingScheduler(eng)
+    blocks = eng.allocator.allocate(2)
+    req = types.SimpleNamespace(id=77, n_generated=0, preemptions=0)
+    sched._admitting_blocks = blocks
+    sched._admitting = req
+    rep = sched.cache_report()
+    assert rep["blocks"]["used"] == 2
+    (row,) = rep["residency"]
+    assert row["admitting"] and row["blocks"] == 2 and row["live_tokens"] == 0
+    assert sum(r["blocks"] for r in rep["residency"]) == rep["blocks"]["used"]
+    # a request already slot-resident is never double-counted
+    sched._admitting = None
+    sched._admitting_blocks = None
+    eng.allocator.free(blocks)
+    assert sched.cache_report()["residency"] == []
+
+
+# ------------------------------------------------- admission wait blame
+def test_admission_wait_blame_in_trace(decoder_params):
+    """A request queued behind cache pressure gets 'queued Nms waiting
+    for K block(s)' blame on its trace, and the wait is counted."""
+    clock = FakeClock()
+    # 4 usable blocks of 4 tokens: one 12-token prompt + headroom hogs
+    # the whole cache
+    eng = small_engine(decoder_params, num_blocks=5, block_size=4, slots=2)
+    sched = ContinuousBatchingScheduler(eng, clock=clock)
+    hog = sched.submit([1] * 12, SamplingParams(max_new_tokens=4))
+    sched.step()  # hog admitted: needs blocks_for(13) = 4 blocks = all
+    waiter = sched.submit([2] * 8, SamplingParams(max_new_tokens=2))
+    clock.advance(0.060)
+    sched.step()  # waiter blocked on blocks (stamps wait start)
+    clock.advance(0.060)
+    while not hog.done():
+        if not sched.step():
+            break
+    # hog finished -> blocks freed -> waiter admits with blame
+    for _ in range(50):
+        if waiter.done():
+            break
+        sched.step()
+    assert waiter.result(timeout=0)
+    events = [e for e in waiter.trace.to_dict()["events"] if e["event"] == "cache_wait"]
+    assert events, "admission wait left no cache_wait event"
+    ev = events[0]
+    assert ev["wait_s"] > 0 and ev["blocks_short"] >= 1
+    assert "waiting for" in ev["blame"] and "block" in ev["blame"]
+    assert sched.capacity.admission_waits == 1
+    assert sched.capacity.admission_wait_s == pytest.approx(ev["wait_s"])
+
+
+def test_time_at_pressure_on_virtual_clock(decoder_params):
+    clock = FakeClock()
+    eng = small_engine(decoder_params, num_blocks=5, block_size=4, slots=2)
+    sched = ContinuousBatchingScheduler(eng, clock=clock, pressure_threshold=0.5)
+    h = sched.submit([1] * 12, SamplingParams(max_new_tokens=3))
+    sched.step()  # all 4 blocks taken -> free fraction 0 <= 0.5
+    assert sched.capacity.time_at_pressure_s == 0.0  # integrates from NEXT tick
+    clock.advance(2.0)
+    sched.step()
+    assert sched.capacity.time_at_pressure_s == pytest.approx(2.0)
+    while not h.done():
+        if not sched.step():
+            break
+    clock.advance(3.0)
+    sched.step()  # free again: interval not counted
+    assert sched.capacity.time_at_pressure_s == pytest.approx(2.0)
+
+
+# ----------------------------------------------------------- MFU / flops
+def test_serving_flops_model():
+    f = ServingFlops(num_layers=2, hidden_size=32, ff_size=64, vocab_size=50)
+    # hand-computed: per_token = 2*(8*1024 + 4*32*64) + 2*32*50 = 35968
+    assert f.per_token_flops == 2 * (8 * 32 * 32 + 4 * 32 * 64) + 2 * 32 * 50
+    assert f.per_ctx_flops == 2 * 4 * 32
+    assert f.prefill_flops(4) == 4 * f.per_token_flops + f.per_ctx_flops * 10
+    assert f.decode_flops(3, 30) == 3 * f.per_token_flops + f.per_ctx_flops * 30
+    assert f.verify_flops(0, 0) == 0
+    assert f.peak_flops > 0
+
+
+def test_engine_flops_accounting_and_mfu(decoder_params):
+    eng = small_engine(decoder_params)
+    assert eng.total_flops() == 0 and eng.mfu() == 0.0
+    eng.generate([[1, 2, 3, 4]], SamplingParams(max_new_tokens=5))
+    assert eng.flops_by_kind["prefill"] == eng.flops_model.prefill_flops(4)
+    assert eng.flops_by_kind["decode"] > 0
+    assert eng.total_device_time_s() > 0
+    assert 0 < eng.mfu() < 1  # CPU is nowhere near TPU peak
+    # speculative path accounts verify flops
+    eng.generate([[5, 6, 5, 6, 5, 6]], SamplingParams(max_new_tokens=6),
+                 speculation=SpeculationConfig(k=2, method="ngram"))
+    assert eng.flops_by_kind["verify"] > 0
+    sched = ContinuousBatchingScheduler(eng)
+    gv = sched.stats.gauge_values()
+    assert gv["mfu"] == pytest.approx(eng.mfu())
+    assert gv["model_tflops_total"] == pytest.approx(eng.total_flops() / 1e12)
+    assert gv["achieved_tflops"] > 0
+
+
+def test_failed_step_accrues_no_flops(decoder_params):
+    """A device step that raises (the case the PR 4 supervisor retries)
+    must not count its FLOPs: accrual pairs with the device_time_s add
+    on the success path only, or MFU inflates under fault storms."""
+    eng = small_engine(decoder_params)
+    eng.generate([[1, 2, 3]], SamplingParams(max_new_tokens=2))  # warm jits
+    flops_before = dict(eng.flops_by_kind)
+    time_before = dict(eng.device_time_s)
+    slots = eng.max_batch_slots
+    args = dict(
+        tokens=np.ones((slots,), np.int32),
+        positions=np.full((slots,), 3, np.int32),
+        block_tables=np.zeros((slots, eng.max_blocks_per_seq), np.int32),
+        active=np.array([True] + [False] * (slots - 1)),
+        temps=np.zeros((slots,), np.float32),
+        top_ks=np.zeros((slots,), np.int32),
+        keys=jnp.stack([jax.random.key(0)] * slots),
+    )
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error", error=FaultInjected, nth=(0,))
+    with plan.active():
+        with pytest.raises(FaultInjected):
+            eng.decode(**args)
+    assert eng.flops_by_kind == flops_before  # failed step: no FLOPs
+    assert eng.device_time_s == time_before  # and no paired time
+    eng.decode(**args)  # same step succeeding does accrue both
+    assert eng.flops_by_kind["decode"] > flops_before["decode"]
+    assert eng.device_time_s["decode"] > time_before["decode"]
+
+
+# --------------------------------------------------------------- goodput
+def test_goodput_stats_unit():
+    g = GoodputStats()
+    g.record(10, good=True)
+    g.record(6, good=False)
+    assert g.tokens_total == 16 and g.tokens_good == 10
+    assert g.requests_total == 2 and g.requests_good == 1
+    assert g.ratio() == pytest.approx(10 / 16)
+
+
+def test_deadline_goodput_on_virtual_clock(decoder_params):
+    """Tokens on an expired request count in the denominator only."""
+    clock = FakeClock()
+    eng = small_engine(decoder_params)
+    sched = ContinuousBatchingScheduler(eng, clock=clock)
+    ok = sched.submit([1, 2, 3], SamplingParams(max_new_tokens=4))
+    late = sched.submit([4, 5, 6], SamplingParams(max_new_tokens=8),
+                        deadline_s=0.5)
+    sched.step()  # admit both, first tokens
+    clock.advance(1.0)  # late's deadline expires mid-generation
+    for _ in range(30):
+        if ok.done() and late.done():
+            break
+        sched.step()
+    assert ok.result(timeout=0)
+    with pytest.raises(Exception):
+        late.result(timeout=0)
+    gp = sched.goodput
+    assert gp.requests_total == 2 and gp.requests_good == 1
+    assert gp.tokens_good == 4
+    assert gp.tokens_total >= gp.tokens_good + 1  # late emitted something
+    assert 0 < gp.ratio() < 1
+
+
+# ------------------------------------------------------ program registry
+def test_program_registry_records_and_blames_retrace(decoder_params):
+    eng = small_engine(decoder_params)
+    sched = ContinuousBatchingScheduler(eng)
+    h = sched.submit([1, 2, 3], SamplingParams(max_new_tokens=4))
+    while not h.done():
+        if not sched.step():
+            break
+    names = {p["name"] for p in eng.programs.snapshot()}
+    assert "decode" in names and "prefill[8]" in names
+    decode = next(p for p in eng.programs.snapshot() if p["name"] == "decode")
+    assert decode["traces"] == 1
+    assert decode["compile_s"] is not None and decode["compile_s"] > 0
+    assert decode["signature"]["tokens"] == "int32[3]"
+    assert eng.programs.total_retraces() == 0
+    # forced batch-widening retrace: the registry must say exactly what
+    # changed, and the blame must land on the flight ring
+    b = eng.max_batch_slots + 1
+    keys = jnp.stack([jax.random.key(0)] * b)
+    eng._decode_jit(
+        eng.params, jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+        eng.cache.k, eng.cache.v,
+        jnp.zeros((b, eng.max_blocks_per_seq), jnp.int32),
+        jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32),
+        jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32), keys,
+    )
+    assert eng.programs.total_retraces() == 1
+    (retrace,) = eng.programs.recent_retraces()
+    assert retrace["program"] == "decode"
+    assert "decode retraced" in retrace["blame"]
+    assert f"tokens int32[{eng.max_batch_slots}] -> int32[{b}]" in retrace["blame"]
+    flight_retraces = [r for r in sched.flight.snapshot() if r["kind"] == "retrace"]
+    assert flight_retraces and flight_retraces[0]["blame"] == retrace["blame"]
+
+
+def test_registry_unit_blame_and_instrument():
+    reg = ProgramRegistry()
+    assert reg.note_trace("p", {"x": np.zeros((4, 8), np.float32)}) is None
+    blame = reg.note_trace("p", {"x": np.zeros((5, 8), np.float32)})
+    assert blame == "p retraced: x float32[4,8] -> float32[5,8]"
+    assert reg.note_trace("p", {"x": np.zeros((5, 8), np.float32)}).endswith(
+        "(jit cache eviction or weak-type change)"
+    )
+    seen = []
+    reg.on_retrace = lambda name, b: seen.append((name, b))
+    reg.note_trace("p", {"y": np.zeros((1,), np.int32)})
+    assert seen and "x float32[5,8] -> <absent>" in seen[0][1]
+    assert "y" in seen[0][1]
+    # instrument(): generic positional capture for executor programs
+    wrapped = reg.instrument("q", lambda a, b: a)
+    wrapped(np.zeros((2,), np.float32), 3)
+    wrapped(np.zeros((7,), np.float32), 3)
+    entry = next(p for p in reg.snapshot() if p["name"] == "q")
+    assert entry["traces"] == 2
+    assert "arg0 float32[2] -> float32[7]" in entry["last_blame"]
+
+
+def test_registry_namespace_eviction():
+    """Executors evict their executor[N] namespace on GC (weakref
+    finalizer -> remove_namespace): a process rebuilding executors in a
+    loop must not grow GLOBAL_PROGRAMS without bound."""
+    reg = ProgramRegistry()
+    reg.note_trace("executor[0].forward", {"x": np.zeros((2,), np.float32)})
+    reg.note_trace("executor[0].forward", {"x": np.zeros((3,), np.float32)})
+    reg.note_trace("executor[0].train_window[4]", {"x": 1})
+    reg.note_trace("executor[1].forward", {"x": np.zeros((2,), np.float32)})
+    assert reg.total_retraces() == 1
+    reg.remove_namespace("executor[0]")
+    assert {e["name"] for e in reg.snapshot()} == {"executor[1].forward"}
+    assert reg.recent_retraces() == []  # its retrace records went too
+    assert reg.total_retraces() == 0
+
+
+def test_zero_steady_state_retraces_with_telemetry(decoder_params):
+    """Capacity telemetry must not perturb jit shapes: a warmed engine
+    serving a mixed stream with observability ON retraces nothing."""
+    eng = small_engine(decoder_params)
+    eng.generate([[1] * 6], SamplingParams(max_new_tokens=2))
+    eng.generate([[1] * 12], SamplingParams(max_new_tokens=2))
+    warm = dict(eng.trace_counts)
+    sched = ContinuousBatchingScheduler(eng, observability=True)
+    hs = [sched.submit([i + 1] * (4 + i), SamplingParams(max_new_tokens=5))
+          for i in range(4)]
+    while any(not h.done() for h in hs):
+        if not sched.step():
+            break
+    assert all(len(h.result(timeout=0)) == 5 for h in hs)
+    assert dict(eng.trace_counts) == warm  # zero added traces
+    assert eng.programs.total_retraces() == 0
+
+
+# ------------------------------------------------------------------- SLO
+def test_slo_burn_rates_on_virtual_clock():
+    clock = FakeClock()
+    mon = SLOMonitor(
+        [SLObjective("ttft", metric="ttft", target=0.9, threshold_s=1.0)],
+        clock=clock, fast_window_s=300.0, slow_window_s=3600.0,
+    )
+    for _ in range(9):
+        mon.observe("completed", ttft_s=0.1)
+    mon.observe("completed", ttft_s=5.0)  # 1 bad in 10 = exactly on budget
+    assert mon.burn_rate("ttft", "fast") == pytest.approx(1.0)
+    assert mon.burn_rate("ttft", "slow") == pytest.approx(1.0)
+    assert mon.breaching() == ["ttft"]  # burn >= 1.0 on both windows
+    # fast window expires -> breach clears (slow alone never pages)
+    clock.advance(301.0)
+    assert mon.burn_rate("ttft", "fast") == 0.0
+    assert mon.burn_rate("ttft", "slow") == pytest.approx(1.0)
+    assert mon.breaching() == []
+    # a fresh burst of violations re-breaches through both windows
+    for _ in range(5):
+        mon.observe("completed", ttft_s=9.0)
+    assert mon.burn_rate("ttft", "fast") == pytest.approx(10.0)
+    assert mon.breaching() == ["ttft"]
+    snap = mon.snapshot()
+    assert snap["healthy"] is False and snap["breaching"] == ["ttft"]
+    obj = snap["objectives"][0]
+    assert obj["fast"]["events"] == 5 and obj["fast"]["bad"] == 5
+    assert obj["slow"]["events"] == 15 and obj["slow"]["bad"] == 6
+
+
+def test_slo_availability_and_skipped_latency_samples():
+    clock = FakeClock()
+    mon = SLOMonitor(
+        [
+            SLObjective("avail", metric="availability", target=0.5),
+            SLObjective("tpot", metric="tpot", target=0.5, threshold_s=0.1),
+        ],
+        clock=clock,
+    )
+    mon.observe("completed", ttft_s=0.1, tpot_s=None)  # tpot skipped
+    mon.observe("PoisonedRequestError", ttft_s=None, tpot_s=0.5)
+    assert mon.snapshot()["objectives"][1]["fast"]["events"] == 1
+    assert mon.burn_rate("avail", "fast") == pytest.approx(1.0)
+    assert mon.burn_rate("tpot", "fast") == pytest.approx(2.0)
+    # client cancellation / shutdown drain settles as ShuttingDownError:
+    # neither good nor bad for availability — client behavior must not
+    # burn the service's error budget
+    mon.observe("ShuttingDownError")
+    assert mon.snapshot()["objectives"][0]["fast"]["events"] == 2
+    assert mon.burn_rate("avail", "fast") == pytest.approx(1.0)
+
+
+def test_slo_slow_window_exact_under_sustained_rate():
+    """The slow window must count its full hour even at request rates
+    where a count-capped per-event ring would have truncated it
+    (regression: maxlen=4096 shrank the 1h window to ~13min at 5 req/s,
+    collapsing multi-window breach detection toward the fast window)."""
+    clock = FakeClock()
+    mon = SLOMonitor(
+        [SLObjective("avail", metric="availability", target=0.9)],
+        clock=clock, fast_window_s=300.0, slow_window_s=3600.0,
+    )
+    # 5 req/s for 30 virtual minutes = 9000 events; the first 900 are
+    # bad — old behavior evicted them by count, hiding the burn
+    for i in range(9000):
+        clock.t = i * 0.2
+        mon.observe("completed" if i >= 900 else "QueueFullError")
+    snap = mon.snapshot()["objectives"][0]
+    assert snap["slow"]["events"] == 9000 and snap["slow"]["bad"] == 900
+    assert mon.burn_rate("avail", "slow") == pytest.approx(1.0)
+    # the fast window sees only the trailing 300s (all good)
+    assert snap["fast"]["events"] == 1500 and snap["fast"]["bad"] == 0
+
+
+def test_scheduler_feeds_slo_and_gauges(decoder_params):
+    clock = FakeClock()
+    eng = small_engine(decoder_params)
+    sched = ContinuousBatchingScheduler(
+        eng, clock=clock,
+        slo_objectives=[
+            SLObjective("ttft_tight", metric="ttft", target=0.9, threshold_s=0.5),
+            SLObjective("availability", metric="availability", target=0.9),
+        ],
+    )
+    h = sched.submit([1, 2, 3], SamplingParams(max_new_tokens=3))
+    clock.advance(2.0)  # TTFT will be 2.0 > 0.5 -> SLO violation
+    while not h.done():
+        if not sched.step():
+            break
+    assert h.result(timeout=0)
+    assert sched.slo.observed == 1
+    assert sched.slo.burn_rate("ttft_tight", "fast") == pytest.approx(10.0)
+    assert sched.slo.burn_rate("availability", "fast") == 0.0
+    gv = sched.stats.gauge_values()
+    assert gv["slo_ttft_tight_burn_fast"] == pytest.approx(10.0)
+    assert gv["slo_availability_burn_fast"] == 0.0
+    assert gv["slo_breaching_total"] == 1
+    assert gv["slo_ttft_tight_breaching"] == 1
+
+
+def test_observability_off_keeps_slo_and_capacity_inert(decoder_params):
+    eng = small_engine(decoder_params)
+    sched = ContinuousBatchingScheduler(eng, observability=False)
+    h = sched.submit([1, 2, 3], SamplingParams(max_new_tokens=4))
+    while not h.done():
+        if not sched.step():
+            break
+    assert h.result(timeout=0)
+    assert sched.slo.observed == 0  # no sink installed
+    assert sched.goodput.requests_total == 0
+    assert sched.capacity.time_at_pressure_s == 0.0
+    # the report itself still works (debug endpoint on a dark scheduler)
+    check_conservation(sched)
+
+
+# ---------------------------------------------------- flight dual clocks
+def test_flight_records_carry_both_clocks(decoder_params):
+    clock = FakeClock(100.0)
+    eng = small_engine(decoder_params)
+    sched = ContinuousBatchingScheduler(eng, clock=clock)
+    h = sched.submit([1, 2, 3], SamplingParams(max_new_tokens=3))
+    while not h.done():
+        if not sched.step():
+            break
+    records = sched.flight.snapshot()
+    assert records
+    for rec in records:
+        assert "t" in rec and "t_sched" in rec
+        assert rec["t_sched"] == 100.0  # the virtual clock, verbatim
+    # the chrome timeline renders from the physical clock only: offsets
+    # are non-negative and finite even though t_sched is frozen
+    trace = sched.flight.to_chrome_trace()
+    ts = [e["ts"] for e in trace["traceEvents"] if "ts" in e]
+    assert ts and all(t >= 0 for t in ts)
+    json.dumps(trace)
+
+
+def test_flight_recorder_without_sched_clock_has_no_t_sched():
+    fr = FlightRecorder(capacity=4)
+    fr.record_step("decode", phases={"device": 0.001})
+    (rec,) = fr.snapshot()
+    assert "t_sched" not in rec
+
+
+# ------------------------------------------------------------- HTTP e2e
+@pytest.fixture(scope="module")
+def gen_server(decoder_params):
+    eng = small_engine(decoder_params)
+    srv = InferenceServer(port=0)
+    # default objective names, but thresholds real wall-clock timing
+    # (cold jit compiles, loaded CI runners) can never breach — this
+    # test covers the endpoint surface, not latency judgments
+    lenient = [
+        SLObjective("ttft_p95", metric="ttft", target=0.95, threshold_s=1e6),
+        SLObjective("tpot_p95", metric="tpot", target=0.95, threshold_s=1e6),
+        SLObjective("availability", metric="availability", target=0.999),
+    ]
+    srv.register_generation(GenerationModel(eng, name="lm", slo_objectives=lenient))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_capacity_endpoints(gen_server):
+    base = f"http://127.0.0.1:{gen_server.port}"
+    code, resp = _post(base, "/v2/models/lm/generate",
+                       {"prompt": [1, 2, 3, 4], "max_new_tokens": 5})
+    assert code == 200 and len(resp["tokens"]) == 5
+
+    cache = json.load(urllib.request.urlopen(f"{base}/v2/debug/cache", timeout=30))
+    rep = cache["models"]["lm"]
+    assert rep["blocks"]["used"] + rep["blocks"]["free"] == rep["blocks"]["total"]
+    assert rep["blocks"]["allocated_total"] >= 1
+
+    progs = json.load(urllib.request.urlopen(f"{base}/v2/debug/programs", timeout=30))
+    names = {p["name"] for p in progs["models"]["lm"]["programs"]}
+    assert "decode" in names
+    assert "executor" in progs  # the process-wide registry rides along
+
+    slo = json.load(urllib.request.urlopen(f"{base}/v2/slo", timeout=30))
+    rep = slo["models"]["lm"]
+    assert rep["observed"] >= 1
+    assert {o["name"] for o in rep["objectives"]} == {
+        "ttft_p95", "tpot_p95", "availability"
+    }
+
+    ready = json.load(urllib.request.urlopen(f"{base}/v2/health/ready", timeout=30))
+    assert ready["ready"] is True
+    rationale = ready["models"]["lm"]
+    assert rationale["breaker"] == "closed"
+    assert rationale["slo_breaching"] == []
+    assert rationale["watchdog_trips"] == 0
+
+    one = json.load(urllib.request.urlopen(f"{base}/v2/models/lm/ready", timeout=30))
+    assert one["ready"] is True and one["rationale"]["breaker"] == "closed"
+
+    metrics = urllib.request.urlopen(f"{base}/metrics", timeout=30).read().decode()
+    for gauge in ("cache_frag_slots", "cache_free_low_water", "mfu",
+                  "achieved_tflops", "goodput_ratio", "slo_breaching_total",
+                  "slo_ttft_p95_burn_fast"):
+        assert f"flexflow_serving_{gauge}{{" in metrics, gauge

@@ -46,17 +46,7 @@ from flexflow_tpu.serving import (
 pytestmark = pytest.mark.chaos
 
 
-class FakeClock:
-    """Virtual time for deadlines and breaker recovery windows."""
-
-    def __init__(self, t: float = 0.0):
-        self.t = t
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, dt: float) -> None:
-        self.t += dt
+from conftest import FakeClock  # noqa: E402
 
 
 @pytest.fixture(scope="module")

@@ -54,18 +54,7 @@ BUCKETS = (8, 16, 32, 64)
 BLOCK = 8
 
 
-class FakeClock:
-    """Virtual time for deadlines and breaker recovery windows (same
-    idiom as tests/test_chaos.py)."""
-
-    def __init__(self, t: float = 0.0):
-        self.t = t
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, dt: float) -> None:
-        self.t += dt
+from conftest import FakeClock  # noqa: E402
 
 
 @pytest.fixture(scope="module")

@@ -51,15 +51,7 @@ CFG = TransformerConfig(
 )
 
 
-class FakeClock:
-    def __init__(self, t: float = 0.0):
-        self.t = t
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, dt: float) -> None:
-        self.t += dt
+from conftest import FakeClock  # noqa: E402
 
 
 @pytest.fixture(scope="module")
@@ -225,6 +217,16 @@ def _golden_stats():
     s.add_gauge("queue_depth", lambda: 2)
     s.add_gauge("cache_occupancy", lambda: 0.25)
     s.add_gauge("dead_gauge", lambda: 1 / 0)  # must be skipped, not fatal
+    # PR 6 capacity/compute/SLO families (binary-exact values)
+    s.add_gauge("cache_frag_slots", lambda: 5)
+    s.add_gauge("cache_pressure_time_s", lambda: 1.5)
+    s.add_gauge("cache_admission_waits", lambda: 1)
+    s.add_gauge("mfu", lambda: 0.125)
+    s.add_gauge("achieved_tflops", lambda: 0.5)
+    s.add_gauge("goodput_tokens_total", lambda: 8)
+    s.add_gauge("goodput_ratio", lambda: 0.75)
+    s.add_gauge("slo_ttft_p95_burn_fast", lambda: 2)
+    s.add_gauge("slo_breaching_total", lambda: 1)
     return s
 
 

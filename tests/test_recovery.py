@@ -49,15 +49,7 @@ BLOCK = 8
 NO_SLEEP = RecoveryPolicy(sleep=lambda _s: None)
 
 
-class FakeClock:
-    def __init__(self, t: float = 0.0):
-        self.t = t
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, dt: float) -> None:
-        self.t += dt
+from conftest import FakeClock  # noqa: E402
 
 
 @pytest.fixture(scope="module")

@@ -35,11 +35,18 @@ traces + the flight recorder cost < ``--max-trace-overhead`` (default
 overhead report, the flight-recorder chrome://tracing dump, and a
 sample request trace.
 
+Every mode also merges its report into a machine-readable
+``--bench-out`` artifact (default ``BENCH_GEN.json``) keyed by mode —
+tok/s, TTFT percentiles, serving MFU, cache telemetry, acceptance rate
+— so the bench trajectory accumulates one comparable JSON per PR
+(uploaded by tpu-ci next to bench_result.json).
+
 Usage:
   python tools/genbench.py [--out genbench.json] [--requests 12]
       [--max-new 16] [--layers 2] [--hidden 64] [--heads 4] [--vocab 128]
       [--speculate] [--spec-k 4] [--min-speedup 1.5]
       [--trace-out trace.json] [--max-trace-overhead 0.03]
+      [--bench-out BENCH_GEN.json]
 """
 from __future__ import annotations
 
@@ -61,6 +68,50 @@ from flexflow_tpu.generation import (  # noqa: E402
     init_decoder_params,
 )
 from flexflow_tpu.models.transformer import TransformerConfig  # noqa: E402
+
+
+def capacity_block(sched) -> dict:
+    """Cache + compute telemetry snapshot for the bench artifact."""
+    gv = sched.stats.gauge_values()
+    ws = sched.stats.window_snapshots()
+    ttft = ws.get("ttft", {})
+    return {
+        "mfu": gv.get("mfu"),
+        "achieved_tflops": gv.get("achieved_tflops"),
+        "model_tflops_total": gv.get("model_tflops_total"),
+        "ttft_p50_s": ttft.get("p50_s"),
+        "ttft_p95_s": ttft.get("p95_s"),
+        "goodput_ratio": gv.get("goodput_ratio"),
+        "cache": {
+            "frag_slots": gv.get("cache_frag_slots"),
+            "free_low_water": gv.get("cache_free_low_water"),
+            "blocks_total": gv.get("cache_blocks_total"),
+            "preempt_reclaimed_blocks": gv.get("cache_preempt_reclaimed_blocks"),
+            "trimmed_blocks": gv.get("cache_trimmed_blocks"),
+            "pressure_time_s": gv.get("cache_pressure_time_s"),
+            "admission_waits": gv.get("cache_admission_waits"),
+        },
+    }
+
+
+def write_bench_artifact(path: str, mode: str, payload: dict) -> None:
+    """Merge one mode's report into the cumulative bench artifact, so a
+    run of several modes (tpu-ci runs --speculate then --trace-out)
+    accumulates into one JSON."""
+    if not path:
+        return
+    data = {}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except (OSError, ValueError):
+        data = {}
+    data[mode] = payload
+    data["backend"] = jax.default_backend()
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
 
 
 def run_stream(engine, prompts, sampling, speculation=None):
@@ -180,6 +231,7 @@ def speculate_bench(args, cfg, params) -> tuple:
         "spec_k": args.spec_k,
         "verify_trace_counts": spec_eng.trace_counts,
         "steady_state_retraces": steady_retraces,
+        "capacity": capacity_block(spec_sched),
         "backend": jax.default_backend(),
     }
     ok = check_no_self_healing(
@@ -274,6 +326,7 @@ def trace_overhead_bench(args, cfg, params) -> tuple:
         "max_trace_overhead": args.max_trace_overhead,
         "steady_state_retraces": steady_retraces,
         "flight_records": len(traced_sched.flight.snapshot()),
+        "capacity": capacity_block(traced_sched),
         "backend": jax.default_backend(),
     }
     ok = True
@@ -322,6 +375,9 @@ def main() -> int:
                          "chrome timeline + sample trace to this file")
     ap.add_argument("--max-trace-overhead", type=float, default=0.03)
     ap.add_argument("--trace-repeats", type=int, default=3)
+    ap.add_argument("--bench-out", default="BENCH_GEN.json",
+                    help="cumulative machine-readable bench artifact "
+                         "(merged per mode; '' disables)")
     args = ap.parse_args()
     args.max_new_set = args.max_new is not None
     if args.max_new is None:
@@ -336,6 +392,7 @@ def main() -> int:
 
     if args.trace_out:
         report, ok = trace_overhead_bench(args, cfg, params)
+        write_bench_artifact(args.bench_out, "trace_overhead", report)
         if not ok:
             return 1
         print(
@@ -346,6 +403,7 @@ def main() -> int:
 
     if args.speculate:
         report, ok = speculate_bench(args, cfg, params)
+        write_bench_artifact(args.bench_out, "speculate", report)
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=2)
@@ -408,10 +466,12 @@ def main() -> int:
         "trace_counts": engine.trace_counts,
         "steady_state_retraces": steady_retraces,
         "recompiles": engine.recompiles(),
+        "capacity": capacity_block(sched),
         "backend": jax.default_backend(),
     }
     ok = check_no_self_healing(report, [sched], [engine])
     print(json.dumps(report, indent=2))
+    write_bench_artifact(args.bench_out, "baseline", report)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=2)

@@ -17,6 +17,15 @@ Against a live server (serving/server.py):
       Dump the engine flight recorder as chrome://tracing JSON (open in
       chrome://tracing or https://ui.perfetto.dev).
 
+  python tools/obsreport.py --url ... cache
+      Capacity view (GET /v2/debug/cache): per-request block residency
+      table, fragmentation, free-block watermarks, pressure time, and
+      admission-wait blame — the "why are requests queueing?" answer.
+
+  python tools/obsreport.py --url ... slo
+      SLO view (GET /v2/slo): per-objective fast/slow burn rates and
+      breach state.
+
 CI self-check (no server needed; used by .github/workflows/tpu-ci.yml):
 
   python tools/obsreport.py --selfcheck
@@ -110,6 +119,58 @@ def show_request(base: str, request_id: int) -> int:
             prev = ev["t"]
         if tr.get("error"):
             print(f"    error: {tr['error']}")
+    return 0
+
+
+def show_cache(base: str) -> int:
+    """Block-residency table + capacity counters per model."""
+    payload = _get_json(f"{base}/v2/debug/cache")
+    for name, rep in sorted(payload.get("models", {}).items()):
+        blocks = rep["blocks"]
+        print(f"model {name!r}: blocks used={blocks['used']}/{blocks['total']} "
+              f"free={blocks['free']} (low_water={blocks['low_water']} "
+              f"high_water={blocks['high_water']})")
+        print(f"    fragmentation={rep['fragmentation_slots']} slot(s)  "
+              f"occupancy={rep['occupancy']:.2f}  queue_depth={rep['queue_depth']}")
+        p = rep["pressure"]
+        print(f"    pressure: under={p['under_pressure']} "
+              f"time_at_pressure={p['time_at_pressure_s'] * 1e3:.1f}ms "
+              f"(threshold {p['threshold']:.0%} free)")
+        c = rep["counters"]
+        print(f"    reclaims: preempt={c['preempt_reclaimed_blocks']} blocks "
+              f"({c['preempt_reclaims']}x)  trim={c['trimmed_blocks']} blocks "
+              f"({c['trims']}x)")
+        print(f"    admission waits: {c['admission_waits']} "
+              f"({c['admission_wait_s'] * 1e3:.1f}ms total)"
+              + (f"  last: {c['last_wait_blame']}" if c.get("last_wait_blame") else ""))
+        rows = rep.get("residency", [])
+        if rows:
+            print("    residency:")
+            print("      req       slot  blocks  alloc_slots  live_tokens  frag")
+            for r in rows:
+                print(f"      {r['request_id']:<9} {r['slot']:<5} {r['blocks']:<7} "
+                      f"{r['allocated_slots']:<12} {r['live_tokens']:<12} "
+                      f"{r['frag_slots']}")
+        else:
+            print("    residency: (no running requests)")
+    return 0
+
+
+def show_slo(base: str) -> int:
+    """Burn-rate summary per objective."""
+    payload = _get_json(f"{base}/v2/slo")
+    for name, rep in sorted(payload.get("models", {}).items()):
+        state = "HEALTHY" if rep["healthy"] else f"BREACHING: {rep['breaching']}"
+        print(f"model {name!r}: {state} ({rep['observed']} requests observed)")
+        for obj in rep["objectives"]:
+            thr = f" <= {obj['threshold_s']}s" if obj["threshold_s"] is not None else ""
+            fast, slow = obj["fast"], obj["slow"]
+            flag = "  << BREACHING" if obj["breaching"] else ""
+            print(f"    {obj['name']:<16} {obj['metric']}{thr} target={obj['target']}")
+            print(f"        fast {fast['window_s']:.0f}s: burn={fast['burn_rate']:.2f} "
+                  f"({fast['bad']}/{fast['events']} bad)   "
+                  f"slow {slow['window_s']:.0f}s: burn={slow['burn_rate']:.2f} "
+                  f"({slow['bad']}/{slow['events']} bad){flag}")
     return 0
 
 
@@ -256,6 +317,62 @@ def selfcheck() -> int:
         # after plan removal /metrics must still parse
         metrics = _get(f"{base}/metrics")
         check(not validate_exposition(metrics), "/metrics broke after chaos")
+
+        # -------------------------- capacity: cache telemetry is honest
+        cache = _get_json(f"{base}/v2/debug/cache")["models"]["lm"]
+        blocks = cache["blocks"]
+        # real conservation, not the tautological used+free==total (used
+        # is computed as total-free): every block ever handed out is
+        # accounted as freed, reclaimed by reset, or still resident
+        check(blocks["allocated_total"] == blocks["freed_total"]
+              + blocks["reset_reclaimed_total"] + blocks["used"],
+              f"cache conservation broken: {blocks}")
+        check(sum(r["blocks"] for r in cache["residency"]) == blocks["used"],
+              f"residency does not sum to used: {cache['residency']} vs {blocks}")
+        check(blocks["low_water"] < blocks["total"],
+              "low-water mark never moved despite served requests")
+        for series in ("cache_occupancy", "mfu", "goodput_ratio",
+                       "slo_breaching_total"):
+            check(f"flexflow_serving_{series}{{" in metrics,
+                  f"/metrics missing {series}")
+
+        # -------------------- program registry: non-empty, blame works
+        progs = _get_json(f"{base}/v2/debug/programs")
+        entries = progs["models"]["lm"]["programs"]
+        names = {p["name"] for p in entries}
+        check("decode" in names and any(n.startswith("prefill[") for n in names),
+              f"program registry missing engine programs: {sorted(names)}")
+        check(all(p["compile_s"] is not None for p in entries
+                  if p["name"] == "decode"),
+              "decode program has no compile wall time")
+        # force a retrace (batch widened by one) and require a correct,
+        # human-readable blame string on the registry
+        import jax.numpy as jnp
+        b = eng.max_batch_slots + 1
+        keys = jnp.stack([jax.random.key(0)] * b)
+        eng._decode_jit(
+            eng.params, jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+            eng.cache.k, eng.cache.v,
+            jnp.zeros((b, eng.max_blocks_per_seq), jnp.int32),
+            jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32),
+            jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32), keys,
+        )
+        retraces = _get_json(f"{base}/v2/debug/programs")["models"]["lm"]["retraces"]
+        check(retraces, "forced retrace produced no registry record")
+        blame = retraces[-1]["blame"] if retraces else ""
+        check("decode retraced" in blame
+              and f"int32[{eng.max_batch_slots}] -> int32[{b}]" in blame,
+              f"retrace blame string wrong: {blame!r}")
+
+        # ------------------------------- SLO + readiness rationale sane
+        slo = _get_json(f"{base}/v2/slo")["models"]["lm"]
+        check(slo["observed"] >= 3 and slo["objectives"],
+              f"SLO monitor saw no requests: {slo['observed']}")
+        ready = _get_json(f"{base}/v2/health/ready")
+        rationale = ready.get("models", {}).get("lm", {})
+        check(rationale.get("breaker") == "closed"
+              and "slo_breaching" in rationale,
+              f"readiness rationale incomplete: {rationale}")
     finally:
         srv.stop()
 
@@ -264,13 +381,20 @@ def selfcheck() -> int:
         return 1
     print("OK: obsreport selfcheck — traces complete (queue/TTFT/TPOT), "
           "/metrics parses with non-empty histograms, quarantine + restart "
-          "each captured a flight-recorder postmortem")
+          "each captured a flight-recorder postmortem, cache telemetry "
+          "conserves blocks, program registry populated and a forced "
+          "retrace produced a correct blame string, SLO + readiness "
+          "rationale live")
     return 0
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("command", nargs="?", default="summary",
+                    choices=("summary", "cache", "slo"),
+                    help="view: summary (default), cache (block "
+                         "residency), slo (burn rates)")
     ap.add_argument("--url", default="", help="base URL of a running server")
     ap.add_argument("--request", type=int, default=None,
                     help="print one request's trace waterfall")
@@ -289,6 +413,10 @@ def main() -> int:
         return show_request(base, args.request)
     if args.timeline_out:
         return dump_timeline(base, args.timeline_out)
+    if args.command == "cache":
+        return show_cache(base)
+    if args.command == "slo":
+        return show_slo(base)
     return summarize(base)
 
 
