@@ -33,6 +33,7 @@ import numpy as np
 
 from ..models.transformer import TransformerConfig
 from ..obs.capacity import ProgramRegistry, ServingFlops
+from ..obs.truth import PredictionLedger
 from ..runtime import faults
 from .cache import BlockAllocator, CacheConfig, KVCache, slot_mapping
 from .decoder import DecoderParams, decode_step, prefill, verify_step
@@ -174,13 +175,35 @@ class GenerationEngine:
         # per step kind — true prompt lengths and live context only, so
         # MFU = flops / device_time_s / chip peak is padding-honest.
         # Recovery replay / bisection probes accrue in BOTH terms (they
-        # are real device work); goodput_ratio is the client-useful view
-        self.flops_model = ServingFlops.from_config(cfg, dtype=cache_config.dtype)
+        # are real device work); goodput_ratio is the client-useful view.
+        # The chip comes from the detected device kind (the calibration
+        # preset table), so MFU and the truth ledger's roofline
+        # predictions use real peaks instead of the generic default.
+        from ..search.calibration import chip_spec_for, detected_device_kind
+
+        kind = detected_device_kind(self.backend)
+        self.flops_model = ServingFlops.from_config(
+            cfg, dtype=cache_config.dtype, chip=chip_spec_for(kind)
+        )
+        # drift alarms only where the roofline means something: on the
+        # CPU backend the prediction models a chip that is not there
+        # (dispatch overhead dominates, peaks are uncalibrated), so the
+        # pairs still record — an operator can read the error — but a
+        # permanently-wrong prediction must not spam the flight ring
+        self._roofline_alarm = jax.default_backend() != "cpu"
         self.flops_by_kind: Dict[str, float] = {"prefill": 0.0, "decode": 0.0, "verify": 0.0}
         # jit program registry: every traced program's static signature,
         # trace count, and compile wall time; retraces carry blame
         # strings (GET /v2/debug/programs)
         self.programs = ProgramRegistry()
+        # cost-model truth ledger (obs/truth.py): every steady-state
+        # step pairs its roofline-predicted time (same derate constants
+        # as the search cost model) with measured wall seconds; EWMA
+        # drift alarms land on the flight ring and
+        # GET /v2/debug/predictions serves the pairs. Compile calls are
+        # excluded — their wall time is compile cost, stamped into the
+        # program registry instead.
+        self.ledger = PredictionLedger()
         # per-slot finiteness of the last step's logits (the supervisor's
         # NaN blame vector: a cheap in-jit isfinite reduce, so a poisoned
         # request is pinned to its slot without extra device calls);
@@ -342,12 +365,29 @@ class GenerationEngine:
         # FLOPs accrue only on SUCCESS, next to the time they pair with:
         # a step that raises (and is retried by the supervisor) must not
         # count its FLOPs without its time, or MFU inflates under faults
-        self.flops_by_kind["prefill"] += self.flops_model.prefill_flops(n)
+        flops = self.flops_model.prefill_flops(n)
+        self.flops_by_kind["prefill"] += flops
         self.device_time_s["prefill"] += elapsed
         if self.trace_counts.get(f"prefill[{bucket}]", 0) > traces_before:
             # this call traced (first compile or a retrace): its wall
             # time is the program's compile cost, registry-stamped
             self.programs.set_compile_time(f"prefill[{bucket}]", elapsed)
+        else:
+            # ledger prediction covers EXECUTED work — the program
+            # computes the full padded bucket, so predicting from the
+            # true prompt length would alarm on every short prompt in a
+            # wide bucket. MFU above stays useful-work-only.
+            self.ledger.observe(
+                f"prefill[{bucket}]",
+                self.flops_model.roofline_s(
+                    self.flops_model.prefill_flops(bucket),
+                    self.flops_model.prefill_bytes(bucket),
+                ),
+                elapsed,
+                label=f"prefill[{bucket}] ({self.flops_model.chip.name})",
+                provenance="serving roofline (ServingFlops x chip peak)",
+                alarm=self._roofline_alarm,
+            )
         return out
 
     def decode(
@@ -395,12 +435,28 @@ class GenerationEngine:
         result = np.asarray(out)  # result sync included in the timing
         elapsed = time.perf_counter() - t0
         # success-only, paired with the time below (see prefill())
-        self.flops_by_kind["decode"] += self.flops_model.decode_flops(
-            int(active.sum()), int(context_lens.sum())
-        )
+        n_active, ctx_sum = int(active.sum()), int(context_lens.sum())
+        flops = self.flops_model.decode_flops(n_active, ctx_sum)
+        self.flops_by_kind["decode"] += flops
         self.device_time_s["decode"] += elapsed
         if self.trace_counts.get("decode", 0) > traces_before:
             self.programs.set_compile_time("decode", elapsed)
+        else:
+            # EXECUTED work: the fixed-shape program runs every batch
+            # slot's projections/FFN (inactive rows masked to scratch,
+            # but computed); only attention context is truly live-only
+            b = self.max_batch_slots
+            self.ledger.observe(
+                "decode",
+                self.flops_model.roofline_s(
+                    self.flops_model.decode_flops(b, ctx_sum),
+                    self.flops_model.decode_bytes(b, ctx_sum),
+                ),
+                elapsed,
+                label=f"decode ({self.flops_model.chip.name})",
+                provenance="serving roofline (ServingFlops x chip peak)",
+                alarm=self._roofline_alarm,
+            )
         return result
 
     def _bias_arg(self, bias) -> jax.Array:
@@ -464,12 +520,27 @@ class GenerationEngine:
         result = (np.asarray(out), np.asarray(n_emitted))
         elapsed = time.perf_counter() - t0
         # success-only, paired with the time below (see prefill())
-        self.flops_by_kind["verify"] += self.flops_model.verify_flops(
-            int(w_tok.sum()), int(ctx.sum())
-        )
+        n_tok, ctx_sum = int(w_tok.sum()), int(ctx.sum())
+        flops = self.flops_model.verify_flops(n_tok, ctx_sum)
+        self.flops_by_kind["verify"] += flops
         self.device_time_s["verify"] += elapsed
         if self.trace_counts.get("verify", 0) > traces_before:
             self.programs.set_compile_time("verify", elapsed)
+        else:
+            # EXECUTED work: all B x W window positions compute (see
+            # decode) — padding only skips attention context
+            bw = self.max_batch_slots * self.spec_window
+            self.ledger.observe(
+                "verify",
+                self.flops_model.roofline_s(
+                    self.flops_model.verify_flops(bw, ctx_sum),
+                    self.flops_model.verify_bytes(bw, ctx_sum),
+                ),
+                elapsed,
+                label=f"verify ({self.flops_model.chip.name})",
+                provenance="serving roofline (ServingFlops x chip peak)",
+                alarm=self._roofline_alarm,
+            )
         return result
 
     def generate(

@@ -447,6 +447,24 @@ class ContinuousBatchingScheduler:
         # steady-state retrace blame rides the flight ring next to the
         # step that caused it ("decode retraced: batch 8 -> 9")
         self.engine.programs.on_retrace = self._note_retrace
+        # cost-model truth (obs/truth.py): predicted-vs-measured step
+        # times as perf_* gauges, drift alarms onto the flight ring,
+        # full pairs on GET /v2/debug/predictions
+        self.stats.add_gauge(
+            "perf_prediction_pairs", lambda: self.engine.ledger.pairs_total
+        )
+        self.stats.add_gauge(
+            "perf_prediction_error_p50",
+            lambda: self.engine.ledger.error_summary()["abs_err_p50"],
+        )
+        self.stats.add_gauge(
+            "perf_prediction_error_max",
+            lambda: self.engine.ledger.error_summary()["abs_err_max"],
+        )
+        self.stats.add_gauge(
+            "perf_drift_alarms", lambda: self.engine.ledger.alarms_total
+        )
+        self.engine.ledger.on_alarm = self._note_drift
         self._dummy_keys = None  # inactive-slot key rows, built once
         # self-healing (recovery.py): journal + supervisor + watchdog.
         # _heartbeat is (seq, started_at) while a device call is in
@@ -739,6 +757,14 @@ class ContinuousBatchingScheduler:
         """Program-registry retrace hook: the blame string lands on the
         flight ring in true order with the step that retraced."""
         self.flight.record_event("retrace", program=name, blame=blame)
+
+    def _note_drift(self, alarm: Dict) -> None:
+        """Truth-ledger drift hook: the calibration-staleness alarm
+        ("decode: predicted 1.8ms, measured p50 3.1ms, error +72%, ...")
+        lands on the flight ring next to the steps that proved it."""
+        self.flight.record_event(
+            "drift", program=alarm["key"], blame=alarm["blame"]
+        )
 
     def _slo_record(self, req: Request) -> None:
         """Terminal SLO/goodput sink (exactly once per request, via the

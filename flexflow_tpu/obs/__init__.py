@@ -26,6 +26,15 @@ PR 6 adds the *resource* dimension:
   multi-window burn rates on the scheduler's injectable clock
   (:class:`SLOMonitor`, ``GET /v2/slo``).
 
+PR 7 adds the *truth* dimension:
+
+* :mod:`truth` — the :class:`PredictionLedger`: every (predicted,
+  measured) pair the simulator/cost model and the runtime can be made
+  to agree on, with per-key relative-error distributions and an EWMA
+  calibration-drift detector whose alarms carry human blame
+  (``GET /v2/debug/predictions``, ``flexflow_sim_*`` on ``/metrics``,
+  recalibration suggestions back into search/calibration.py).
+
 See tools/obsreport.py for the CLI (summaries, trace waterfalls,
 timeline dumps, cache/SLO views, and the CI ``--selfcheck``).
 """
@@ -45,9 +54,12 @@ from .prom import (
 )
 from .slo import DEFAULT_OBJECTIVES, SLObjective, SLOMonitor
 from .trace import NULL_TRACE, RequestTrace, TraceRing, next_request_id
+from .truth import GLOBAL_LEDGER, PredictionLedger
 
 __all__ = [
     "CacheTelemetry",
+    "PredictionLedger",
+    "GLOBAL_LEDGER",
     "DEFAULT_OBJECTIVES",
     "FlightRecorder",
     "GLOBAL_PROGRAMS",

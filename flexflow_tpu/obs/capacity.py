@@ -294,6 +294,13 @@ class ServingFlops:
             if dtype in (DataType.BFLOAT16, DataType.HALF)
             else self.chip.f32_flops
         )
+        # byte model for the roofline's memory leg (obs/truth.py pairs
+        # predicted step time with measured): each step streams the
+        # weights once and touches the KV cache per live context position
+        self.dtype_bytes = 2 if dtype in (DataType.BFLOAT16, DataType.HALF) else 4
+        self.param_count = 2 * v * e + l * (4 * e * e + 2 * e * f)
+        self.param_bytes = self.param_count * self.dtype_bytes
+        self.kv_bytes_per_pos = 2 * l * e * self.dtype_bytes  # k + v
 
     @classmethod
     def from_config(cls, cfg, dtype: DataType = DataType.FLOAT, chip=None) -> "ServingFlops":
@@ -323,6 +330,33 @@ class ServingFlops:
         drafts across slots) with ``context_sum`` live attended
         positions (window token j at position p attends to p+1)."""
         return n_tokens * self.per_token_flops + self.per_ctx_flops * context_sum
+
+    # ------------------------------------------ predicted step time (truth)
+    def prefill_bytes(self, prompt_len: int) -> float:
+        n = max(0, prompt_len)
+        return self.param_bytes + self.kv_bytes_per_pos * n
+
+    def decode_bytes(self, n_active: int, context_sum: int) -> float:
+        """HBM bytes for one decode step: weights once, KV read per live
+        context position, KV write per active token."""
+        return self.param_bytes + self.kv_bytes_per_pos * (context_sum + n_active)
+
+    def verify_bytes(self, n_tokens: int, context_sum: int) -> float:
+        return self.param_bytes + self.kv_bytes_per_pos * (context_sum + n_tokens)
+
+    def roofline_s(self, flops: float, bytes_hbm: float) -> float:
+        """The search cost model's roofline applied to one serving step
+        — the PREDICT side of the truth ledger, sharing the same derate
+        constants so serving error and search error are comparable."""
+        from ..search.cost_model import (  # lazy: avoid import cycle at load
+            HBM_EFFICIENCY,
+            KERNEL_OVERHEAD,
+            MXU_EFFICIENCY,
+        )
+
+        t_compute = flops / (self.peak_flops * MXU_EFFICIENCY)
+        t_memory = bytes_hbm / (self.chip.hbm_bandwidth * HBM_EFFICIENCY)
+        return max(t_compute, t_memory) + KERNEL_OVERHEAD
 
 
 # --------------------------------------------------------------------------
@@ -483,6 +517,14 @@ class ProgramRegistry:
     def recent_retraces(self) -> List[Dict]:
         with self._lock:
             return list(self.retraces)
+
+    def trace_count(self, name: str) -> int:
+        """Traces recorded for one program (0 if never traced) — callers
+        compare before/after a host call to tell compiles from
+        steady-state runs (the truth ledger excludes compile calls)."""
+        with self._lock:
+            entry = self.entries.get(name)
+            return entry.traces if entry is not None else 0
 
     def total_retraces(self) -> int:
         with self._lock:

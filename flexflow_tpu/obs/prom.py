@@ -77,6 +77,14 @@ _HELP = {
     "engine_failures": "Restart budgets exhausted (engine declared dead).",
     "flexflow_fault_site_calls_total": "Times each fault-injection site was reached (active plan).",
     "flexflow_fault_site_fires_total": "Times a fault rule fired at the site (active plan).",
+    "perf_prediction_pairs": "Predicted-vs-measured pairs joined in the engine's truth ledger.",
+    "perf_prediction_error_p50": "Median per-program absolute relative error of step-time predictions.",
+    "perf_prediction_error_max": "Worst per-program absolute relative error of step-time predictions.",
+    "perf_drift_alarms": "Calibration-drift alarms raised by the engine's truth ledger.",
+    "flexflow_sim_prediction_error_ratio": "Signed relative error of simulator/cost-model predictions vs measured time, per key quantile.",
+    "flexflow_sim_prediction_pairs_total": "Measured samples joined with a registered prediction, per key.",
+    "flexflow_sim_prediction_unpredicted_total": "Measured samples that had no registered prediction (counted, not dropped).",
+    "flexflow_sim_drift_alarms_total": "Calibration-drift alarms raised by the process-wide prediction ledger.",
 }
 
 
@@ -114,9 +122,12 @@ def _help_type(lines, name: str, kind: str) -> None:
 def render_prometheus(
     models: Mapping[str, "object"],
     fault_sites: Optional[Dict[str, Dict[str, int]]] = None,
+    ledger=None,
 ) -> str:
     """Render ``{model_name: ServingStats}`` (plus optional fault-site
-    counters from runtime.faults.site_counters()) as exposition text."""
+    counters from runtime.faults.site_counters(), plus the process-wide
+    prediction ledger's ``flexflow_sim_*`` families) as exposition
+    text."""
     lines: list = []
     names = sorted(models)
 
@@ -205,6 +216,41 @@ def render_prometheus(
                 'flexflow_fault_site_fires_total{site="%s"} %s'
                 % (escape_label_value(site), format_value(fault_sites[site]["fires"]))
             )
+
+    # ------------------------------------------------- cost-model truth
+    if ledger is not None:
+        # bounded cardinality AND bounded lock hold: only keys with
+        # joined pairs, capped — a search sweep can register thousands
+        # of never-executed ops, and a scrape must not serialize the
+        # full table against the measurement hot path
+        rep = ledger.scrape_snapshot(128)
+        paired = rep["entries"]
+        _help_type(lines, "flexflow_sim_prediction_error_ratio", "gauge")
+        for e in paired:
+            kl = escape_label_value(e["key"])
+            for q, field in (("0.5", "rel_err_p50"), ("0.95", "rel_err_p95")):
+                if e[field] is not None:
+                    lines.append(
+                        'flexflow_sim_prediction_error_ratio{key="%s",quantile="%s"} %s'
+                        % (kl, q, format_value(e[field]))
+                    )
+        _help_type(lines, "flexflow_sim_prediction_pairs_total", "counter")
+        for e in paired:
+            lines.append(
+                'flexflow_sim_prediction_pairs_total{key="%s"} %s'
+                % (escape_label_value(e["key"]), format_value(e["pairs"]))
+            )
+        counters = rep["counters"]
+        _help_type(lines, "flexflow_sim_prediction_unpredicted_total", "counter")
+        lines.append(
+            "flexflow_sim_prediction_unpredicted_total %s"
+            % format_value(counters["unpredicted_total"])
+        )
+        _help_type(lines, "flexflow_sim_drift_alarms_total", "counter")
+        lines.append(
+            "flexflow_sim_drift_alarms_total %s"
+            % format_value(counters["drift_alarms_total"])
+        )
 
     return "\n".join(lines) + "\n"
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import itertools
+import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -27,6 +28,7 @@ import jax.numpy as jnp
 from ..core.graph import PCGraph, Node
 from ..core.types import CompMode, LossType, MetricsType, OpType
 from ..obs.capacity import GLOBAL_PROGRAMS
+from ..obs.truth import GLOBAL_LEDGER
 from ..ops.base import LowerCtx, get_op_def
 from ..parallel.propagation import infer_all_specs
 from ..parallel.strategy import ParallelStrategy, to_partition_spec
@@ -165,6 +167,7 @@ class CompiledExecutor:
     _train_step: Optional[Callable] = None
     _eval_step: Optional[Callable] = None
     _forward: Optional[Callable] = None
+    _truth_counts: Any = None  # program -> window calls (truth-ledger sampling)
     _pipeline_plan: Any = None  # _PipelinePlan when the strategy pipelines
     _remat_plan: Any = None  # (pre, repeats, post) when remat_blocks engaged
 
@@ -824,6 +827,12 @@ class CompiledExecutor:
         # evict this executor's registry namespace when it is collected:
         # rebuilding executors in a loop must not grow GLOBAL_PROGRAMS
         weakref.finalize(self, GLOBAL_PROGRAMS.remove_namespace, self._prog_ns)
+        weakref.finalize(self, GLOBAL_LEDGER.remove_namespace, self._prog_ns)
+        # predict side of the truth ledger: the strategy simulator's
+        # whole-step estimate for THIS executor's train program, keyed so
+        # the measured train windows below join it (obs/truth.py)
+        if self.optimizer is not None:
+            self._register_step_prediction()
         self._forward = jax.jit(
             GLOBAL_PROGRAMS.instrument(f"{self._prog_ns}.forward", forward)
         )
@@ -841,6 +850,72 @@ class CompiledExecutor:
             self._multi_step_cache = {}
             self._window_cache = {}
 
+    def _register_step_prediction(self) -> None:
+        """Register the strategy-level simulated step time for this
+        executor's train program in the truth ledger. Telemetry only: a
+        graph the strategy predictor cannot walk (exotic pipeline
+        layouts, missing shardings) must never break compile."""
+        try:
+            from ..parallel.machine import MachineSpec
+            from ..search.calibration import (
+                CPU_FITTED_CONTENTION,
+                chip_spec_for,
+                detected_device_kind,
+                load_or_calibrate,
+            )
+            from ..search.simulator import predict_strategy_time
+
+            devs = jax.devices()
+            kind = detected_device_kind(self.backend or "cpu")
+            chip = chip_spec_for(kind)
+            if jax.default_backend() == "cpu":
+                # the bench's virtual-device convention: N virtual CPU
+                # devices share one host, so per-device peaks divide by
+                # N x the fitted contention factor
+                scale = max(1, len(devs)) * CPU_FITTED_CONTENTION
+                chip = dataclasses.replace(
+                    chip,
+                    bf16_flops=chip.bf16_flops / scale,
+                    f32_flops=chip.f32_flops / scale,
+                    hbm_bandwidth=chip.hbm_bandwidth / scale,
+                )
+            machine = MachineSpec(
+                num_nodes=1, devices_per_node=max(1, len(devs)), chip=chip
+            )
+            predict_strategy_time(
+                self.graph,
+                self.strategy,
+                machine=machine,
+                calibration=load_or_calibrate(machine),
+                ledger_key=f"{self._prog_ns}.train_step",
+            )
+        except Exception:
+            pass
+
+    def _truth_sample(self, program: str) -> bool:
+        """Whether to measure THIS window call for the truth ledger.
+        Measuring requires a device sync, which serializes the host/
+        device overlap a training loop otherwise enjoys — so sample:
+        the first few calls per program (warm statistics quickly, and
+        cover short benches like _bench_one entirely), then every 8th."""
+        if self._truth_counts is None:
+            self._truth_counts = {}
+        n = self._truth_counts.get(program, 0)
+        self._truth_counts[program] = n + 1
+        return n < 4 or n % 8 == 0
+
+    def _measure_window_step(self, program: str, traces_before: int,
+                             elapsed: float, num_steps: int) -> None:
+        """Measure side of the truth ledger: per-optimizer-step wall
+        seconds from one traced multi-step window. Compile calls
+        (the window program traced during this call) are excluded —
+        their wall time is compile cost, not step time."""
+        if GLOBAL_PROGRAMS.trace_count(program) > traces_before:
+            return
+        GLOBAL_LEDGER.measure(
+            f"{self._prog_ns}.train_step", elapsed / max(1, num_steps)
+        )
+
     # ---------------------------------------------------------------- API
     def set_learning_rate(self, lr: float) -> None:
         """Adjust lr in-place (it lives in opt_state as a traced scalar, so
@@ -856,9 +931,28 @@ class CompiledExecutor:
         inputs = self._shard_inputs(inputs)
         if jax.process_count() > 1:
             label = self.shard_label(label)
+        # truth-ledger measurement (sampled — see _truth_sample): the
+        # default fit loop (trace_window=1) runs THIS program, so the
+        # simulator's step prediction must pair here too, not only on
+        # the traced multi-step windows below
+        program = f"{self._prog_ns}.train_step"
+        measure = self._truth_sample(program)
+        traces_before = GLOBAL_PROGRAMS.trace_count(program) if measure else 0
+        if measure:
+            # drain async dispatch backlog BEFORE the timer starts: the
+            # unmeasured calls between samples never sync, so the device
+            # may still be running earlier steps — timing them into this
+            # window would over-report step time and false-alarm drift
+            jax.block_until_ready(self.params)
+        t0 = time.perf_counter() if measure else 0.0
         self.params, self.opt_state, self.state, mets = self._train_step(
             self.params, self.opt_state, self.state, tuple(inputs), label, rng
         )
+        if measure:
+            jax.block_until_ready(mets)
+            self._measure_window_step(
+                program, traces_before, time.perf_counter() - t0, 1
+            )
         return mets
 
     def _scan_train_steps(self, w: int, per_step_xs: bool):
@@ -918,9 +1012,28 @@ class CompiledExecutor:
         inputs = self._shard_inputs(inputs)
         if jax.process_count() > 1:
             label = self.shard_label(label)
+        # truth-ledger measurement (sampled — see _truth_sample): the
+        # timing includes a metrics sync; through a tunneled transport
+        # block_until_ready may under-wait, which at worst under-reports
+        # measured time — telemetry, not billing
+        program = f"{self._prog_ns}.train_repeat[{num_steps}]"
+        measure = self._truth_sample(program)
+        traces_before = GLOBAL_PROGRAMS.trace_count(program) if measure else 0
+        if measure:
+            # drain async dispatch backlog BEFORE the timer starts: the
+            # unmeasured calls between samples never sync, so the device
+            # may still be running earlier steps — timing them into this
+            # window would over-report step time and false-alarm drift
+            jax.block_until_ready(self.params)
+        t0 = time.perf_counter() if measure else 0.0
         self.params, self.opt_state, self.state, mets = jitted(
             self.params, self.opt_state, self.state, tuple(inputs), label, rng
         )
+        if measure:
+            jax.block_until_ready(mets)
+            self._measure_window_step(
+                program, traces_before, time.perf_counter() - t0, num_steps
+            )
         return jax.tree.map(lambda m: m[-1], mets)
 
     def train_window(
@@ -936,9 +1049,24 @@ class CompiledExecutor:
         jitted = self._scan_train_steps(w, per_step_xs=True)
         inputs = self._shard_inputs(inputs, leading_axis=True)
         labels = self.shard_label(labels, leading_axis=True)
+        program = f"{self._prog_ns}.train_window[{w}]"
+        measure = self._truth_sample(program)
+        traces_before = GLOBAL_PROGRAMS.trace_count(program) if measure else 0
+        if measure:
+            # drain async dispatch backlog BEFORE the timer starts: the
+            # unmeasured calls between samples never sync, so the device
+            # may still be running earlier steps — timing them into this
+            # window would over-report step time and false-alarm drift
+            jax.block_until_ready(self.params)
+        t0 = time.perf_counter() if measure else 0.0
         self.params, self.opt_state, self.state, mets = jitted(
             self.params, self.opt_state, self.state, tuple(inputs), labels, rng
         )
+        if measure:
+            jax.block_until_ready(mets)
+            self._measure_window_step(
+                program, traces_before, time.perf_counter() - t0, w
+            )
         return mets
 
     def eval_window(

@@ -51,6 +51,32 @@ def cost_key(op_type: OpType, params, input_specs: Sequence[TensorSpec], n_parts
     return f"{op_type.name}|{params!r}|{shapes}|{n_parts}"
 
 
+def op_ledger_key(
+    device_kind: str, op_type: OpType, params,
+    input_specs: Sequence[TensorSpec], n_parts: int,
+) -> str:
+    """Truth-ledger key for one op signature ON one device kind
+    (``op:<device-slug>:<cost_key>``). The device lives in the key so a
+    prediction made for a hypothetical machine (a v5e what-if searched
+    on a CPU dev box) can never join a measurement taken on different
+    hardware and raise a false drift alarm."""
+    return f"op:{_slug(device_kind)}:{cost_key(op_type, params, input_specs, n_parts)}"
+
+
+def detected_device_kind(default: str = "cpu") -> str:
+    """The default backend's device kind ("cpu", "TPU v5e", ...) — the
+    one shared detection used by chip resolution, the truth ledger, and
+    the strategy predictor."""
+    try:
+        import jax
+
+        return getattr(
+            jax.devices()[0], "device_kind", jax.default_backend() or default
+        )
+    except Exception:
+        return default
+
+
 @dataclasses.dataclass
 class Calibration:
     """Measured timing data for one device kind."""
@@ -67,6 +93,13 @@ class Calibration:
     # the evidence log can see exactly which ops fell back to
     # roofline x derate and which classes the derate geomean missed.
     failed: List[str] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        # where this table came from (file path when loaded from disk):
+        # ends the truth ledger's drift-blame string so a stale table is
+        # named, not just detected. Plain attribute, not a field — it
+        # must not ride to_json into the persisted tables.
+        self.source = "(in-memory)"
 
     def derate(self, op_type: OpType) -> float:
         return self.derates.get(op_class(op_type), 1.0)
@@ -117,9 +150,11 @@ def load_calibration(device_kind: str) -> Optional[Calibration]:
         p = base / f"opcosts_{_slug(device_kind)}.json"
         if p.exists():
             try:
-                return Calibration.from_json(p.read_text())
+                cal = Calibration.from_json(p.read_text())
             except (json.JSONDecodeError, OSError):
                 continue
+            cal.source = str(p)
+            return cal
     return None
 
 
@@ -168,6 +203,8 @@ def measure_lowered_op(
     inner: int = 32,
     reps: int = 3,
     analytic_hint: Optional[float] = None,
+    ledger=None,
+    ledger_key: Optional[str] = None,
 ) -> Optional[float]:
     """Jit one shard of the op's lowering on the default device and time
     it (the reference's inner_measure_operator_cost, operator.h:127).
@@ -214,6 +251,28 @@ def measure_lowered_op(
         if not jnp.issubdtype(args[0].dtype, jnp.floating):
             inner = 0  # can't thread the carry through integer inputs
 
+        def note(result: float) -> float:
+            # measure side of the truth ledger: joins the cost model's
+            # prediction for the same (device, op, params, shapes,
+            # n_parts) key so calib_debug / obsreport report error
+            # without a private path. Every successful measurement —
+            # slope OR single-shot fallback — passes through here
+            # ("counted, never dropped"). A measure-mode CostModel
+            # passes its own ledger_key so its prediction joins exactly,
+            # whatever device naming it predicted under.
+            try:
+                led = ledger
+                if led is None:
+                    from ..obs.truth import GLOBAL_LEDGER as led
+                key = ledger_key or op_ledger_key(
+                    detected_device_kind(backend),
+                    op_type, params, input_specs, n_parts,
+                )
+                led.measure(key, result)
+            except Exception:
+                pass
+            return result
+
         # inputs AND weights are runtime jit arguments — closing over
         # them would bake them into the XLA program as literals, letting
         # the compiler constant-fold/pre-transform weights and bias the
@@ -241,7 +300,7 @@ def measure_lowered_op(
             float(acc)
             elapsed = time.perf_counter() - t0
             per = (elapsed - _readback_floor(backend)) / n
-            return per if per > 0 else None
+            return note(per) if per > 0 else None
 
         def perturbed(inputs, acc):
             # cheap data dependency: scales with |inputs[0]|, defeats LICM
@@ -346,7 +405,7 @@ def measure_lowered_op(
             _BASELINE_CACHE[base_key] = base_per_iter
         # floor: never let noisy subtraction return <=0; 5% of the loop
         # body is a conservative lower bound for the op itself
-        return max(per_iter - base_per_iter, 0.05 * per_iter)
+        return note(max(per_iter - base_per_iter, 0.05 * per_iter))
     except Exception:
         return None
 
@@ -473,9 +532,8 @@ def load_or_calibrate(
         try:
             import jax
 
-            backend = jax.default_backend()
-            if backend != "cpu":
-                device_kind = getattr(jax.devices()[0], "device_kind", backend)
+            if jax.default_backend() != "cpu":
+                device_kind = detected_device_kind()
         except Exception:
             pass
     if device_kind == "analytic":
@@ -486,6 +544,72 @@ def load_or_calibrate(
     if allow_measure:
         return calibrate(machine, device_kind=device_kind)
     return Calibration(device_kind=device_kind)
+
+
+# ---------------------------------------------------------------------------
+# recalibration from the truth ledger (obs/truth.py)
+# ---------------------------------------------------------------------------
+
+
+def recalibration_suggestions(ledger=None, min_rel_err: float = 0.25) -> List[Dict]:
+    """Drifting ``op:*`` ledger entries -> suggested calibration-table
+    updates. Each suggestion carries the cost key, the stale predicted
+    seconds, the measured p50 that should replace it, and the blame
+    string — the "the simulator is lying, now what?" hand-off."""
+    if ledger is None:
+        from ..obs.truth import GLOBAL_LEDGER as ledger  # noqa: F811
+    out: List[Dict] = []
+    for e in ledger.report()["entries"]:
+        if not e["key"].startswith("op:") or e["pairs"] < ledger.min_samples:
+            continue
+        parts = e["key"].split(":", 2)  # op:<device-slug>:<cost_key>
+        if len(parts) != 3:
+            continue
+        ewma = e["rel_err_ewma"]
+        if ewma is None or abs(ewma) < min_rel_err or e["measured_p50_s"] is None:
+            continue
+        out.append({
+            "device": parts[1],
+            "cost_key": parts[2],
+            "label": e["label"],
+            "predicted_s": e["predicted_s"],
+            "measured_p50_s": e["measured_p50_s"],
+            "rel_err": ewma,
+            "blame": e["last_blame"] or (
+                f"{e['label']}: predicted {e['predicted_s']:.3g}s, "
+                f"measured p50 {e['measured_p50_s']:.3g}s, error {ewma:+.0%}"
+            ),
+        })
+    return out
+
+
+def apply_recalibration(
+    cal: Calibration,
+    suggestions: Optional[Sequence[Dict]] = None,
+    ledger=None,
+    min_rel_err: float = 0.25,
+    save: bool = False,
+) -> List[Dict]:
+    """Fold measured medians back into ``cal.entries`` for every
+    drifting op the ledger has evidence on; returns what was applied.
+    ``save=True`` persists the refreshed table to the on-disk cache."""
+    applied = [
+        s for s in (
+            suggestions if suggestions is not None
+            else recalibration_suggestions(ledger, min_rel_err)
+        )
+        # never fold one device's measurements into another device's
+        # table (suggestions carry the ledger key's device slug)
+        if s.get("device") in (None, _slug(cal.device_kind))
+    ]
+    for s in applied:
+        cal.entries[s["cost_key"]] = s["measured_p50_s"]
+    if save and applied:
+        try:
+            cal.save()
+        except OSError:
+            pass
+    return applied
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,10 @@ class CostMetrics:
     backward_time: float = 0.0
     sync_time: float = 0.0
     memory_requirement: float = 0.0  # bytes per device
+    # truth-ledger tag (obs/truth.py): the prediction this estimate
+    # registered, so a later measurement of the same op signature joins
+    # it into a (predicted, measured) pair
+    prediction_id: Optional[int] = None
 
     @property
     def total_time(self) -> float:
@@ -63,6 +67,7 @@ class CostModel:
         machine: Optional[MachineSpec] = None,
         measure: bool = False,
         calibration=None,
+        ledger=None,
     ):
         from .calibration import Calibration
 
@@ -70,6 +75,12 @@ class CostModel:
         self.chip = self.machine.chip
         self.measure = measure
         self.calibration = calibration if calibration is not None else Calibration()
+        # truth ledger (obs/truth.py): every estimate this model hands
+        # the search registers its predicted forward time, so a later
+        # on-device measurement of the same signature grades it
+        if ledger is None:
+            from ..obs.truth import GLOBAL_LEDGER as ledger  # noqa: F811
+        self.ledger = ledger
         # cache: (op_type, params, shard shapes) -> CostMetrics
         # (reference: hash_to_operator_cost, simulator.cc:588-628)
         self._cache: Dict[Tuple, CostMetrics] = {}
@@ -103,15 +114,55 @@ class CostModel:
         dtype = input_specs[0].dtype if input_specs else DataType.FLOAT
         roofline = self._roofline_time(flops, bytes_hbm, dtype)
         fwd = roofline * self.calibration.derate(op_type)
+        source = (
+            f"analytic roofline x derate {self.calibration.derate(op_type):.2f}"
+        )
         calibrated = self.calibration.lookup(op_type, params, input_specs, n_parts)
         if calibrated is not None:
             fwd = calibrated
-        elif self.measure:
+            source = (
+                f"calibration table entry from "
+                f"{getattr(self.calibration, 'source', '(in-memory)')} "
+                f"({self.calibration.device_kind})"
+            )
+        # predict side of the truth ledger (obs/truth.py): register the
+        # forward-time estimate under the device-qualified cost key
+        # (op:<device>:<cost_key> — the device this model's calibration
+        # claims to describe) so a later measurement of this exact
+        # signature ON THAT DEVICE grades it. Cache misses only — the
+        # per-signature cache below makes this once-per-signature, off
+        # the search's hot path. Registered BEFORE measure mode runs:
+        # measure_lowered_op writes its result through to the SAME
+        # ledger key, so the pre-measure estimate must already be there
+        # for the pair to join.
+        from .calibration import op_ledger_key
+
+        ledger_key = op_ledger_key(
+            self.calibration.device_kind, op_type, params, input_specs, n_parts
+        )
+        shapes = ",".join("x".join(str(d) for d in s.shape) for s in input_specs)
+        dt = input_specs[0].dtype.name.lower() if input_specs else "?"
+        label = f"{op_type.name} {shapes} {dt} /{n_parts}"
+        # alarm only when a calibration table vouched for the number: a
+        # raw roofline x derate estimate is expected to miss (that is
+        # why derates exist) and must not raise "calibration drift"
+        pid = self.ledger.predict(ledger_key, fwd, label=label,
+                                  provenance=source,
+                                  alarm=calibrated is not None)
+        if calibrated is None and self.measure:
             measured = self._try_measure(
-                op_type, params, input_specs, n_parts, analytic_hint=roofline
+                op_type, params, input_specs, n_parts,
+                analytic_hint=roofline, ledger_key=ledger_key,
             )
             if measured is not None:
                 fwd = measured
+                source = "live on-device measurement (measure mode)"
+                # refresh in place (same prediction id): future
+                # measurements grade against the measured value, not the
+                # superseded analytic estimate — and a live measurement
+                # IS calibrated evidence, so drift off it may alarm
+                self.ledger.predict(ledger_key, fwd, label=label,
+                                    provenance=source, alarm=True)
         # backward ≈ 2x forward for matmul-dominated ops (dL/dx + dL/dw),
         # ≈ 1x for elementwise (reference measures separately; same ratio)
         bwd_factor = 2.0 if cost.flops > 0 else 1.0
@@ -119,6 +170,7 @@ class CostModel:
             forward_time=fwd,
             backward_time=fwd * bwd_factor,
             memory_requirement=cost.memory_bytes / max(1, n_parts),
+            prediction_id=pid,
         )
         self._cache[key] = m
         return m
@@ -130,19 +182,26 @@ class CostModel:
         return max(t_compute, t_memory) + KERNEL_OVERHEAD
 
     def _try_measure(
-        self, op_type, params, input_specs, n_parts, analytic_hint=None
+        self, op_type, params, input_specs, n_parts,
+        analytic_hint=None, ledger_key=None,
     ) -> Optional[float]:
         """Measured calibration: jit the op's lowering on the default
         device and time it (the reference's inner_measure_operator_cost
         on TPU); the result is written through to the on-disk cache.
         ``analytic_hint`` (the caller's roofline estimate) sizes the
-        timing loop so the measurement resolves without escalation."""
+        timing loop so the measurement resolves without escalation;
+        ``ledger_key`` routes the measurement to the exact truth-ledger
+        entry this model's prediction registered under."""
         key = (op_type, params, tuple((s.shape, s.dtype) for s in input_specs), n_parts)
         if key in self._measure_cache:
             return self._measure_cache[key]
         from .calibration import cost_key, measure_lowered_op
 
-        t = measure_lowered_op(op_type, params, input_specs, n_parts, analytic_hint=analytic_hint)
+        t = measure_lowered_op(
+            op_type, params, input_specs, n_parts,
+            analytic_hint=analytic_hint, ledger=self.ledger,
+            ledger_key=ledger_key,
+        )
         self._measure_cache[key] = t  # type: ignore
         if t is not None:
             self.calibration.entries[cost_key(op_type, params, input_specs, n_parts)] = t

@@ -509,6 +509,7 @@ def predict_strategy_time(
     machine: Optional[MachineSpec] = None,
     calibration=None,
     cost_model: Optional[CostModel] = None,
+    ledger_key: Optional[str] = None,
 ) -> float:
     """Strategy-level step-time predictor: walk the PCG with a
     ParallelStrategy (mesh axis sizes + PartitionSpecs) and charge
@@ -617,6 +618,28 @@ def predict_strategy_time(
                     out_bytes, n, groups=max(1, n_total // n)
                 )
     total += cm.chip.coll_overhead * len(grad_sync_groups)
+    if ledger_key is not None:
+        # predict side of the truth ledger: the whole-step estimate,
+        # keyed to the executor program that will run this strategy so
+        # its measured train windows grade the simulator end to end
+        # (obs/truth.py; the per-op predictions above registered via
+        # the cost model already)
+        from ..obs.truth import GLOBAL_LEDGER
+
+        cal = cm.calibration
+        GLOBAL_LEDGER.predict(
+            ledger_key,
+            total,
+            label=f"{ledger_key} (strategy step)",
+            provenance=(
+                f"predict_strategy_time over calibration "
+                f"'{cal.device_kind}' ({getattr(cal, 'source', '(in-memory)')})"
+            ),
+            # an analytic (uncalibrated) step estimate records pairs for
+            # inspection but cannot raise a "calibration drift" alarm —
+            # there is no calibration table to be stale
+            alarm=cal.device_kind != "analytic",
+        )
     return total
 
 

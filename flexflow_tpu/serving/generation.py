@@ -93,6 +93,12 @@ class GenerationModel:
         return self.scheduler.slo
 
     @property
+    def ledger(self):
+        """Cost-model truth ledger: per-step (predicted, measured)
+        pairs + drift alarms (GET /v2/debug/predictions)."""
+        return self.engine.ledger
+
+    @property
     def goodput(self):
         return self.scheduler.goodput
 

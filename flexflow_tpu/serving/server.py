@@ -34,6 +34,12 @@ API. This server implements the same surface directly (stdlib only):
   GET  /v2/debug/programs[?model=M]        -> jit program registry:
                                               traced signatures, compile
                                               times, retrace blame
+  GET  /v2/debug/predictions[?model=M]     -> cost-model truth: per-step
+                                              (predicted, measured)
+                                              pairs, relative-error
+                                              distributions, and
+                                              calibration-drift alarms
+                                              with blame
   GET  /v2/slo                             -> per-model SLO objectives
                                               with fast/slow burn rates
   GET  /v2/models/{name}                   -> model metadata
@@ -69,7 +75,7 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
-from ..obs import GLOBAL_PROGRAMS, render_prometheus
+from ..obs import GLOBAL_LEDGER, GLOBAL_PROGRAMS, render_prometheus
 from ..runtime import faults
 from .batcher import DynamicBatcher, make_batcher
 from .model import InferenceModel
@@ -209,7 +215,11 @@ class InferenceServer:
         return out
 
     def metrics_text(self) -> str:
-        return render_prometheus(self._all_stats(), fault_sites=faults.site_counters())
+        return render_prometheus(
+            self._all_stats(),
+            fault_sites=faults.site_counters(),
+            ledger=GLOBAL_LEDGER,
+        )
 
     def debug_traces(
         self,
@@ -292,6 +302,22 @@ class InferenceServer:
                 "programs": GLOBAL_PROGRAMS.snapshot(),
                 "retraces": GLOBAL_PROGRAMS.recent_retraces(),
             }
+        return out
+
+    def debug_predictions(self, model: Optional[str] = None) -> Dict:
+        """Cost-model truth: per generation model, the engine ledger's
+        (predicted, measured) pairs, relative-error distributions, and
+        drift alarms; plus the process-wide ledger (search cost model,
+        calibration measurements, executor train programs)."""
+        out: Dict = {
+            "models": {
+                name: g.ledger.report()
+                for name, g in sorted(self.generators.items())
+                if model is None or name == model
+            }
+        }
+        if model is None:
+            out["global"] = GLOBAL_LEDGER.report()
         return out
 
     def slo_report(self) -> Dict:
@@ -393,6 +419,10 @@ class InferenceServer:
                     ))
                 if path == "/v2/debug/programs":
                     return self._json(200, server.debug_programs(
+                        model=(query.get("model") or [None])[0]
+                    ))
+                if path == "/v2/debug/predictions":
+                    return self._json(200, server.debug_predictions(
                         model=(query.get("model") or [None])[0]
                     ))
                 if path == "/v2/slo":

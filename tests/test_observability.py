@@ -32,6 +32,7 @@ from flexflow_tpu.generation import (
 from flexflow_tpu.models.transformer import TransformerConfig
 from flexflow_tpu.obs import (
     FlightRecorder,
+    PredictionLedger,
     RequestTrace,
     TraceRing,
     render_prometheus,
@@ -227,7 +228,31 @@ def _golden_stats():
     s.add_gauge("goodput_ratio", lambda: 0.75)
     s.add_gauge("slo_ttft_p95_burn_fast", lambda: 2)
     s.add_gauge("slo_breaching_total", lambda: 1)
+    # PR 7 truth families (binary-exact values)
+    s.add_gauge("perf_prediction_pairs", lambda: 4)
+    s.add_gauge("perf_prediction_error_p50", lambda: 0.5)
+    s.add_gauge("perf_prediction_error_max", lambda: 2)
+    s.add_gauge("perf_drift_alarms", lambda: 1)
     return s
+
+
+def _golden_ledger():
+    """Deterministic prediction ledger for the flexflow_sim_* families:
+    binary-exact predicted/measured (0.25 / 0.375 -> rel err exactly
+    0.5, which also trips the drift alarm at the 4th pair), one key
+    with quote + backslash to keep label-escaping pinned, and one
+    unpredicted measurement."""
+    led = PredictionLedger(clock=lambda: 0.0)
+    led.predict("decode", 0.25, label="decode (v5e)",
+                provenance="serving roofline")
+    for _ in range(4):
+        led.measure("decode", 0.375)
+    tricky = 'op:LINEAR|pa"ram\\s|64x32:bf16|1'
+    led.predict(tricky, 0.25, label="LINEAR 64x32 bf16",
+                provenance="calibration table entry from (in-memory)")
+    led.measure(tricky, 0.25)
+    led.measure("op:unseen", 0.125)
+    return led
 
 
 def test_prometheus_golden_exposition():
@@ -236,6 +261,7 @@ def test_prometheus_golden_exposition():
     text = render_prometheus(
         {"lm": _golden_stats()},
         fault_sites={"generation.decode_step": {"calls": 5, "fires": 1}},
+        ledger=_golden_ledger(),
     )
     assert not validate_exposition(text)
     golden_path = os.path.join(os.path.dirname(__file__), "data", "prometheus_golden.txt")

@@ -15,8 +15,9 @@ measure_lowered_op (jit, scalar-readback flush, best-of-N):
   C  raw matmul fori_loop at inner=8/32/128 -> per-iter slope vs fixed
      intercept (separates per-program overhead from per-iteration cost)
   D  raw LayerNorm-equivalent loop, same inner sweep
-  E  the framework path (measure_lowered_op) on the same two ops for a
-     direct apples-to-apples delta
+  E  the framework path (cost-model predict + measure_lowered_op) on
+     the same two ops, with the prediction error read back from the
+     shared truth ledger (obs/truth.py) — no private comparison path
 
 Writes CALIB_DEBUG.json; prints one summary JSON line.
 """
@@ -113,23 +114,58 @@ def main():
     mb = 16 * 128 * 768 * 2 / 1e6
     res["steps"]["ln_effective_gbps"] = round(3 * mb / 1e3 / max(per_iter_ln, 1e-9), 1)
 
-    # E: the framework path on the same two ops
+    # E: the framework path on the same two ops — predictions from the
+    # cost model, measurements from measure_lowered_op, and the error
+    # read back from the SHARED truth ledger (obs/truth.py) instead of
+    # a private predicted-vs-measured comparison here
     from flexflow_tpu.core.types import DataType, OpType
     from flexflow_tpu.core.parallel_tensor import TensorSpec
+    from flexflow_tpu.obs.truth import GLOBAL_LEDGER
+    from flexflow_tpu.ops.base import get_op_def
     from flexflow_tpu.ops.linear import LinearParams
     from flexflow_tpu.ops.norm import LayerNormParams
-    from flexflow_tpu.search.calibration import measure_lowered_op
+    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.search.calibration import (
+        chip_spec_for,
+        load_or_calibrate,
+        measure_lowered_op,
+        op_ledger_key,
+    )
+    from flexflow_tpu.search.cost_model import CostModel
 
+    cm = CostModel(
+        MachineSpec(num_nodes=1, devices_per_node=1, chip=chip_spec_for(kind)),
+        calibration=load_or_calibrate(device_kind=kind if backend != "cpu" else "cpu"),
+    )
+    suite = [
+        ("linear",
+         OpType.LINEAR,
+         LinearParams(out_dim=3072, use_bias=True, dtype=DataType.BFLOAT16),
+         [TensorSpec((2048, 768), DataType.BFLOAT16)]),
+        ("ln",
+         OpType.LAYERNORM, LayerNormParams(axes=(2,), dtype=DataType.BFLOAT16),
+         [TensorSpec((16, 128, 768), DataType.BFLOAT16)]),
+    ]
     t0 = time.time()
-    lin = measure_lowered_op(
-        OpType.LINEAR,
-        LinearParams(out_dim=3072, use_bias=True, dtype=DataType.BFLOAT16),
-        [TensorSpec((2048, 768), DataType.BFLOAT16)], inner=32)
-    lnm = measure_lowered_op(
-        OpType.LAYERNORM, LayerNormParams(axes=(2,), dtype=DataType.BFLOAT16),
-        [TensorSpec((16, 128, 768), DataType.BFLOAT16)], inner=32)
-    res["steps"]["framework_linear_us"] = round((lin or 0) * 1e6, 2)
-    res["steps"]["framework_ln_us"] = round((lnm or 0) * 1e6, 2)
+    errors = {}
+    for name, op_type, params, specs in suite:
+        out_specs = get_op_def(op_type).infer_output_specs(params, list(specs))
+        cm.op_cost_metrics(op_type, params, specs, out_specs, 1)  # predict side
+        measure_lowered_op(op_type, params, specs, inner=32)      # measure side
+        key = op_ledger_key(cm.calibration.device_kind, op_type, params, specs, 1)
+        entry = next((e for e in GLOBAL_LEDGER.report()["entries"]
+                      if e["key"] == key), None)
+        if entry is None or not entry["pairs"]:
+            res["steps"][f"framework_{name}_us"] = None
+            continue
+        res["steps"][f"framework_{name}_us"] = round(entry["measured_p50_s"] * 1e6, 2)
+        errors[name] = {
+            "predicted_us": round(entry["predicted_s"] * 1e6, 2),
+            "measured_p50_us": round(entry["measured_p50_s"] * 1e6, 2),
+            "rel_err": round(entry["rel_err_p50"], 3),
+            "provenance": entry["provenance"],
+        }
+    res["steps"]["prediction_error"] = errors
     res["steps"]["framework_seconds"] = round(time.time() - t0, 1)
 
     tmp = OUT.with_suffix(".json.tmp")

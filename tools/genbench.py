@@ -39,7 +39,9 @@ Every mode also merges its report into a machine-readable
 ``--bench-out`` artifact (default ``BENCH_GEN.json``) keyed by mode —
 tok/s, TTFT percentiles, serving MFU, cache telemetry, acceptance rate
 — so the bench trajectory accumulates one comparable JSON per PR
-(uploaded by tpu-ci next to bench_result.json).
+(uploaded by tpu-ci next to bench_result.json), and APPENDS the run to
+``--history-out`` (default ``BENCH_HISTORY.jsonl``; timestamped +
+git-sha-stamped) — the trajectory tools/perfwatch.py gates CI on.
 
 Usage:
   python tools/genbench.py [--out genbench.json] [--requests 12]
@@ -82,6 +84,11 @@ def capacity_block(sched) -> dict:
         "ttft_p50_s": ttft.get("p50_s"),
         "ttft_p95_s": ttft.get("p95_s"),
         "goodput_ratio": gv.get("goodput_ratio"),
+        "prediction": {
+            "pairs": gv.get("perf_prediction_pairs"),
+            "error_p50": gv.get("perf_prediction_error_p50"),
+            "drift_alarms": gv.get("perf_drift_alarms"),
+        },
         "cache": {
             "frag_slots": gv.get("cache_frag_slots"),
             "free_low_water": gv.get("cache_free_low_water"),
@@ -112,6 +119,64 @@ def write_bench_artifact(path: str, mode: str, payload: dict) -> None:
     data["backend"] = jax.default_backend()
     with open(path, "w") as f:
         json.dump(data, f, indent=2)
+
+
+def _git_sha() -> str:
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+        return out or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _history_metrics(mode: str, report: dict) -> dict:
+    """The comparable per-mode scalars tools/perfwatch.py gates on."""
+    cap = report.get("capacity") or {}
+    if mode == "baseline":
+        return {
+            "decode_tokens_per_s": report.get("decode_tokens_per_s"),
+            "prefill_tokens_per_s": report.get("prefill_tokens_per_s"),
+            "ttft_p50_s": cap.get("ttft_p50_s"),
+            "mfu": cap.get("mfu"),
+        }
+    if mode == "speculate":
+        return {
+            "tokens_per_step_speedup": report.get("tokens_per_step_speedup"),
+            "acceptance_rate": report.get("acceptance_rate"),
+        }
+    if mode == "trace_overhead":
+        return {"tracing_overhead": report.get("tracing_overhead")}
+    return {}
+
+
+def append_history(path: str, mode: str, report: dict, ok: bool = True) -> None:
+    """Append this run to the bench trajectory (JSONL): timestamped and
+    git-sha-stamped so tools/perfwatch.py can compare runs and a human
+    can bisect a regression to a commit. Runs that failed their own
+    bench gate are stamped ok=false — recorded for the human, EXCLUDED
+    from perfwatch's rolling baseline (three red runs must not median a
+    regression into the reference). '' disables."""
+    if not path:
+        return
+    entry = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "git_sha": _git_sha(),
+        "backend": jax.default_backend(),
+        "mode": mode,
+        "ok": bool(ok),
+        "metrics": _history_metrics(mode, report),
+    }
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        print(f"WARNING: could not append bench history to {path}: {e}",
+              file=sys.stderr)
 
 
 def run_stream(engine, prompts, sampling, speculation=None):
@@ -378,6 +443,10 @@ def main() -> int:
     ap.add_argument("--bench-out", default="BENCH_GEN.json",
                     help="cumulative machine-readable bench artifact "
                          "(merged per mode; '' disables)")
+    ap.add_argument("--history-out", default="BENCH_HISTORY.jsonl",
+                    help="bench trajectory (JSONL, one line per run, "
+                         "timestamped + git-sha-stamped; gated by "
+                         "tools/perfwatch.py; '' disables)")
     args = ap.parse_args()
     args.max_new_set = args.max_new is not None
     if args.max_new is None:
@@ -393,6 +462,7 @@ def main() -> int:
     if args.trace_out:
         report, ok = trace_overhead_bench(args, cfg, params)
         write_bench_artifact(args.bench_out, "trace_overhead", report)
+        append_history(args.history_out, "trace_overhead", report, ok)
         if not ok:
             return 1
         print(
@@ -404,6 +474,7 @@ def main() -> int:
     if args.speculate:
         report, ok = speculate_bench(args, cfg, params)
         write_bench_artifact(args.bench_out, "speculate", report)
+        append_history(args.history_out, "speculate", report, ok)
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=2)
@@ -472,6 +543,7 @@ def main() -> int:
     ok = check_no_self_healing(report, [sched], [engine])
     print(json.dumps(report, indent=2))
     write_bench_artifact(args.bench_out, "baseline", report)
+    append_history(args.history_out, "baseline", report, ok)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=2)

@@ -126,8 +126,26 @@ def main():
     ex = model.executor
 
     # measured step time with the SAME helper bench.py uses, so the
-    # profile fractions can be read against the recorded MFU numbers
+    # profile fractions can be read against the recorded MFU numbers.
+    # The timed train_batch_repeated windows inside feed the truth
+    # ledger's measure side; the executor registered the simulator's
+    # predicted step time at compile — so the prediction-error block
+    # below comes from the SHARED ledger, not a private comparison.
     step_s = _bench_one(ex, args.batch, cfg, args.iters)
+
+    from flexflow_tpu.obs.truth import GLOBAL_LEDGER
+
+    truth = next((e for e in GLOBAL_LEDGER.report()["entries"]
+                  if e["key"] == f"{ex._prog_ns}.train_step"), None)
+    prediction = None
+    if truth is not None and truth["pairs"]:
+        prediction = {
+            "predicted_step_ms": round(truth["predicted_s"] * 1e3, 3),
+            "measured_step_ms": round(truth["measured_p50_s"] * 1e3, 3),
+            "rel_err": round(truth["rel_err_p50"], 3),
+            "pairs": truth["pairs"],
+            "provenance": truth["provenance"],
+        }
 
     import jax.numpy as jnp
     rs = np.random.RandomState(0)
@@ -156,6 +174,7 @@ def main():
                    "searched": args.searched},
         "step_ms": round(step_s * 1e3, 3),
         "mfu": round(mfu, 4),
+        "prediction": prediction,
         "breakdown": breakdown,
     }
     data = {"what": "XLA device-trace breakdown of the timed training window",
@@ -169,7 +188,7 @@ def main():
     tmp = OUT.with_suffix(".json.tmp")
     tmp.write_text(json.dumps(data, indent=1) + "\n")
     os.replace(tmp, OUT)
-    print(json.dumps({k: entry[k] for k in ("backend", "step_ms", "mfu")} |
+    print(json.dumps({k: entry[k] for k in ("backend", "step_ms", "mfu", "prediction")} |
                      {"categories": breakdown.get("category_fractions"),
                       "top3": breakdown.get("top_ops", [])[:3]}))
 

@@ -26,6 +26,12 @@ Against a live server (serving/server.py):
       SLO view (GET /v2/slo): per-objective fast/slow burn rates and
       breach state.
 
+  python tools/obsreport.py --url ... predict
+      Cost-model truth view (GET /v2/debug/predictions): per-program
+      (predicted, measured) pairs with relative-error distributions,
+      and the calibration-drift alarms with blame — the "is the
+      simulator lying?" answer.
+
 CI self-check (no server needed; used by .github/workflows/tpu-ci.yml):
 
   python tools/obsreport.py --selfcheck
@@ -35,7 +41,11 @@ CI self-check (no server needed; used by .github/workflows/tpu-ci.yml):
       traces carry queue-time/TTFT/TPOT, a forced quarantine AND a
       forced engine restart each capture a flight-recorder snapshot
       containing the failing step, and the error response embeds the
-      postmortem. Exit 1 on any miss.
+      postmortem. PR 7: additionally asserts the truth ledger holds
+      (predicted, measured) pairs for prefill/decode/verify plus an
+      executor program after real runs, and that a deliberately scaled
+      calibration entry trips the calibration-drift alarm with the
+      correct op-level blame string. Exit 1 on any miss.
 """
 from __future__ import annotations
 
@@ -171,6 +181,47 @@ def show_slo(base: str) -> int:
                   f"({fast['bad']}/{fast['events']} bad)   "
                   f"slow {slow['window_s']:.0f}s: burn={slow['burn_rate']:.2f} "
                   f"({slow['bad']}/{slow['events']} bad){flag}")
+    return 0
+
+
+def _predict_rows(rep: dict, indent: str = "    ") -> None:
+    entries = [e for e in rep.get("entries", []) if e["pairs"] > 0]
+    if not entries:
+        print(indent + "(no joined pairs)")
+    else:
+        print(indent + "key                        pairs  predicted   meas_p50    err_p50  ewma     alarm")
+        for e in entries:
+            pred = e["predicted_s"]
+            p50 = e["measured_p50_s"]
+            print(
+                f"{indent}{e['key'][:26]:<26} {e['pairs']:<6} "
+                f"{pred * 1e3:9.3f}ms {p50 * 1e3:9.3f}ms "
+                f"{(e['rel_err_p50'] or 0):+8.0%} {(e['rel_err_ewma'] or 0):+8.0%} "
+                f"{'<<' if e['alarming'] else ''}"
+            )
+    unpred = rep.get("unpredicted", {})
+    if unpred:
+        total = rep.get("counters", {}).get("unpredicted_total", sum(unpred.values()))
+        print(f"{indent}unpredicted measurements: {total} across {len(unpred)} key(s)")
+    for a in rep.get("alarms", []):
+        print(f"{indent}DRIFT: {a['blame']}")
+
+
+def show_predictions(base: str) -> int:
+    """Predicted-vs-measured table + drift alarms, per model and for
+    the process-wide ledger (cost model / calibration / executor)."""
+    payload = _get_json(f"{base}/v2/debug/predictions")
+    for name, rep in sorted(payload.get("models", {}).items()):
+        c = rep["counters"]
+        print(f"model {name!r}: {c['pairs_total']} pairs, "
+              f"{c['drift_alarms_total']} drift alarm(s)")
+        _predict_rows(rep)
+    g = payload.get("global")
+    if g is not None:
+        c = g["counters"]
+        print(f"global ledger (cost model / calibration / executor): "
+              f"{c['pairs_total']} pairs, {c['drift_alarms_total']} drift alarm(s)")
+        _predict_rows(g)
     return 0
 
 
@@ -373,6 +424,94 @@ def selfcheck() -> int:
         check(rationale.get("breaker") == "closed"
               and "slo_breaching" in rationale,
               f"readiness rationale incomplete: {rationale}")
+
+        # --------------------- cost-model truth: ledger joins all paths
+        # a speculative request so the verify program pairs too (its
+        # first call is a compile and rightly excluded)
+        for _ in range(2):
+            code, resp = post("/v2/models/lm/generate",
+                              {"prompt": [7, 8, 9] * 4, "max_new_tokens": 12,
+                               "speculation": {"enabled": True, "k": 2}})
+            check(code == 200, f"speculative generate failed: {code} {resp}")
+        preds = _get_json(f"{base}/v2/debug/predictions")
+        lm = preds["models"]["lm"]
+        entries = {e["key"]: e for e in lm["entries"]}
+        for k in ("decode", "verify"):
+            check(entries.get(k, {}).get("pairs", 0) >= 1,
+                  f"no (predicted, measured) pair for {k}: {sorted(entries)}")
+        check(any(k.startswith("prefill[") and e["pairs"] >= 1
+                  for k, e in entries.items()),
+              f"no prefill pair in the ledger: {sorted(entries)}")
+        check(all(e["predicted_s"] > 0 for e in entries.values()),
+              "ledger entry with non-positive prediction")
+
+        # executor program: a tiny compiled model's train window must
+        # join the strategy simulator's compile-time prediction in the
+        # process-wide ledger
+        from flexflow_tpu import (ActiMode, FFConfig, FFModel, LossType,
+                                  SGDOptimizer)
+        from flexflow_tpu.obs.truth import GLOBAL_LEDGER
+
+        mdl = FFModel(FFConfig(batch_size=8))
+        t = mdl.create_tensor((8, 8))
+        t = mdl.dense(t, 8, ActiMode.RELU)
+        t = mdl.dense(t, 4)
+        t = mdl.softmax(t)
+        mdl.compile(optimizer=SGDOptimizer(lr=0.1),
+                    loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+        import jax.numpy as jnp
+        xs = jnp.asarray(np.random.RandomState(0).randn(8, 8), jnp.float32)
+        ys = jnp.zeros((8,), jnp.int32)
+        rng = jax.random.key(0)
+        mdl.executor.train_batch_repeated([xs], ys, rng, num_steps=2)  # compile
+        mdl.executor.train_batch_repeated([xs], ys, rng, num_steps=2)  # measured
+        ex_key = f"{mdl.executor._prog_ns}.train_step"
+        ex_entry = next((e for e in GLOBAL_LEDGER.report()["entries"]
+                         if e["key"] == ex_key), None)
+        check(ex_entry is not None and ex_entry["pairs"] >= 1
+              and ex_entry["predicted_s"] > 0,
+              f"executor program {ex_key} has no (predicted, measured) pair")
+
+        # forced miscalibration: a calibration entry deliberately scaled
+        # to 1/4 of the measured op time must trip the drift alarm with
+        # op-level blame naming the calibration table
+        from flexflow_tpu.core.tensor import TensorSpec
+        from flexflow_tpu.core.types import DataType, OpType
+        from flexflow_tpu.obs.truth import PredictionLedger
+        from flexflow_tpu.ops.base import get_op_def
+        from flexflow_tpu.ops.linear import LinearParams
+        from flexflow_tpu.search.calibration import (Calibration, cost_key,
+                                                     measure_lowered_op,
+                                                     op_ledger_key)
+        from flexflow_tpu.search.cost_model import CostModel
+
+        led = PredictionLedger()
+        drift = []
+        led.on_alarm = drift.append
+        lp = LinearParams(out_dim=64, use_bias=True, dtype=DataType.FLOAT)
+        lspecs = [TensorSpec((128, 64), DataType.FLOAT)]
+        lkey = cost_key(OpType.LINEAR, lp, lspecs, 1)
+        measured = measure_lowered_op(OpType.LINEAR, lp, lspecs, inner=8)
+        if measured is None:
+            # below the host's jitter floor: the alarm-path check still
+            # runs against a nominal measured value
+            measured = 1e-4
+        cal = Calibration(device_kind="cpu", entries={lkey: measured / 4.0})
+        cal.source = "calibration_data/opcosts_cpu.json (selfcheck: entry scaled /4)"
+        cm = CostModel(calibration=cal, ledger=led)
+        out_specs = get_op_def(OpType.LINEAR).infer_output_specs(lp, list(lspecs))
+        cmets = cm.op_cost_metrics(OpType.LINEAR, lp, lspecs, out_specs, 1)
+        check(cmets.prediction_id is not None,
+              "CostMetrics not tagged with a prediction id")
+        for _ in range(4):
+            led.measure(op_ledger_key("cpu", OpType.LINEAR, lp, lspecs, 1),
+                        measured)
+        blame = drift[-1]["blame"] if drift else ""
+        check(drift, "scaled calibration entry did not trip the drift alarm")
+        check("LINEAR" in blame and "+300%" in blame
+              and "calibration table entry" in blame
+              and "opcosts_cpu.json" in blame,
+              f"drift blame wrong: {blame!r}")
     finally:
         srv.stop()
 
@@ -384,7 +523,9 @@ def selfcheck() -> int:
           "each captured a flight-recorder postmortem, cache telemetry "
           "conserves blocks, program registry populated and a forced "
           "retrace produced a correct blame string, SLO + readiness "
-          "rationale live")
+          "rationale live, truth ledger joined prefill/decode/verify + an "
+          "executor program, and a scaled calibration entry tripped the "
+          "drift alarm with correct blame")
     return 0
 
 
@@ -392,9 +533,10 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("command", nargs="?", default="summary",
-                    choices=("summary", "cache", "slo"),
+                    choices=("summary", "cache", "slo", "predict"),
                     help="view: summary (default), cache (block "
-                         "residency), slo (burn rates)")
+                         "residency), slo (burn rates), predict "
+                         "(cost-model truth: error table + drift alarms)")
     ap.add_argument("--url", default="", help="base URL of a running server")
     ap.add_argument("--request", type=int, default=None,
                     help="print one request's trace waterfall")
@@ -417,6 +559,8 @@ def main() -> int:
         return show_cache(base)
     if args.command == "slo":
         return show_slo(base)
+    if args.command == "predict":
+        return show_predictions(base)
     return summarize(base)
 
 
