@@ -52,7 +52,12 @@ Failure taxonomy (the README's failure-semantics table):
                               requests are HELD (never failed with the
                               engine's internal error) and admitted
                               again if the breaker's half-open probe
-                              succeeds after recovery_s
+                              succeeds after recovery_s. In fleet mode
+                              (scheduler.failover_sink set by
+                              serving/fleet.py) nothing is failed at
+                              all: every live stream leaves this
+                              scheduler and journal-replays onto a
+                              surviving replica byte-exactly.
 
 Chaos sites: ``generation.journal_replay`` fires at the top of every
 restart, so tests can inject a *double fault* (a crash during recovery)

@@ -358,8 +358,17 @@ class ContinuousBatchingScheduler:
         trace_progress_every: int = 8,
         slo_objectives=None,
         pressure_threshold: float = 0.10,
+        fault_scope: Optional[str] = None,
     ):
         self.engine = engine
+        # fleet integration (serving/fleet.py): fault_scope tags every
+        # step's injection sites with this replica's id (so chaos plans
+        # can target ONE replica); failover_sink, when set, receives
+        # every live request instead of a terminal EngineFailedError
+        # when the restart budget exhausts — the fleet journal-replays
+        # them onto surviving replicas via adopt()
+        self.fault_scope = fault_scope
+        self.failover_sink: Optional[Callable] = None
         # scheduler-wide default speculation policy (a request's own
         # config overrides it); draft_params backs 'draft_model' drafters
         self.speculation_default = speculation
@@ -661,16 +670,43 @@ class ContinuousBatchingScheduler:
 
     def _fail_running_engine_dead(self, err: EngineFailedError) -> None:
         """Restart budget exhausted: every slot-resident stream is truly
-        lost — fail it with the typed EngineFailedError (never the raw
-        device traceback). The engine was reset, so slot/allocator
-        bookkeeping restarts from empty rather than freeing stale block
-        ids into the fresh free list."""
+        lost to THIS engine — fail it with the typed EngineFailedError
+        (never the raw device traceback). The engine was reset, so
+        slot/allocator bookkeeping restarts from empty rather than
+        freeing stale block ids into the fresh free list.
+
+        With a ``failover_sink`` installed (fleet mode), the streams are
+        not lost at all: every live request — slot-resident, replay-
+        requeued mid-stream, and fresh queued — leaves this scheduler
+        entirely (journal drained, slots cleared, queue emptied) and is
+        handed to the sink, which journal-replays it onto a surviving
+        replica (adopt()). The handoff is safe against double emission
+        because the requests fully exit this scheduler's bookkeeping
+        before the sink runs."""
         self.journal.drain()
-        states = list(self._running.values())
+        states = sorted(self._running.values(), key=lambda s: s.admitted_seq)
         self._reset_slots()
         self.engine.reset()
         for state in states:
             state.blocks = []
+        sink = self.failover_sink
+        if sink is not None:
+            with self._lock:
+                queued, self._queue = list(self._queue), deque()
+            live = [s.req for s in states if not s.req.handle.done()]
+            live += [r for r in queued if not r.handle.done()]
+            try:
+                sink(live, err)
+                return
+            except Exception:
+                # the fleet must never make a dying engine worse: put
+                # the taken queue back (ahead of anything submitted
+                # meanwhile) and fall through to the single-engine
+                # terminal semantics
+                with self._lock:
+                    for req in reversed(queued):
+                        self._queue.appendleft(req)
+        for state in states:
             if state.req.handle._fail(err):
                 self.stats.incr("failed")
         # replay-requeued MID-STREAM requests (n_generated > 0) are as
@@ -678,6 +714,9 @@ class ContinuousBatchingScheduler:
         # tokens, so holding them for a possible future probe would
         # hang them instead. Fresh queued requests stay held: they
         # streamed nothing and remain safe to resubmit or admit later.
+        # One lock hold for the whole partition: the queue must never
+        # look momentarily empty to a concurrent submit, or max_queue
+        # backpressure overshoots while the kept requests re-enter.
         with self._lock:
             keep: deque = deque()
             for req in self._queue:
@@ -713,6 +752,78 @@ class ContinuousBatchingScheduler:
                 self._queue.appendleft(req)
         if replayed:
             self.recovery_stats.incr("replayed_tokens", replayed)
+        self._wake.set()
+
+    def steal_queue(self) -> List[Request]:
+        """Fleet rescue: atomically take every QUEUED (never slot-
+        resident this life, or held behind the breaker) request off this
+        scheduler, for adoption elsewhere. Safe against a live loop
+        thread — the queue is only popped under the same lock. Slot-
+        resident streams are NOT stealable (the loop thread owns them);
+        they finish, fail over via the supervisor, or expire."""
+        with self._lock:
+            stolen, self._queue = list(self._queue), deque()
+        return [r for r in stolen if not r.handle.done()]
+
+    def adopt(self, req: Request, *, front: bool = True) -> None:
+        """Cross-replica journal-replay admission (fleet failover): take
+        ownership of a Request journaled on a dead sibling scheduler.
+        The replay state IS the request object — original prompt, every
+        emitted token, the per-token-count seeded sampling keys and
+        speculation config — so the recompute-prefill path resumes the
+        stream byte-exactly on THIS engine (fleet replicas are built by
+        one factory, hence geometrically identical). Bypasses the
+        max_queue bound and the breaker on purpose: a migrated stream
+        was already admitted once and must not be dropped for
+        backpressure it cleared on its original replica. ``front``
+        requeues ahead of fresh work (mid-stream requests were admitted
+        before anything now waiting)."""
+        req.prompt = req.original_prompt + list(req.generated)
+        # heterogeneous-adopter guards (unreachable for fleet-built
+        # replicas, which share one factory): mirror submit()'s
+        # can-never-be-admitted checks, or the adopted stream wedges
+        # this queue's FCFS head forever
+        room = self.engine.max_seq_len - len(req.prompt)
+        cache_room = (
+            self.engine.allocator.num_total * self.engine.cache_config.block_size
+            - len(req.prompt)
+        )
+        if (
+            len(req.prompt) > self.engine.buckets[-1]
+            or room < 1
+            or self.engine.cache_config.blocks_for(len(req.prompt) + 1)
+            > self.engine.allocator.num_total
+        ):
+            if req.handle._fail(ValueError(
+                f"adopted stream length {len(req.prompt)} can never be "
+                f"admitted on this engine (max bucket "
+                f"{self.engine.buckets[-1]}, max_seq_len "
+                f"{self.engine.max_seq_len}, cache blocks "
+                f"{self.engine.allocator.num_total})"
+            )):
+                self.stats.incr("failed")
+            return
+        # re-clamp the budget against THIS engine's geometry (total
+        # generated = already-emitted + what still fits here)
+        req.max_new = min(
+            req.max_new, req.n_generated + room, req.n_generated + cache_room
+        )
+        if req.n_generated > 0:
+            req.replays += 1
+            req.trace.note_replay()
+            self.recovery_stats.incr("replayed_tokens", req.n_generated)
+        # retarget terminal observability at the adopting scheduler so
+        # the finished trace and SLO/goodput accounting land where the
+        # stream actually completed
+        if req.trace_ring is not None:
+            req.trace_ring = self.trace_ring
+        if req.slo_sink is not None:
+            req.slo_sink = self._slo_record
+        with self._lock:
+            if front:
+                self._queue.appendleft(req)
+            else:
+                self._queue.append(req)
         self._wake.set()
 
     def _reset_slots(self) -> None:
@@ -1361,7 +1472,16 @@ class ContinuousBatchingScheduler:
         any running request speculates. Returns True if any work
         happened. Each working iteration writes one flight-recorder
         step record with its phase decomposition (admission prefills
-        record their own entries inside _admit)."""
+        record their own entries inside _admit). With a fault_scope
+        (fleet replica), the whole iteration — including the supervisor
+        recovery path — runs inside that injection scope so chaos plans
+        can target this replica alone."""
+        if self.fault_scope is None:
+            return self._step_impl()
+        with faults.scope(self.fault_scope):
+            return self._step_impl()
+
+    def _step_impl(self) -> bool:
         ph = self._step_phases = {}
         info = self._step_info = {}
         self._step_recorded = False

@@ -85,6 +85,11 @@ _HELP = {
     "flexflow_sim_prediction_pairs_total": "Measured samples joined with a registered prediction, per key.",
     "flexflow_sim_prediction_unpredicted_total": "Measured samples that had no registered prediction (counted, not dropped).",
     "flexflow_sim_drift_alarms_total": "Calibration-drift alarms raised by the process-wide prediction ledger.",
+    "fleet_replicas": "Current fleet replicas per lifecycle state.",
+    "fleet_failovers_total": "Replica deaths whose live streams were handed over for cross-replica journal-replay.",
+    "fleet_migrated_streams_total": "Streams journal-replayed onto a surviving or replacement replica.",
+    "fleet_replaced_total": "Replicas retired and swapped for a fresh warmed replica.",
+    "router_decisions_total": "Fleet router placements by decision reason.",
 }
 
 
@@ -119,17 +124,42 @@ def _help_type(lines, name: str, kind: str) -> None:
     lines.append(f"# TYPE {name} {kind}")
 
 
+def _model_labels(key) -> str:
+    """Label block for one stats key: a plain model name renders
+    ``model="name"``; a ``(model, replica)`` tuple (a fleet replica's
+    stats) additionally carries ``replica="rN"`` — so every
+    ``flexflow_serving_*`` family is per-replica for fleets and
+    Prometheus aggregates across the replica label."""
+    if isinstance(key, tuple):
+        m, rep = key
+        return 'model="%s",replica="%s"' % (
+            escape_label_value(m), escape_label_value(rep),
+        )
+    return 'model="%s"' % escape_label_value(key)
+
+
+def _sort_key(key):
+    if isinstance(key, tuple):
+        return (key[0], key[1])
+    return (key, "")
+
+
 def render_prometheus(
     models: Mapping[str, "object"],
     fault_sites: Optional[Dict[str, Dict[str, int]]] = None,
     ledger=None,
+    fleets: Optional[Dict[str, Dict]] = None,
 ) -> str:
-    """Render ``{model_name: ServingStats}`` (plus optional fault-site
-    counters from runtime.faults.site_counters(), plus the process-wide
-    prediction ledger's ``flexflow_sim_*`` families) as exposition
+    """Render ``{model_name: ServingStats}`` (keys may be
+    ``(model, replica)`` tuples for fleet replicas — every family then
+    carries a ``replica`` label), plus optional fault-site counters
+    from runtime.faults.site_counters(), the process-wide prediction
+    ledger's ``flexflow_sim_*`` families, and per-fleet lifecycle
+    families (``fleets={model: Fleet.prom_fleet()}``: replica states,
+    failover/migration counters, router decisions) as exposition
     text."""
     lines: list = []
-    names = sorted(models)
+    names = sorted(models, key=_sort_key)
 
     # ------------------------------------------------------------ counters
     _help_type(lines, "flexflow_serving_requests_total", "counter")
@@ -137,8 +167,8 @@ def render_prometheus(
         counts = models[m].counters()
         for outcome in sorted(counts):
             lines.append(
-                'flexflow_serving_requests_total{model="%s",outcome="%s"} %s'
-                % (escape_label_value(m), escape_label_value(outcome),
+                'flexflow_serving_requests_total{%s,outcome="%s"} %s'
+                % (_model_labels(m), escape_label_value(outcome),
                    format_value(counts[outcome]))
             )
 
@@ -146,20 +176,20 @@ def render_prometheus(
     _help_type(lines, "flexflow_serving_request_latency_seconds", "summary")
     for m in names:
         snap = models[m].latency.snapshot()
-        ml = escape_label_value(m)
+        ml = _model_labels(m)
         for q, key in (("0.5", "p50_s"), ("0.95", "p95_s"), ("0.99", "p99_s")):
             lines.append(
-                'flexflow_serving_request_latency_seconds{model="%s",quantile="%s"} %s'
+                'flexflow_serving_request_latency_seconds{%s,quantile="%s"} %s'
                 % (ml, q, format_value(snap[key]))
             )
         # sum/count from the SAME locked snapshot, so ratio consumers
         # never see a sum that includes an observation count doesn't
         lines.append(
-            'flexflow_serving_request_latency_seconds_sum{model="%s"} %s'
+            'flexflow_serving_request_latency_seconds_sum{%s} %s'
             % (ml, format_value(snap["sum_s"]))
         )
         lines.append(
-            'flexflow_serving_request_latency_seconds_count{model="%s"} %s'
+            'flexflow_serving_request_latency_seconds_count{%s} %s'
             % (ml, format_value(snap["count"]))
         )
 
@@ -176,16 +206,16 @@ def render_prometheus(
             snap = hist_snaps[m].get(hname)
             if snap is None:
                 continue
-            ml = escape_label_value(m)
+            ml = _model_labels(m)
             for le, cum in snap["buckets"]:
                 lines.append(
-                    '%s_bucket{model="%s",le="%s"} %s'
+                    '%s_bucket{%s,le="%s"} %s'
                     % (family, ml,
                        "+Inf" if math.isinf(le) else format_value(le),
                        format_value(cum))
                 )
-            lines.append('%s_sum{model="%s"} %s' % (family, ml, format_value(snap["sum"])))
-            lines.append('%s_count{model="%s"} %s' % (family, ml, format_value(snap["count"])))
+            lines.append('%s_sum{%s} %s' % (family, ml, format_value(snap["sum"])))
+            lines.append('%s_count{%s} %s' % (family, ml, format_value(snap["count"])))
 
     # --------------------------------------------------------------- gauges
     gauge_values = {m: models[m].gauge_values() for m in names}
@@ -198,9 +228,45 @@ def render_prometheus(
             if v is None:
                 continue  # unregistered here, or the gauge callable died
             lines.append(
-                '%s{model="%s"} %s'
-                % (family, escape_label_value(m), format_value(v))
+                '%s{%s} %s'
+                % (family, _model_labels(m), format_value(v))
             )
+
+    # ---------------------------------------------------------------- fleet
+    if fleets:
+        fnames = sorted(fleets)
+        _help_type(lines, "flexflow_serving_fleet_replicas", "gauge")
+        for f in fnames:
+            fl = escape_label_value(f)
+            states = fleets[f].get("states", {})
+            for state in sorted(states):
+                lines.append(
+                    'flexflow_serving_fleet_replicas{model="%s",state="%s"} %s'
+                    % (fl, escape_label_value(state), format_value(states[state]))
+                )
+        for short, key in (
+            ("fleet_failovers_total", "failovers_total"),
+            ("fleet_migrated_streams_total", "migrated_streams_total"),
+            ("fleet_replaced_total", "replaced_total"),
+        ):
+            family = "flexflow_serving_%s" % short
+            _help_type(lines, family, "counter")
+            for f in fnames:
+                lines.append(
+                    '%s{model="%s"} %s'
+                    % (family, escape_label_value(f),
+                       format_value(fleets[f].get(key, 0)))
+                )
+        _help_type(lines, "flexflow_serving_router_decisions_total", "counter")
+        for f in fnames:
+            fl = escape_label_value(f)
+            decisions = fleets[f].get("router_decisions", {})
+            for reason in sorted(decisions):
+                lines.append(
+                    'flexflow_serving_router_decisions_total{model="%s",reason="%s"} %s'
+                    % (fl, escape_label_value(reason),
+                       format_value(decisions[reason]))
+                )
 
     # ---------------------------------------------------------- fault sites
     if fault_sites:

@@ -40,6 +40,18 @@ Injection sites currently threaded through the codebase:
                                 restart (value = journal entries); an error here
                                 is a double fault consuming another restart
                                 budget unit (generation/recovery.py)
+  ``fleet.route``               before each fleet routing decision (value =
+                                (prompt tokens, candidate replica ids))
+  ``fleet.replica_spawn``       before a fleet replica is built/warmed (value =
+                                the new replica id); an error here is a failed
+                                replacement spawn (serving/fleet.py)
+
+**Scopes**: a fleet replica runs its scheduler steps inside
+``with scope(replica_id):`` — rules registered with ``scope=`` (or via the
+:func:`replica_kill` helper) fire only on that replica's calls, and their
+``nth``/``every`` triggers count against a per-(site, scope) call counter,
+so chaos tests can murder replica "r1" on exactly ITS 3rd decode step no
+matter how the fleet interleaves replicas.
 
 Usage::
 
@@ -74,6 +86,59 @@ class TransientDeviceError(RuntimeError):
 # Module-global active plan. ``inject`` reads this exactly once per call;
 # when no plan is installed the call is a no-op returning its value.
 _PLAN: Optional["FaultPlan"] = None
+
+# Thread-local injection scope (fleet replica id). Only scoped call
+# sites pay for it; the disabled-plan hot path never reads it.
+_SCOPE = threading.local()
+
+
+class scope:
+    """Tag injections on this thread with a label (a fleet replica id):
+    ``with faults.scope("r1"): ...``. Rules with a matching ``scope``
+    fire only inside; nesting restores the previous label on exit."""
+
+    __slots__ = ("name", "_prev")
+
+    def __init__(self, name: Optional[str]):
+        self.name = name
+
+    def __enter__(self) -> "scope":
+        self._prev = getattr(_SCOPE, "name", None)
+        _SCOPE.name = self.name
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _SCOPE.name = self._prev
+
+
+def current_scope() -> Optional[str]:
+    return getattr(_SCOPE, "name", None)
+
+
+def replica_kill(
+    plan: "FaultPlan",
+    replica: str,
+    *,
+    site: str = "generation.decode_step",
+    mode: str = "error",
+    error: Any = None,
+    gate: Optional[threading.Event] = None,
+    nth=None,
+    every: Optional[int] = None,
+    max_fires: Optional[int] = None,
+) -> "FaultPlan":
+    """Chaos helper: deterministically murder ONE fleet replica
+    mid-step. Registers a scoped rule on ``site`` (default: the batched
+    decode step) that fires only for ``replica``'s own calls, with
+    ``nth``/``every`` counted per replica — ``replica_kill(plan, "r1",
+    every=1)`` fails every one of r1's decode steps until its restart
+    budget exhausts and the fleet fails its streams over."""
+    if error is None and mode == "error":
+        error = RuntimeError(f"injected kill of replica {replica}")
+    return plan.on(
+        site, mode=mode, error=error, gate=gate, nth=nth, every=every,
+        max_fires=max_fires, scope=replica,
+    )
 
 
 def inject(site: str, value: Any = None) -> Any:
@@ -144,6 +209,7 @@ class FaultRule:
     probability: Optional[float] = None  # seeded coin flip
     when: Optional[Callable[[Any], bool]] = None  # predicate on value
     select: Optional[Callable[[Any], Any]] = None  # nan mode: per-entry mask
+    scope: Optional[str] = None  # fire only inside with scope(name); nth/every count per (site, scope)
     max_fires: Optional[int] = None
     fires: int = 0
 
@@ -158,6 +224,7 @@ class FaultPlan:
         self._sleep = sleep
         self._rules: Dict[str, List[FaultRule]] = {}
         self._counts: Dict[str, int] = {}
+        self._scope_counts: Dict[Tuple[str, str], int] = {}
         self._rngs: Dict[int, random.Random] = {}
         self._lock = threading.Lock()
         self.events: List[Tuple[str, int, str]] = []  # (site, call, mode)
@@ -176,6 +243,7 @@ class FaultPlan:
         probability: Optional[float] = None,
         when: Optional[Callable[[Any], bool]] = None,
         select: Optional[Callable[[Any], Any]] = None,
+        scope: Optional[str] = None,
         max_fires: Optional[int] = None,
     ) -> "FaultPlan":
         if mode not in ("error", "latency", "nan", "stall"):
@@ -187,7 +255,8 @@ class FaultPlan:
         rule = FaultRule(
             site=site, mode=mode, error=error, latency_s=latency_s, gate=gate,
             nth=tuple(nth) if nth is not None else None, every=every,
-            probability=probability, when=when, select=select, max_fires=max_fires,
+            probability=probability, when=when, select=select, scope=scope,
+            max_fires=max_fires,
         )
         self._rules.setdefault(site, []).append(rule)
         return self
@@ -218,6 +287,12 @@ class FaultPlan:
         """How many times ``site`` was reached (fired or not)."""
         with self._lock:
             return self._counts.get(site, 0)
+
+    def scoped_calls(self, site: str, scope_name: str) -> int:
+        """How many times ``site`` was reached inside ``scope(name)``
+        (the counter scoped rules' nth/every triggers run against)."""
+        with self._lock:
+            return self._scope_counts.get((site, scope_name), 0)
 
     def fired(self, site: str) -> int:
         with self._lock:
@@ -262,12 +337,26 @@ class FaultPlan:
         return True
 
     def _fire(self, site: str, value: Any) -> Any:
+        sc = current_scope()
         with self._lock:
             call = self._counts.get(site, 0)
             self._counts[site] = call + 1
-            hits = [
-                r for r in self._rules.get(site, ()) if self._matches(r, call, value)
-            ]
+            scall = None
+            if sc is not None:
+                scall = self._scope_counts.get((site, sc), 0)
+                self._scope_counts[(site, sc)] = scall + 1
+            hits = []
+            for r in self._rules.get(site, ()):
+                if r.scope is not None:
+                    # scoped rule: fires only inside its scope, with
+                    # nth/every counted against the per-scope counter
+                    if r.scope != sc:
+                        continue
+                    idx = scall
+                else:
+                    idx = call
+                if self._matches(r, idx, value):
+                    hits.append(r)
             for r in hits:
                 r.fires += 1
                 self.events.append((site, call, r.mode))

@@ -16,13 +16,16 @@ from .resilience import (
     ShuttingDownError,
 )
 from .server import InferenceServer
-from .stats import Histogram, LatencyWindow, ServingStats, TokenRate
+from .stats import FleetStats, Histogram, LatencyWindow, ServingStats, TokenRate
 
 __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
     "DeadlineExceededError",
     "DynamicBatcher",
+    "Fleet",
+    "FleetRouter",
+    "FleetStats",
     "GenerationModel",
     "GrpcInferenceServer",
     "Histogram",
@@ -44,7 +47,7 @@ __all__ = [
 
 def __getattr__(name):
     # lazy: grpc_server pulls in grpcio + protobuf only when used;
-    # GenerationModel pulls in the generation package (jax tracing)
+    # GenerationModel / Fleet pull in the generation package (jax tracing)
     if name == "GrpcInferenceServer":
         from .grpc_server import GrpcInferenceServer
 
@@ -53,4 +56,8 @@ def __getattr__(name):
         from .generation import GenerationModel
 
         return GenerationModel
+    if name in ("Fleet", "FleetRouter"):
+        from . import fleet
+
+        return getattr(fleet, name)
     raise AttributeError(name)

@@ -1,0 +1,984 @@
+"""Fleet serving tier: N generation-engine replicas behind a
+cache-aware router, with chaos-certified drain / replace / failover.
+
+PR 4 made a *single* engine self-healing; this module makes the failure
+of any one replica a **routing event, not an outage**. A :class:`Fleet`
+owns N replicas — each a full :class:`GenerationModel` with its own
+continuous-batching scheduler, supervisor, circuit breaker, step
+watchdog, and flight ring — and three cooperating pieces:
+
+* :class:`FleetRouter` — places each request by **prefix affinity**
+  (longest shared prefix with a replica's resident / recently routed
+  prompts, so shared system prompts land where their KV neighbors
+  live) and **least-loaded score** computed from the PR 5/6 telemetry
+  already on every replica: queue depth, slot occupancy, free KV
+  blocks, and TTFT error-budget burn. Affinity only breaks load ties
+  (within ``TIE_MARGIN``): a skewed replica loses traffic no matter how
+  warm its prefixes are. A DRAINING or breaker-OPEN replica is never a
+  candidate. Decisions are counted by reason
+  (``router_decisions_total{reason}``) and stamped on request traces.
+
+* the **fleet supervisor** (:meth:`Fleet.check`, a thread under
+  ``start()`` / manual calls in virtual-clock tests) — turns the health
+  signals PRs 1/4 already emit into lifecycle transitions:
+
+    watchdog trip            -> **drain** (stop admitting; residents
+                                finish — or, if the replica is truly
+                                wedged, expire at their own deadlines
+                                while the watchdog reaps them)
+    drain complete/timeout   -> **replace** (spawn a fresh replica and
+                                warm its jits BEFORE it takes traffic:
+                                the fixed-shape decode program compiles
+                                during warmup, so the replacement's
+                                first request costs zero steady-state
+                                retraces)
+    restart budget exhausted -> **failover** (below), then replace
+
+* **cross-replica journal-replay failover** — every replica scheduler
+  carries a ``failover_sink``: when its supervisor gives up
+  (EngineFailedError), the live streams are NOT failed; they leave the
+  dead scheduler entirely (journal drained, slots cleared) and the
+  fleet re-admits each one on a survivor via
+  ``ContinuousBatchingScheduler.adopt()``. The journal state is the
+  request object itself — original prompt, emitted tokens, per-token-
+  count seeded sampling keys, speculation config — which is engine-
+  agnostic, so the recompute-prefill path resumes every stream
+  **byte-exactly** on the survivor (greedy, seeded temperature, and
+  speculative; the same PR 2/3 determinism that makes same-engine
+  replay exact). Requests with no eligible survivor (n=1, or total
+  brownout) wait in a fleet-level pending queue and ride onto the
+  replacement replica — the HELD queue survives a full replica
+  replacement.
+
+``Fleet(n=1)`` is the single-replica degenerate case and duck-types
+:class:`GenerationModel` (same submit/stats/health surface, same typed
+errors, zero extra retraces), so existing callers migrate by swapping
+the constructor. Chaos is the spec: ``runtime/faults.py`` grew
+``fleet.route`` / ``fleet.replica_spawn`` sites plus a
+``replica_kill`` helper (scoped rules that murder ONE replica's steps
+deterministically), driven by tests/test_fleet.py on virtual clocks and
+``tools/chaoscheck.py --fleet`` live.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..generation.engine import GenerationEngine, SamplingParams
+from ..generation.recovery import EngineFailedError
+from ..generation.scheduler import GenerationHandle, Request
+from ..obs import FlightRecorder
+from ..runtime import faults
+from .generation import GenerationModel
+from .resilience import (
+    CircuitOpenError,
+    DeadlineExceededError,
+    ShuttingDownError,
+)
+from .stats import FleetStats
+
+
+class ReplicaState:
+    """Replica lifecycle states (strings, so reports stay JSON-plain)."""
+
+    ACTIVE = "active"      # eligible for routing
+    DRAINING = "draining"  # finishing residents; no new placements
+    RETIRING = "retiring"  # replaced, but still finishing residents
+    DEAD = "dead"          # engine declared failed; streams failed over
+
+
+class Replica:
+    """One fleet member: id + GenerationModel + lifecycle state."""
+
+    def __init__(self, rid: str, model: GenerationModel):
+        self.id = rid
+        self.model = model
+        self.state = ReplicaState.ACTIVE
+        self.since = 0.0  # last state-transition time (fleet clock)
+        # router affinity memory: recently routed prompts (prefix-capped)
+        self.recent_prompts: deque = deque(maxlen=8)
+        # health-signal edge detection for the fleet supervisor
+        self.seen_watchdog_trips = 0
+        self.breaker_open_checks = 0  # consecutive checks observed OPEN
+        # quarantine-storm detection: quarantines since the last
+        # completed request on this replica
+        self.seen_completed = 0
+        self.seen_quarantined = 0
+        self.quarantine_streak = 0
+        self.drain_started: Optional[float] = None
+
+    @property
+    def scheduler(self):
+        return self.model.scheduler
+
+    @property
+    def engine(self) -> GenerationEngine:
+        return self.model.engine
+
+    def eligible(self) -> bool:
+        """May the router place NEW traffic here? Active, breaker not
+        holding traffic, and not shutting down."""
+        return (
+            self.state == ReplicaState.ACTIVE
+            and self.model.breaker.ready()
+            and not self.scheduler._draining
+            and not self.scheduler._stopped
+        )
+
+
+class FleetRouter:
+    """Cache-aware placement: least-loaded wins under skew; prefix
+    affinity breaks ties among near-equally loaded replicas."""
+
+    # load-score ties within this margin are broken by prefix affinity
+    # (one queued/running request = 1.0, so affinity never outvotes a
+    # whole request of load imbalance)
+    TIE_MARGIN = 0.5
+    PREFIX_CAP = 256  # tokens of prefix compared/remembered per prompt
+
+    def __init__(self, fleet: "Fleet", stats: FleetStats):
+        self.fleet = fleet
+        self.stats = stats
+
+    # ------------------------------------------------------------ scoring
+    def load_score(self, replica: Replica) -> float:
+        """Smaller = less loaded. Inputs are the telemetry PRs 5/6
+        already maintain: queue depth + slot occupancy (unit weight
+        each), KV-block pressure (0..1), and the replica's fast-window
+        TTFT burn (capped — a replica burning its latency budget sheds
+        load even when its queue looks short)."""
+        s = replica.scheduler
+        alloc = s.engine.allocator
+        load = float(len(s._queue) + len(s._running))
+        load += 1.0 - alloc.num_free / max(1, alloc.num_total)
+        load += 0.25 * min(2.0, self._ttft_burn(s))
+        return load
+
+    @staticmethod
+    def _ttft_burn(scheduler) -> float:
+        burn = 0.0
+        try:
+            for obj in scheduler.slo.objectives:
+                if "ttft" in obj.name:
+                    burn = max(burn, scheduler.slo.burn_rate(obj.name, "fast"))
+        except Exception:
+            pass  # routing must never die of an SLO accounting race
+        return burn
+
+    def affinity(self, replica: Replica, prompt: Sequence[int]) -> int:
+        """Longest common prefix (tokens) between ``prompt`` and the
+        replica's resident or recently routed prompts — the requests
+        whose KV blocks are (or were just) hot on that engine. Reads
+        live structures owned by other threads (the loop thread mutates
+        _running; concurrent submits append recent prompts), so a
+        mid-iteration mutation degrades to zero affinity rather than
+        failing the route."""
+        try:
+            seen: List[Tuple[int, ...]] = list(replica.recent_prompts)
+            for st in list(replica.scheduler._running.values()):
+                seen.append(tuple(st.req.original_prompt[: self.PREFIX_CAP]))
+        except RuntimeError:
+            return 0
+        best = 0
+        for p in seen:
+            n = 0
+            for a, b in zip(p, prompt):
+                if a != b:
+                    break
+                n += 1
+            best = max(best, n)
+        return best
+
+    # ------------------------------------------------------------ routing
+    def route(self, prompt: Sequence[int]) -> Tuple[Replica, str]:
+        """Pick the replica for one request; returns (replica, reason).
+        Raises CircuitOpenError when no replica is eligible (fleet
+        brownout) — except the single-replica fleet, which delegates to
+        its lone replica so submit raises exactly the bare
+        GenerationModel's typed error (parity)."""
+        reps = self.fleet._replicas_snapshot()
+        faults.inject("fleet.route", (list(prompt), [r.id for r in reps]))
+        cands = [r for r in reps if r.eligible()]
+        if not cands:
+            if len(reps) == 1:
+                # n=1 parity: the lone replica's own submit raises the
+                # right typed error (CircuitOpen / ShuttingDown)
+                self.stats.note_decision("only_candidate")
+                return reps[0], "only_candidate"
+            self.stats.note_decision("no_candidate")
+            raise CircuitOpenError(
+                "fleet brownout: no eligible replica "
+                f"({', '.join(f'{r.id}={r.state}' for r in reps)})"
+            )
+        if len(cands) == 1:
+            choice, reason = cands[0], "only_candidate"
+        else:
+            loads = {r.id: self.load_score(r) for r in cands}
+            best = min(loads.values())
+            near = [r for r in cands if loads[r.id] <= best + self.TIE_MARGIN]
+            if len(near) > 1:
+                affs = {r.id: self.affinity(r, prompt) for r in near}
+                amax = max(affs.values())
+                if amax > 0:
+                    choice = min(
+                        (r for r in near if affs[r.id] == amax),
+                        key=lambda r: (loads[r.id], r.id),
+                    )
+                    reason = "affinity"
+                else:
+                    choice = min(near, key=lambda r: (loads[r.id], r.id))
+                    reason = "least_loaded"
+            else:
+                choice, reason = near[0], "least_loaded"
+        self.stats.note_decision(reason)
+        choice.recent_prompts.append(tuple(prompt[: self.PREFIX_CAP]))
+        return choice, reason
+
+    def place_failover(self, replicas: List[Replica]) -> Optional[Replica]:
+        """Survivor choice for a migrated stream: least-loaded eligible
+        replica (affinity is meaningless — the stream's KV blocks died
+        with its engine)."""
+        cands = [r for r in replicas if r.eligible()]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (self.load_score(r), r.id))
+
+
+class _FleetBreakerView:
+    """Duck-typed breaker for server-level readiness: the fleet is
+    'open' only when NO replica can take traffic."""
+
+    def __init__(self, fleet: "Fleet"):
+        self._fleet = fleet
+
+    def ready(self) -> bool:
+        return any(r.eligible() for r in self._fleet._replicas_snapshot())
+
+    @property
+    def state(self) -> str:
+        return "closed" if self.ready() else "open"
+
+
+class _MergedTraceRing:
+    """Read-only merged view over every replica's trace ring (the
+    fleet-level ``GET /v2/debug/traces`` surface)."""
+
+    def __init__(self, fleet: "Fleet"):
+        self._fleet = fleet
+
+    def _rings(self):
+        return [r.model.trace_ring for r in self._fleet._replicas_snapshot()]
+
+    @property
+    def capacity(self) -> int:
+        return sum(r.capacity for r in self._rings())
+
+    def __len__(self) -> int:
+        return sum(len(r) for r in self._rings())
+
+    def recent(self, n: int = 32):
+        traces = [t for ring in self._rings() for t in ring.recent(n)]
+        traces.sort(key=lambda t: t.t_finish or 0, reverse=True)
+        return traces[:n]
+
+    def get(self, request_id: int):
+        for ring in self._rings():
+            tr = ring.get(request_id)
+            if tr is not None:
+                return tr
+        return None
+
+
+class _FleetAggregateStats:
+    """``/v2/stats`` view of a multi-replica fleet: per-replica
+    snapshots plus summed admission counters and load gauges (the
+    per-replica Prometheus families carry everything else)."""
+
+    _SUM_GAUGES = ("queue_depth", "running", "tokens_generated", "preemptions")
+
+    def __init__(self, fleet: "Fleet"):
+        self._fleet = fleet
+
+    def snapshot(self) -> Dict:
+        from .stats import ServingStats
+
+        per = {
+            r.id: r.model.stats.snapshot()
+            for r in self._fleet._replicas_snapshot()
+        }
+        folded = self._fleet._folded_snapshot()
+        out: Dict = {}
+        for c in ServingStats.COUNTERS:
+            out[c] = sum(int(p.get(c) or 0) for p in per.values())
+            out[c] += int(folded.get(c) or 0)
+        for g in self._SUM_GAUGES:
+            out[g] = sum(p.get(g) or 0 for p in per.values())
+        out["fleet"] = self._fleet.fleet_stats.snapshot()
+        out["fleet"]["replicas_by_state"] = self._fleet.states()
+        out["fleet"]["pending"] = len(self._fleet._pending)
+        out["replicas"] = per
+        return out
+
+
+class Fleet:
+    """N warm generation replicas behind a cache-aware router, with a
+    supervisor owning the drain / replace / failover lifecycle.
+
+    ``engine_factory`` builds one fresh :class:`GenerationEngine` per
+    replica (initial fleet AND replacements) — replicas are homogeneous
+    by construction, which is what makes cross-replica journal replay
+    exact. ``scheduler_kwargs`` are passed to every replica's
+    continuous-batching scheduler (clock/breaker/recovery/watchdog —
+    pass factories for per-replica objects exactly as with the batcher;
+    plain values like ``recovery=RecoveryPolicy(...)`` are fine).
+
+    Duck-types :class:`GenerationModel` so ``InferenceServer.
+    register_generation`` and existing callers work unchanged; with
+    ``n=1`` the delegation is total (same stats object, same breaker,
+    same typed errors, zero extra retraces).
+    """
+
+    def __init__(
+        self,
+        engine_factory: Callable[[], GenerationEngine],
+        n: int = 1,
+        *,
+        name: str = "generator",
+        clock: Callable[[], float] = time.monotonic,
+        warmup: bool = True,
+        warm_prompt: Sequence[int] = (1, 2, 3, 1, 2, 3),
+        warm_tokens: int = 3,
+        auto_replace: bool = True,
+        drain_timeout_s: float = 60.0,
+        poll_s: float = 0.25,
+        max_spawn_retries: int = 3,
+        quarantine_streak_limit: int = 3,
+        observability: bool = True,
+        scheduler_kwargs: Optional[dict] = None,
+    ):
+        if n < 1:
+            raise ValueError("a fleet needs at least one replica")
+        self.engine_factory = engine_factory
+        self.name = name
+        self.clock = clock
+        self.warmup = warmup
+        self.warm_prompt = list(warm_prompt)
+        self.warm_tokens = warm_tokens
+        self.auto_replace = auto_replace
+        self.drain_timeout_s = drain_timeout_s
+        self.poll_s = poll_s
+        self.max_spawn_retries = max_spawn_retries
+        self.quarantine_streak_limit = quarantine_streak_limit
+        self._scheduler_kwargs = dict(scheduler_kwargs or {})
+        self._scheduler_kwargs.setdefault("clock", clock)
+        self._scheduler_kwargs.setdefault("observability", observability)
+        self.fleet_stats = FleetStats()
+        # fleet lifecycle ring: route/drain/replace/failover/migrate
+        # events with dual-clock stamps, surfaced on GET /v2/fleet
+        self.fleet_flight = FlightRecorder(
+            capacity=256, enabled=observability, sched_clock=clock
+        )
+        self._lock = threading.RLock()
+        self._pending: deque = deque()  # requests awaiting ANY replica
+        # counters folded in from retired replicas AND fleet-pending
+        # terminal outcomes, so the aggregate /v2/stats view stays
+        # cumulative across replacements and never under-reports
+        # failures that happened outside any replica
+        self._folded_counters: Dict[str, int] = {}
+        self._rid = itertools.count()
+        self._spawn_fail_streak = 0
+        self._draining = False
+        self._stopped = False
+        self._started = False
+        self._monitor: Optional[threading.Thread] = None
+        self._monitor_stop = threading.Event()
+        self.router = FleetRouter(self, self.fleet_stats)
+        # replaced-but-still-busy replicas: out of the routing set, kept
+        # stepping until their residents finish (or expire), then torn
+        # down — a drain timeout must never abort live streams
+        self._retiring: List[Replica] = []
+        self.replicas: List[Replica] = [self._spawn() for _ in range(n)]
+
+    # ----------------------------------------------------------- replicas
+    def _replicas_snapshot(self) -> List[Replica]:
+        with self._lock:
+            return list(self.replicas)
+
+    def _spawn(self) -> Replica:
+        """Build + warm one replica. The ``fleet.replica_spawn`` fault
+        site fires BEFORE the factory so chaos tests can fail a
+        replacement; warmup compiles the steady-state programs (the
+        fixed-shape decode jit, the warm prompt's prefill bucket, and —
+        when the fleet speculates by default — the verify jit) so the
+        replica's first real request never pays a retrace."""
+        rid = f"r{next(self._rid)}"
+        faults.inject("fleet.replica_spawn", rid)
+        engine = self.engine_factory()
+        if self.warmup:
+            engine.generate(
+                [list(self.warm_prompt)],
+                SamplingParams(max_new_tokens=self.warm_tokens),
+                speculation=self._scheduler_kwargs.get("speculation"),
+                draft_params=self._scheduler_kwargs.get("draft_params"),
+            )
+        kwargs = dict(self._scheduler_kwargs)
+        for key in ("breaker", "retry"):
+            # stateful per-replica objects must not be shared: pass them
+            # as zero-arg factories (same convention as make_batcher)
+            if callable(kwargs.get(key)):
+                kwargs[key] = kwargs[key]()
+        model = GenerationModel(
+            engine, name=self.name, fault_scope=rid, **kwargs
+        )
+        rep = Replica(rid, model)
+        rep.since = self.clock()
+        model.scheduler.failover_sink = (
+            lambda reqs, cause, _rep=rep: self._on_replica_failed(_rep, reqs, cause)
+        )
+        if self._started:
+            model.start()
+        return rep
+
+    def states(self) -> Dict[str, int]:
+        out = {s: 0 for s in (ReplicaState.ACTIVE, ReplicaState.DRAINING, ReplicaState.DEAD)}
+        with self._lock:
+            members = list(self.replicas) + list(self._retiring)
+        for r in members:
+            out[r.state] = out.get(r.state, 0) + 1
+        return out
+
+    # ------------------------------------------------------------- submit
+    def submit(
+        self,
+        prompt: Sequence[int],
+        sampling: Optional[SamplingParams] = None,
+        deadline_s: Optional[float] = None,
+        speculation=None,
+        transport: Optional[str] = None,
+    ) -> GenerationHandle:
+        """Route + enqueue one request. Typed rejections mirror the
+        single-model path (QueueFullError / CircuitOpenError /
+        ShuttingDownError / DeadlineExceededError), plus
+        CircuitOpenError for a fleet-wide brownout."""
+        if self._draining or self._stopped:
+            raise ShuttingDownError("fleet draining")
+        replica, reason = self.router.route(prompt)
+        handle = replica.model.submit(
+            prompt, sampling, deadline_s=deadline_s,
+            speculation=speculation, transport=transport,
+        )
+        handle.trace.event("route", replica=replica.id, reason=reason)
+        self.fleet_flight.record_event(
+            "route", replica=replica.id, reason=reason,
+            request_id=handle._request.id,
+        )
+        return handle
+
+    def generate(
+        self,
+        prompt: Sequence[int],
+        sampling: Optional[SamplingParams] = None,
+        timeout: Optional[float] = None,
+        speculation=None,
+    ) -> List[int]:
+        """Blocking single-request generation (deadline = timeout)."""
+        handle = self.submit(
+            prompt, sampling, deadline_s=timeout, speculation=speculation
+        )
+        return handle.result(timeout=timeout)
+
+    # ----------------------------------------------------------- failover
+    def _on_replica_failed(
+        self, replica: Replica, requests: List[Request], cause: BaseException
+    ) -> None:
+        """failover_sink for one replica (runs on ITS loop thread inside
+        the supervisor's give-up, after the requests fully left the dead
+        scheduler): mark the replica DEAD and journal-replay every live
+        stream onto survivors. Never raises — an unplaceable request
+        waits in the fleet pending queue for the replacement replica."""
+        with self._lock:
+            replica.state = ReplicaState.DEAD
+            replica.since = self.clock()
+        self.fleet_stats.incr("failovers")
+        self.fleet_flight.record_event(
+            "failover", replica=replica.id, streams=len(requests),
+            error=repr(cause)[:200],
+        )
+        self._place(requests)
+
+    def _place(self, requests: List[Request]) -> None:
+        """Admit journal-replayed requests onto eligible replicas.
+        Mid-stream requests (clients already hold tokens) go to the
+        FRONT of their survivor's queue in original order; fresh ones
+        to the back. Unplaceable requests wait in the fleet pending
+        queue (drained onto the next replica to come up). Never raises,
+        and guards PER REQUEST: a failure placing one request pends
+        that request alone — an already-adopted stream must never be
+        re-pended, or two schedulers would own (and emit into) it."""
+        mid = [r for r in requests if r.n_generated > 0]
+        fresh = [r for r in requests if r.n_generated == 0]
+        unplaced: List[Request] = []
+        for req in list(reversed(mid)) + fresh:
+            if req.handle.done():
+                continue
+            try:
+                survivor = self.router.place_failover(self._replicas_snapshot())
+                if survivor is None:
+                    unplaced.append(req)
+                    continue
+                survivor.scheduler.adopt(req, front=(req.n_generated > 0))
+            except Exception:
+                # adopt's enqueue is its final mutation, so a raise
+                # means the request did NOT land on the survivor
+                unplaced.append(req)
+                continue
+            self.fleet_stats.incr("migrated_streams")
+            try:
+                req.trace.event("failover", to_replica=survivor.id)
+                self.fleet_flight.record_event(
+                    "migrate", request_id=req.id, to_replica=survivor.id,
+                    mid_stream=req.n_generated > 0,
+                )
+            except Exception:
+                pass  # telemetry must not disturb an adopted stream
+        if unplaced:
+            with self._lock:
+                # preserve original relative order in pending
+                for req in requests:
+                    if req in unplaced:
+                        self._pending.append(req)
+
+    # --------------------------------------------------------- supervisor
+    def drain(self, replica: Replica, reason: str = "manual") -> None:
+        """Stop admitting to ``replica``; residents finish on it (the
+        scheduler keeps stepping). The supervisor replaces it once idle
+        or after ``drain_timeout_s``."""
+        with self._lock:
+            if replica.state != ReplicaState.ACTIVE:
+                return
+            replica.state = ReplicaState.DRAINING
+            replica.since = self.clock()
+            replica.drain_started = self.clock()
+        self.fleet_stats.incr("drains")
+        self.fleet_flight.record_event("drain", replica=replica.id, reason=reason)
+
+    def check(self) -> None:
+        """One fleet-supervisor inspection (manual on virtual clocks in
+        tests; polled by the monitor thread under start()): edge-detect
+        health signals into drains, complete drains into replacements,
+        replace dead replicas, re-admit pending requests, and expire
+        pending deadlines."""
+        now = self.clock()
+        self._sweep_retiring()
+        for rep in self._replicas_snapshot():
+            sched = rep.scheduler
+            if rep.state == ReplicaState.ACTIVE:
+                trips = sched.recovery_stats.watchdog_trips
+                if trips > rep.seen_watchdog_trips:
+                    rep.seen_watchdog_trips = trips
+                    self.drain(rep, reason="watchdog_trip")
+                elif rep.model.breaker.state == "open":
+                    # PR 1's third health signal: a breaker held OPEN
+                    # (step-failure storm or a trip that never
+                    # recovered) drains the replica — two consecutive
+                    # observations, so a transient open that the
+                    # recovery path closes immediately doesn't thrash
+                    # replacements
+                    rep.breaker_open_checks += 1
+                    if rep.breaker_open_checks >= 2:
+                        self.drain(rep, reason="breaker_open")
+                else:
+                    rep.breaker_open_checks = 0
+                if rep.state == ReplicaState.ACTIVE:
+                    # quarantine storms slip past the consecutive-
+                    # failure breaker (each successful prefill resets
+                    # its count), so a replica quarantining every
+                    # stream looks healthy to it: N quarantines with no
+                    # completed request in between is a replica-health
+                    # signal, not N coincidentally poisoned clients
+                    completed = sched.stats.get("completed")
+                    quarantined = sched.recovery_stats.quarantined
+                    if completed > rep.seen_completed:
+                        rep.quarantine_streak = 0
+                    rep.quarantine_streak += quarantined - rep.seen_quarantined
+                    rep.seen_completed = completed
+                    rep.seen_quarantined = quarantined
+                    if rep.quarantine_streak >= self.quarantine_streak_limit:
+                        self.drain(rep, reason="quarantine_storm")
+            if rep.state == ReplicaState.DRAINING:
+                if not sched.has_work():
+                    self._replace(rep, reason="drained")
+                elif (
+                    rep.drain_started is not None
+                    and now - rep.drain_started >= self.drain_timeout_s
+                ):
+                    # rescue the never-admitted (and breaker-held)
+                    # queue onto healthy replicas, then RETIRE rather
+                    # than tear down: slot-resident streams keep
+                    # finishing on their (possibly wedged) engine —
+                    # completed normally or deadline-reaped by its
+                    # watchdog, never aborted by the replacement
+                    stolen = sched.steal_queue()
+                    if stolen:
+                        self._place(stolen)
+                    self._replace(rep, reason="drain_timeout", retire=True)
+            elif rep.state == ReplicaState.DEAD and self.auto_replace:
+                self._replace(rep, reason="failover")
+        self._expire_pending(now)
+        self._drain_pending()
+
+    def _sweep_retiring(self) -> None:
+        """Tear down retired replicas once their residents are gone
+        (finished, failed over, or deadline-reaped). The teardown then
+        joins an idle loop thread — it can no longer abort live work
+        or block the monitor on a wedged device call."""
+        with self._lock:
+            retiring = list(self._retiring)
+        for rep in retiring:
+            if rep.scheduler.has_work():
+                continue
+            self._teardown(rep)
+            with self._lock:
+                if rep in self._retiring:
+                    self._retiring.remove(rep)
+
+    def _replace(self, old: Replica, reason: str, retire: bool = False) -> None:
+        """Swap ``old`` for a fresh warmed replica. A failed spawn
+        (fleet.replica_spawn chaos, or a real factory error) is counted
+        and retried on the next check; ``max_spawn_retries`` consecutive
+        failures declare the fleet unable to replace — pending streams
+        fail typed instead of hanging forever. ``retire=True`` keeps the
+        old replica alive (out of the routing set) until its residents
+        finish — used by the drain timeout, where teardown would abort
+        live streams."""
+        try:
+            new = self._spawn()
+        except Exception as e:
+            self.fleet_stats.incr("spawn_failures")
+            self._spawn_fail_streak += 1
+            self.fleet_flight.record_event(
+                "spawn_failed", replacing=old.id, error=repr(e)[:200],
+                streak=self._spawn_fail_streak,
+            )
+            if self._spawn_fail_streak > self.max_spawn_retries:
+                self._fail_pending(EngineFailedError(
+                    f"fleet cannot spawn a replacement replica "
+                    f"({self._spawn_fail_streak} consecutive failures; "
+                    f"last: {e!r})"
+                ))
+            return
+        self._spawn_fail_streak = 0
+        with self._lock:
+            try:
+                idx = self.replicas.index(old)
+            except ValueError:
+                idx = None
+            if idx is None:
+                self.replicas.append(new)
+            else:
+                self.replicas[idx] = new
+        self.fleet_stats.incr("replaced")
+        self.fleet_flight.record_event(
+            "replace", old=old.id, new=new.id, reason=reason
+        )
+        if retire and old.scheduler.has_work():
+            old.state = ReplicaState.RETIRING
+            old.since = self.clock()
+            with self._lock:
+                self._retiring.append(old)
+        else:
+            self._teardown(old)
+        self._drain_pending()
+
+    def _fold_counters(self, counts: Dict[str, int]) -> None:
+        with self._lock:
+            for k, v in counts.items():
+                self._folded_counters[k] = self._folded_counters.get(k, 0) + v
+
+    def _folded_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._folded_counters)
+
+    def _teardown(self, replica: Replica) -> None:
+        replica.state = ReplicaState.DEAD
+        try:
+            self._fold_counters(replica.model.stats.counters())
+        except Exception:
+            pass
+        try:
+            # bounded join: teardown runs on the monitor thread, and a
+            # replica that somehow still wedges must not stall the
+            # whole fleet supervisor for scheduler.stop's default 30s
+            replica.model.scheduler.stop(drain=False, timeout=5.0)
+        except Exception:
+            pass  # a wedged replica's teardown must not take the fleet down
+
+    def _drain_pending(self) -> None:
+        with self._lock:
+            if not self._pending:
+                return
+            if not any(r.eligible() for r in self.replicas):
+                return
+            pending, self._pending = list(self._pending), deque()
+        self._place(pending)
+
+    def _expire_pending(self, now: float) -> None:
+        with self._lock:
+            keep: deque = deque()
+            expired: List[Request] = []
+            for req in self._pending:
+                if req.handle.done():
+                    continue
+                if req.cancelled or (
+                    req.deadline is not None and now >= req.deadline
+                ):
+                    expired.append(req)
+                else:
+                    keep.append(req)
+            self._pending = keep
+        for req in expired:
+            if req.cancelled:
+                err, outcome = ShuttingDownError("request cancelled"), "cancelled"
+            else:
+                err = DeadlineExceededError(
+                    "deadline expired while awaiting a replica"
+                )
+                outcome = "expired"
+            if req.handle._fail(err):
+                self._fold_counters({outcome: 1})
+
+    def _fail_pending(self, err: BaseException) -> None:
+        with self._lock:
+            pending, self._pending = list(self._pending), deque()
+        for req in pending:
+            if req.handle._fail(err):
+                self._fold_counters({"failed": 1})
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+            self._draining = False
+            self._stopped = False
+            reps = list(self.replicas)
+        for rep in reps:
+            rep.model.start()
+        self._monitor_stop.clear()
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._monitor_stop.wait(timeout=self.poll_s):
+            try:
+                self.check()
+            except Exception:
+                # the fleet supervisor must never die of a transient
+                # inspection race; missing one poll beats losing the
+                # drain/replace lifecycle for the process lifetime
+                pass
+
+    def stop(self, drain: bool = True) -> None:
+        """Graceful by default: every replica drains (finishes queued +
+        running work), then the monitor exits; pending fleet-level
+        requests fail typed."""
+        self._draining = True
+        try:
+            self._monitor_stop.set()
+            if self._monitor is not None:
+                self._monitor.join(timeout=5.0)
+                self._monitor = None
+            with self._lock:
+                members = list(self.replicas) + list(self._retiring)
+                self._retiring = []
+            for rep in members:
+                try:
+                    rep.model.stop(drain=drain)
+                except Exception:
+                    pass
+            self._fail_pending(ShuttingDownError("fleet stopped"))
+        finally:
+            self._draining = False
+            self._started = False
+            self._stopped = True
+
+    def step(self) -> bool:
+        """One synchronous fleet iteration (virtual-clock tests): step
+        every live replica's scheduler once, then run the supervisor's
+        check(). Returns True while any work remains in flight."""
+        did = False
+        with self._lock:
+            members = list(self.replicas) + list(self._retiring)
+        for rep in members:
+            if rep.state != ReplicaState.DEAD:
+                did = rep.scheduler.step() or did
+        self.check()
+        return did or bool(self._pending)
+
+    def ready(self) -> bool:
+        return (
+            not self._draining
+            and not self._stopped
+            and any(r.eligible() for r in self._replicas_snapshot())
+        )
+
+    def has_work(self) -> bool:
+        with self._lock:
+            members = list(self.replicas) + list(self._retiring)
+        return bool(self._pending) or any(r.scheduler.has_work() for r in members)
+
+    # ------------------------------------------- GenerationModel surface
+    def _solo(self) -> Optional[GenerationModel]:
+        reps = self._replicas_snapshot()
+        return reps[0].model if len(reps) == 1 else None
+
+    @property
+    def breaker(self):
+        solo = self._solo()
+        return solo.breaker if solo is not None else _FleetBreakerView(self)
+
+    @property
+    def stats(self):
+        """n=1 parity: a never-failed-over single replica exposes its
+        own ServingStats (bit-identical surface to the bare
+        GenerationModel). Once ANY fleet lifecycle event happened —
+        failover, replacement — the per-replica counters no longer tell
+        the cumulative story, so even n=1 switches to the aggregate
+        view (replica counters + folded retired/pending counters)."""
+        solo = self._solo()
+        if solo is not None:
+            fs = self.fleet_stats
+            if fs.failovers == 0 and fs.replaced == 0 and not self._folded_snapshot():
+                return solo.stats
+        return _FleetAggregateStats(self)
+
+    @property
+    def trace_ring(self):
+        solo = self._solo()
+        return solo.trace_ring if solo is not None else _MergedTraceRing(self)
+
+    @property
+    def scheduler(self):
+        """The single replica's scheduler (n=1 parity); multi-replica
+        fleets have one scheduler PER replica — use ``replicas``."""
+        solo = self._solo()
+        if solo is None:
+            raise AttributeError(
+                "a multi-replica fleet has one scheduler per replica; "
+                "iterate fleet.replicas"
+            )
+        return solo.scheduler
+
+    @property
+    def engine(self):
+        solo = self._solo()
+        if solo is None:
+            raise AttributeError(
+                "a multi-replica fleet has one engine per replica; "
+                "iterate fleet.replicas"
+            )
+        return solo.engine
+
+    @property
+    def flight(self):
+        solo = self._solo()
+        return solo.flight if solo is not None else self.fleet_flight
+
+    @property
+    def capacity(self):
+        solo = self._solo()
+        return solo.capacity if solo is not None else None
+
+    @property
+    def slo(self):
+        solo = self._solo()
+        return solo.slo if solo is not None else None
+
+    def cache_report(self) -> Dict:
+        solo = self._solo()
+        if solo is not None:
+            return solo.cache_report()
+        return {r.id: r.model.cache_report() for r in self._replicas_snapshot()}
+
+    def readiness_rationale(self) -> Dict:
+        return {
+            "ready": self.ready(),
+            "fleet": True,
+            "replicas": {
+                r.id: {"state": r.state, **r.model.readiness_rationale()}
+                for r in self._replicas_snapshot()
+            },
+            "pending": len(self._pending),
+        }
+
+    sampling_from = staticmethod(GenerationModel.sampling_from)
+    speculation_from = staticmethod(GenerationModel.speculation_from)
+
+    def metadata(self) -> Dict:
+        reps = self._replicas_snapshot()
+        md = reps[0].model.metadata()
+        md["fleet"] = {
+            "replicas": len(reps),
+            "states": self.states(),
+            "auto_replace": self.auto_replace,
+            "drain_timeout_s": self.drain_timeout_s,
+        }
+        return md
+
+    # ----------------------------------------------------------- reports
+    def report(self) -> Dict:
+        """The ``GET /v2/fleet`` payload: per-replica state + router
+        score inputs + residency, fleet counters, router decisions, and
+        the recent lifecycle events (failovers, drains, replacements,
+        migrations)."""
+        reps = []
+        with self._lock:
+            members = list(self.replicas) + list(self._retiring)
+        for r in members:
+            s = r.scheduler
+            alloc = s.engine.allocator
+            rs = s.recovery_stats
+            reps.append({
+                "id": r.id,
+                "state": r.state,
+                "since": r.since,
+                "breaker": r.model.breaker.state,
+                "queue_depth": len(s._queue),
+                "running": len(s._running),
+                "blocks_free": alloc.num_free,
+                "blocks_total": alloc.num_total,
+                "watchdog_trips": rs.watchdog_trips,
+                "engine_failures": rs.engine_failures,
+                "recoveries": rs.recoveries,
+                "load_score": self.router.load_score(r),
+                "residency": [
+                    {
+                        "request_id": st.req.id,
+                        "generated": st.req.n_generated,
+                        "blocks": len(st.blocks),
+                    }
+                    for st in sorted(
+                        s._running.values(), key=lambda st: st.admitted_seq
+                    )
+                ],
+            })
+        out = {"name": self.name, "replicas": reps, "pending": len(self._pending)}
+        out.update(self.fleet_stats.snapshot())
+        out["recent_events"] = self.fleet_flight.snapshot(32)
+        return out
+
+    def prom_fleet(self) -> Dict:
+        """The ``fleets=`` input to obs.prom.render_prometheus: replica
+        states, lifecycle counters, and router decisions."""
+        fs = self.fleet_stats.snapshot()
+        return {
+            "states": self.states(),
+            "failovers_total": fs["failovers"],
+            "migrated_streams_total": fs["migrated_streams"],
+            "replaced_total": fs["replaced"],
+            "router_decisions": fs["router_decisions"],
+        }

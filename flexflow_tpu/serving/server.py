@@ -42,6 +42,12 @@ API. This server implements the same surface directly (stdlib only):
                                               with blame
   GET  /v2/slo                             -> per-model SLO objectives
                                               with fast/slow burn rates
+  GET  /v2/fleet                           -> fleet serving tier state:
+                                              replica lifecycle states,
+                                              residency, router score
+                                              inputs + decisions, and
+                                              recent failover / drain /
+                                              replace events
   GET  /v2/models/{name}                   -> model metadata
   GET  /v2/models/{name}/ready             -> per-model readiness
   POST /v2/models/{name}/infer             -> run inference
@@ -209,16 +215,60 @@ class InferenceServer:
     def _all_stats(self) -> Dict:
         """model name -> ServingStats across both serving paths (the
         /metrics scrape set). Snapshots the dicts: repository load/
-        unload mutates them concurrently."""
+        unload mutates them concurrently. Fleet generators contribute
+        one entry PER REPLICA under a ``(model, replica)`` key, so every
+        serving family renders with a ``replica`` label and Prometheus
+        aggregates across it."""
         out = {n: b.stats for n, b in list(self.batchers.items())}
-        out.update({n: g.stats for n, g in list(self.generators.items())})
+        for n, g in list(self.generators.items()):
+            reps = getattr(g, "replicas", None)
+            if reps is None:
+                out[n] = g.stats
+            else:
+                for r in list(reps):
+                    out[(n, r.id)] = r.model.stats
         return out
+
+    def _fleets(self) -> Dict:
+        """model name -> fleet lifecycle metrics (Fleet generators
+        only): replica states, failover/migration counters, router
+        decisions — the ``fleets=`` input to render_prometheus."""
+        return {
+            n: g.prom_fleet()
+            for n, g in list(self.generators.items())
+            if hasattr(g, "prom_fleet")
+        }
+
+    def _generation_units(self):
+        """(label, GenerationModel) pairs across all generators; a
+        fleet contributes one unit per replica, labeled
+        ``name/replica`` — the shared iteration for the per-engine
+        debug endpoints (traces, timeline, cache, programs,
+        predictions, slo)."""
+        for name, g in sorted(self.generators.items()):
+            reps = getattr(g, "replicas", None)
+            if reps is None:
+                yield name, g
+            else:
+                for r in list(reps):
+                    yield f"{name}/{r.id}", r.model
+
+    @staticmethod
+    def _unit_matches(label: str, model: Optional[str]) -> bool:
+        """``?model=`` filter: the plain name matches itself, a fleet
+        name matches all its replicas, and ``name/rN`` matches one."""
+        return (
+            model is None
+            or label == model
+            or label.split("/", 1)[0] == model
+        )
 
     def metrics_text(self) -> str:
         return render_prometheus(
             self._all_stats(),
             fault_sites=faults.site_counters(),
             ledger=GLOBAL_LEDGER,
+            fleets=self._fleets(),
         )
 
     def debug_traces(
@@ -230,9 +280,9 @@ class InferenceServer:
         """Recent finished request traces, most recent first, across the
         generation schedulers and the dynamic batchers."""
         rings = []
-        for name, g in list(self.generators.items()):
-            if model is None or name == model:
-                rings.append((name, g.trace_ring))
+        for label, unit in self._generation_units():
+            if self._unit_matches(label, model):
+                rings.append((label, unit.trace_ring))
         for name, b in list(self.batchers.items()):
             if model is None or name == model:
                 rings.append((name, b.trace_ring))
@@ -257,13 +307,13 @@ class InferenceServer:
         generation model), plus the recent incident snapshots under a
         non-standard ``incidents`` key chrome ignores."""
         events, incidents = [], []
-        for pid, (name, g) in enumerate(sorted(self.generators.items()), start=1):
-            if model is not None and name != model:
+        for pid, (label, unit) in enumerate(self._generation_units(), start=1):
+            if not self._unit_matches(label, model):
                 continue
-            trace = g.flight.to_chrome_trace(pid=pid, name=name)
+            trace = unit.flight.to_chrome_trace(pid=pid, name=label)
             events.extend(trace["traceEvents"])
             incidents.extend(
-                {**inc, "model": name} for inc in list(g.flight.incidents)
+                {**inc, "model": label} for inc in list(unit.flight.incidents)
             )
         return {
             "traceEvents": events,
@@ -276,9 +326,9 @@ class InferenceServer:
         table, fragmentation, watermarks, pressure, admission waits."""
         return {
             "models": {
-                name: g.cache_report()
-                for name, g in sorted(self.generators.items())
-                if model is None or name == model
+                label: unit.cache_report()
+                for label, unit in self._generation_units()
+                if self._unit_matches(label, model)
             }
         }
 
@@ -289,12 +339,12 @@ class InferenceServer:
         blame."""
         out: Dict = {
             "models": {
-                name: {
-                    "programs": g.programs.snapshot(),
-                    "retraces": g.programs.recent_retraces(),
+                label: {
+                    "programs": unit.programs.snapshot(),
+                    "retraces": unit.programs.recent_retraces(),
                 }
-                for name, g in sorted(self.generators.items())
-                if model is None or name == model
+                for label, unit in self._generation_units()
+                if self._unit_matches(label, model)
             }
         }
         if model is None:
@@ -311,9 +361,9 @@ class InferenceServer:
         calibration measurements, executor train programs)."""
         out: Dict = {
             "models": {
-                name: g.ledger.report()
-                for name, g in sorted(self.generators.items())
-                if model is None or name == model
+                label: unit.ledger.report()
+                for label, unit in self._generation_units()
+                if self._unit_matches(label, model)
             }
         }
         if model is None:
@@ -321,11 +371,23 @@ class InferenceServer:
         return out
 
     def slo_report(self) -> Dict:
-        """Per-model SLO objectives with multi-window burn rates."""
+        """Per-model SLO objectives with multi-window burn rates (one
+        entry per fleet replica)."""
         return {
             "models": {
-                name: g.slo.snapshot()
+                label: unit.slo.snapshot()
+                for label, unit in self._generation_units()
+            }
+        }
+
+    def fleet_report(self) -> Dict:
+        """GET /v2/fleet: per-fleet replica states, residency, router
+        score inputs + decisions, and recent lifecycle events."""
+        return {
+            "models": {
+                name: g.report()
                 for name, g in sorted(self.generators.items())
+                if hasattr(g, "replicas")
             }
         }
 
@@ -427,6 +489,8 @@ class InferenceServer:
                     ))
                 if path == "/v2/slo":
                     return self._json(200, server.slo_report())
+                if path == "/v2/fleet":
+                    return self._json(200, server.fleet_report())
                 if path == "/v2/models":
                     return self._json(
                         200,

@@ -1,0 +1,536 @@
+"""Fleet serving tier tests (ISSUE 8): cache-aware router placement
+properties, cross-replica journal-replay failover exactness, the
+drain/replace lifecycle, and single-replica parity.
+
+The core property under test is **failover exactness**: a stream whose
+replica dies mid-flight must journal-replay onto a survivor (or the
+replacement replica) and produce byte-identical tokens to a fault-free
+run — greedy, seeded temperature, and speculative, all riding ONE
+mixed batch through one forced failover. Everything runs on virtual
+clocks with synchronous ``fleet.step()`` driving; replica murders are
+deterministic scoped fault rules (``replica_kill``).
+
+Batch-of-one caveat the scenarios respect: a killed replica whose
+batch holds a single request quarantines it by bisection (PR 1's
+fail-the-request semantics — with one request, engine death and data
+poison are indistinguishable), so every failover scenario keeps >= 2
+residents on the murdered replica.
+
+Kept deliberately lean on fresh GenerationEngine objects (each one
+re-jits its whole program family): one shared reference engine, merged
+lifecycle scenarios.
+"""
+import jax
+import pytest
+
+from flexflow_tpu.generation import (
+    GenerationEngine,
+    RecoveryPolicy,
+    SamplingParams,
+    SpeculationConfig,
+    init_decoder_params,
+)
+from flexflow_tpu.models.transformer import TransformerConfig
+from flexflow_tpu.obs import render_prometheus, validate_exposition
+from flexflow_tpu.runtime import faults
+from flexflow_tpu.runtime.faults import FaultPlan, replica_kill
+from flexflow_tpu.serving import InferenceServer
+from flexflow_tpu.serving.fleet import Fleet, ReplicaState
+from flexflow_tpu.serving.generation import GenerationModel
+from flexflow_tpu.serving.resilience import (
+    CircuitOpenError,
+    DeadlineExceededError,
+    QueueFullError,
+    ShuttingDownError,
+)
+
+pytestmark = pytest.mark.fleet
+
+# 1 layer / tiny widths on purpose: every fresh replica re-jits its
+# whole program family, and the properties under test (routing,
+# journal-replay failover, lifecycle) are depth- and width-independent
+# — the smaller programs keep this file inside the tier-1 wall-clock
+# budget
+CFG = TransformerConfig(
+    num_layers=1, hidden_size=16, num_heads=2, ff_size=32,
+    seq_length=64, vocab_size=40, causal=True,
+)
+BUCKETS = (8, 32, 64)
+BLOCK = 8
+NO_SLEEP = RecoveryPolicy(sleep=lambda _s: None)
+TIGHT_BUDGET = RecoveryPolicy(max_restarts=1, sleep=lambda _s: None)
+
+from conftest import FakeClock  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def decoder_params():
+    return init_decoder_params(jax.random.key(0), CFG)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    assert faults.active_plan() is None, "a test leaked an installed FaultPlan"
+
+
+def make_factory(decoder_params, slots=3):
+    def factory():
+        return GenerationEngine(
+            decoder_params, CFG, max_batch_slots=slots, block_size=BLOCK,
+            prompt_buckets=BUCKETS,
+        )
+    return factory
+
+
+def make_fleet(decoder_params, n=2, *, recovery=NO_SLEEP, clock=None,
+               slots=3, **fleet_kwargs):
+    clock = clock or FakeClock()
+    kwargs = dict(fleet_kwargs.pop("scheduler_kwargs", {}))
+    kwargs.setdefault("recovery", recovery)
+    return Fleet(
+        make_factory(decoder_params, slots=slots), n, clock=clock,
+        scheduler_kwargs=kwargs, **fleet_kwargs,
+    )
+
+
+def drive(fleet, handles, steps=500):
+    for _ in range(steps):
+        if all(h.done() for h in handles):
+            return
+        fleet.step()
+
+
+_REF_ENGINE = None
+
+
+def solo_reference(decoder_params, prompts, samplings, speculation=None):
+    """Fault-free per-request reference streams on ONE shared bare
+    engine (batch composition never changes a request's tokens — PR 2's
+    guarantee — and a module-wide engine keeps the jit bill down)."""
+    global _REF_ENGINE
+    if _REF_ENGINE is None:
+        _REF_ENGINE = make_factory(decoder_params)()
+    return [
+        _REF_ENGINE.generate([list(p)], s, speculation=speculation)[0]
+        for p, s in zip(prompts, samplings)
+    ]
+
+
+PROMPTS = [[1, 2, 3], [4, 5, 6, 7], [9, 8, 7, 6, 5], [1, 2, 3, 4, 4]]
+GREEDY = SamplingParams(max_new_tokens=12)
+
+
+# ---------------------------------------------------------------------------
+# router placement properties (no stepping: engines never compile here)
+# ---------------------------------------------------------------------------
+
+
+def test_router_prefix_affinity_wins_ties(decoder_params):
+    fleet = make_fleet(decoder_params, n=2, warmup=False)
+    fleet.submit([7, 7, 7, 1], GREEDY)   # empty fleet -> least id (r0)
+    fleet.submit([5, 5, 5, 2], GREEDY)   # skew 1 vs 0 -> r1
+    # loads tied again (1, 1): the shared-prefix prompt must follow its
+    # prefix to r1, not fall back to replica order
+    fleet.submit([5, 5, 5, 9, 9], GREEDY)
+    r0, r1 = fleet.replicas
+    assert [r.id for r in (r0, r1)] == ["r0", "r1"]
+    assert len(r0.scheduler._queue) == 1
+    assert len(r1.scheduler._queue) == 2
+    assert fleet.fleet_stats.decisions()["affinity"] == 1
+    assert fleet.fleet_stats.decisions()["least_loaded"] == 2
+
+
+def test_router_least_loaded_under_skew(decoder_params):
+    """Affinity only breaks ties: a loaded replica loses the request
+    even when it holds the prompt's whole prefix."""
+    fleet = make_fleet(decoder_params, n=2, warmup=False)
+    fleet.submit([3, 3, 3, 1], GREEDY)   # -> r0
+    # loads now (1, 0): the skew beats r0's perfect prefix affinity
+    fleet.submit([3, 3, 3, 2], GREEDY)   # -> r1
+    r0, r1 = fleet.replicas
+    assert len(r0.scheduler._queue) == 1
+    assert len(r1.scheduler._queue) == 1
+    assert fleet.fleet_stats.decisions()["least_loaded"] == 2
+    assert "affinity" not in fleet.fleet_stats.decisions()
+
+
+def test_router_never_places_on_draining_or_open(decoder_params):
+    fleet = make_fleet(decoder_params, n=2, warmup=False)
+    r0, r1 = fleet.replicas
+    fleet.drain(r0, reason="test")
+    for _ in range(3):
+        fleet.submit([1, 2, 3], GREEDY)
+    assert len(r0.scheduler._queue) == 0
+    assert len(r1.scheduler._queue) == 3
+    # breaker-OPEN excludes the survivor too: total brownout is a typed
+    # CircuitOpenError, counted as a router decision
+    r1.model.breaker.trip()
+    with pytest.raises(CircuitOpenError):
+        fleet.submit([1, 2, 3], GREEDY)
+    assert fleet.fleet_stats.decisions()["no_candidate"] == 1
+    assert fleet.fleet_stats.decisions()["only_candidate"] == 3
+
+
+# ---------------------------------------------------------------------------
+# cross-replica journal-replay failover exactness
+# ---------------------------------------------------------------------------
+
+
+def test_failover_mixed_streams_exact(decoder_params):
+    """THE chaos-certification property: murdering a replica mid-stream
+    (persistent step crashes exhaust its restart budget) journal-replays
+    its RUNNING streams onto the survivor byte-identically — greedy
+    (across a block boundary, 12 > BLOCK), seeded temperature, and
+    speculative, mixed in one batch. The kill covers both step kinds
+    (decode + verify) so the speculating batch dies too."""
+    spec = SpeculationConfig(k=3, method="ngram")
+    prompts = [
+        [1, 2, 3],                  # greedy            -> r0 (first)
+        [4, 5, 6, 7],               # greedy            -> r1 (skew)
+        [1, 2, 3, 8],               # temp, affinity p0  -> r0 (tie)
+        [9, 8, 7, 6, 5],            # temp              -> r1 (skew)
+        [1, 2, 3, 8, 8],            # spec, affinity     -> r0 (tie)
+    ]
+    samp = [
+        GREEDY,
+        GREEDY,
+        SamplingParams(max_new_tokens=10, temperature=0.8, top_k=10, seed=42),
+        SamplingParams(max_new_tokens=10, temperature=0.7, top_k=8, seed=7),
+        SamplingParams(max_new_tokens=10),
+    ]
+    specs = [None, None, None, None, spec]
+    ref = [
+        solo_reference(decoder_params, [p], [s], speculation=sp)[0]
+        for p, s, sp in zip(prompts, samp, specs)
+    ]
+    fleet = make_fleet(decoder_params, n=2, recovery=TIGHT_BUDGET)
+    plan = FaultPlan(seed=0)
+    replica_kill(plan, "r0", every=1)
+    replica_kill(plan, "r0", site="generation.verify", every=1)
+    with plan.active():
+        handles = [
+            fleet.submit(p, s, speculation=sp)
+            for p, s, sp in zip(prompts, samp, specs)
+        ]
+        # placement as designed: r0 holds 3 streams (restart, not
+        # batch-of-one quarantine), r1 holds 2
+        r0 = fleet.replicas[0]
+        assert r0.id == "r0" and len(r0.scheduler._queue) == 3
+        drive(fleet, handles)
+    assert [h.result(timeout=0) for h in handles] == ref
+    fs = fleet.fleet_stats.snapshot()
+    assert fs["failovers"] == 1
+    assert fs["migrated_streams"] == 3
+    assert fs["replaced"] == 1
+    # every migrated stream rode at least one replay (the in-budget
+    # same-engine restart may have replayed it once already); the
+    # survivor's streams were never touched
+    assert all(h._request.replays >= 1 for h in handles[::2])
+    assert all(h._request.replays == 0 for h in (handles[1], handles[3]))
+    # the dead replica was swapped for a fresh warmed one
+    assert fleet.states() == {"active": 2, "draining": 0, "dead": 0}
+    assert "r0" not in [r.id for r in fleet.replicas]
+    for r in fleet.replicas:
+        assert r.engine.allocator.num_free == r.engine.allocator.num_total
+
+
+def test_held_queue_survives_full_replacement(decoder_params):
+    """n=1: the dead replica's RUNNING and HELD requests wait in the
+    fleet pending queue, survive a chaos-failed first spawn attempt,
+    ride onto the eventually-warmed replacement, and complete
+    byte-identically — nothing is failed, nothing hangs. The
+    replacement then serves fresh traffic with ZERO steady-state
+    retraces (warmup compiled its fixed-shape decode before traffic)."""
+    samp = [GREEDY] * len(PROMPTS)
+    ref = solo_reference(decoder_params, PROMPTS, samp)
+    fleet = make_fleet(decoder_params, n=1, recovery=TIGHT_BUDGET)
+    plan = FaultPlan(seed=0)
+    replica_kill(plan, "r0", every=1)
+    plan.on("fleet.replica_spawn", mode="error",
+            error=RuntimeError("spawn infra down"), nth=(0,))
+    with plan.active():
+        handles = [fleet.submit(p, s) for p, s in zip(PROMPTS, samp)]
+        drive(fleet, handles)
+    assert [h.result(timeout=0) for h in handles] == ref
+    fs = fleet.fleet_stats.snapshot()
+    assert fs["failovers"] == 1 and fs["replaced"] == 1
+    assert fs["spawn_failures"] == 1  # first spawn died, retry succeeded
+    assert fs["migrated_streams"] == len(PROMPTS)
+    # the chaos-failed first spawn consumed id r1; the replacement is r2
+    assert [r.id for r in fleet.replicas] == ["r2"]
+    # fresh traffic on the replacement: no program may retrace
+    new_engine = fleet.replicas[0].engine
+    h2 = fleet.submit([2, 4, 6], GREEDY)
+    drive(fleet, [h2])
+    assert h2.done()
+    assert new_engine.recompiles() == {}
+    assert new_engine.trace_counts["decode"] == 1
+
+
+def test_pending_deadline_expires_without_replica(decoder_params):
+    """Streams waiting in the fleet pending queue (no replica to adopt
+    them: auto_replace off) still honor their deadlines, typed."""
+    clock = FakeClock()
+    fleet = make_fleet(
+        decoder_params, n=1, recovery=TIGHT_BUDGET, clock=clock,
+        auto_replace=False, warmup=False,
+    )
+    plan = FaultPlan(seed=0)
+    replica_kill(plan, "r0", every=1)
+    with plan.active():
+        h1 = fleet.submit(PROMPTS[0], GREEDY, deadline_s=30.0)
+        h2 = fleet.submit(PROMPTS[1], GREEDY, deadline_s=30.0)
+        for _ in range(40):
+            fleet.step()
+    assert not h1.done() and not h2.done()
+    assert len(fleet._pending) == 2
+    assert fleet.fleet_stats.snapshot()["failovers"] == 1
+    clock.advance(31.0)
+    fleet.check()
+    for h in (h1, h2):
+        with pytest.raises(DeadlineExceededError):
+            h.result(timeout=0)
+    assert len(fleet._pending) == 0
+    # fleet-level deaths stay on the books: after a failover the n=1
+    # stats view is the cumulative aggregate, and the pending expiries
+    # count as expired even though no replica ever failed them
+    snap = fleet.stats.snapshot()
+    assert snap["admitted"] == 2 and snap["expired"] == 2
+
+
+# ---------------------------------------------------------------------------
+# drain / replace lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_drain_completes_residents_then_replaces(decoder_params):
+    """The fleet supervisor edge-detects a replica's watchdog trip into
+    a drain: the replica takes no new traffic but keeps stepping its
+    residents to completion on its OWN engine (no migration, no
+    restart); only once idle is it retired and replaced by a fresh
+    warmed replica."""
+    ref = solo_reference(decoder_params, PROMPTS[:2], [GREEDY] * 2)
+    fleet = make_fleet(decoder_params, n=2)
+    h_resident = fleet.submit(PROMPTS[0], GREEDY)   # -> r0
+    fleet.step()  # admit + first token on r0
+    r0 = next(r for r in fleet.replicas if r.scheduler.has_work())
+    old_engine = r0.engine
+    # the health signal the watchdog thread would have written
+    r0.scheduler.recovery_stats.incr("watchdog_trips")
+    fleet.check()
+    assert r0.state == ReplicaState.DRAINING
+    assert fleet.fleet_stats.snapshot()["drains"] == 1
+    h_new = fleet.submit(PROMPTS[1], GREEDY)  # must avoid the draining r0
+    survivor = next(r for r in fleet.replicas if r is not r0)
+    assert survivor.scheduler.has_work() or len(survivor.scheduler._queue) == 1
+    drive(fleet, [h_resident, h_new])
+    # the resident finished on its original engine, exactly, untouched
+    assert h_resident.result(timeout=0) == ref[0]
+    assert h_new.result(timeout=0) == ref[1]
+    assert old_engine.resets == 0              # drain is not a crash
+    assert h_resident._request.replays == 0    # ... and not a migration
+    fs = fleet.fleet_stats.snapshot()
+    assert fs["replaced"] == 1 and fs["failovers"] == 0
+    assert r0 not in fleet.replicas
+    assert fleet.states()["active"] == 2
+    # the replacement came up warm: fixed-shape decode compiled exactly
+    # once, before traffic
+    new = fleet.replicas[0] if fleet.replicas[0] is not survivor else fleet.replicas[1]
+    assert new.engine.trace_counts.get("decode") == 1
+    assert new.engine.recompiles() == {}
+
+
+def test_breaker_open_drains_and_rescues_held_queue(decoder_params):
+    """PR 1's third health signal: a breaker held OPEN (observed on two
+    consecutive checks) drains the replica; at drain timeout its
+    never-admitted, breaker-held queue is stolen onto a healthy
+    survivor before the teardown could fail it."""
+    clock = FakeClock()
+    fleet = make_fleet(decoder_params, n=2, warmup=False, clock=clock,
+                       drain_timeout_s=10.0)
+    r0, r1 = fleet.replicas
+    h = fleet.submit(PROMPTS[0], GREEDY)   # queued on r0, never admitted
+    assert len(r0.scheduler._queue) == 1
+    r0.model.breaker.trip()
+    fleet.check()
+    assert r0.state == ReplicaState.ACTIVE  # one observation: no thrash
+    fleet.check()
+    assert r0.state == ReplicaState.DRAINING
+    assert fleet.fleet_stats.snapshot()["drains"] == 1
+    # the held queue cannot drain (admission is breaker-gated): at the
+    # drain timeout it is rescued onto r1 and r0 is replaced
+    clock.advance(11.0)
+    fleet.check()
+    assert not h.done()
+    assert len(r1.scheduler._queue) == 1
+    assert r0 not in fleet.replicas
+    fs = fleet.fleet_stats.snapshot()
+    assert fs["replaced"] == 1 and fs["migrated_streams"] == 1
+    assert fs["failovers"] == 0  # a held queue is a rescue, not a failover
+
+
+def test_drain_timeout_retires_without_aborting_residents(decoder_params):
+    """A drain that times out with a live resident must not abort it:
+    the replica leaves the routing set (replaced) but keeps stepping as
+    RETIRING until the stream completes byte-exactly, and only then is
+    it torn down."""
+    clock = FakeClock()
+    fleet = make_fleet(decoder_params, n=2, warmup=False, clock=clock,
+                       drain_timeout_s=5.0)
+    ref = solo_reference(decoder_params, PROMPTS[:1], [GREEDY])
+    h = fleet.submit(PROMPTS[0], GREEDY)
+    fleet.step()  # admit on r0
+    r0 = next(r for r in fleet.replicas if r.scheduler.has_work())
+    fleet.drain(r0, reason="test")
+    clock.advance(6.0)
+    fleet.check()  # drain timeout: replaced, but the resident lives on
+    assert r0 not in fleet.replicas
+    assert r0.state == ReplicaState.RETIRING
+    assert fleet.states()["retiring"] == 1
+    assert not h.done()  # NOT aborted with ShuttingDownError
+    drive(fleet, [h])    # retiring replicas keep stepping
+    assert h.result(timeout=0) == ref[0]
+    fleet.check()        # idle now: swept and torn down
+    assert r0 not in fleet._retiring
+    assert r0.state == ReplicaState.DEAD
+    assert fleet.fleet_stats.snapshot()["replaced"] == 1
+
+
+def test_quarantine_storm_drains_replica(decoder_params):
+    """A replica quarantining stream after stream (with no completion
+    in between) slips past the consecutive-failure breaker — each
+    successful prefill resets its count — so the fleet supervisor
+    drains it on the quarantine streak instead; a completion resets
+    the streak."""
+    fleet = make_fleet(decoder_params, n=2, warmup=False)
+    r0 = fleet.replicas[0]
+    # two quarantines, then a completed request: streak resets
+    r0.scheduler.recovery_stats.incr("quarantined", 2)
+    fleet.check()
+    assert r0.state == ReplicaState.ACTIVE
+    r0.scheduler.stats.incr("completed")
+    r0.scheduler.recovery_stats.incr("quarantined", 2)
+    fleet.check()
+    assert r0.state == ReplicaState.ACTIVE  # 2 < limit after the reset
+    # a third consecutive quarantine crosses the limit: the idle
+    # replica drains and is replaced within the same inspection
+    r0.scheduler.recovery_stats.incr("quarantined")
+    fleet.check()
+    assert r0 not in fleet.replicas
+    fs = fleet.fleet_stats.snapshot()
+    assert fs["drains"] == 1 and fs["replaced"] == 1
+
+
+# ---------------------------------------------------------------------------
+# single-replica parity
+# ---------------------------------------------------------------------------
+
+
+def test_single_replica_parity(decoder_params):
+    """Fleet(n=1) is a drop-in for the bare GenerationModel: identical
+    stats keys, identical typed errors, zero extra retraces."""
+    bare = GenerationModel(
+        make_factory(decoder_params)(), name="solo",
+        recovery=NO_SLEEP, clock=FakeClock(), max_queue=4,
+    )
+    fleet = make_fleet(
+        decoder_params, n=1,
+        scheduler_kwargs=dict(max_queue=4),
+    )
+    ref = solo_reference(decoder_params, PROMPTS[:1], [GREEDY])
+
+    hb = bare.submit(PROMPTS[0], GREEDY)
+    while not hb.done() and bare.scheduler.step():
+        pass
+    hf = fleet.submit(PROMPTS[0], GREEDY)
+    drive(fleet, [hf])
+    assert hb.result(timeout=0) == hf.result(timeout=0) == ref[0]
+
+    # same stats surface (the fleet's n=1 stats IS a replica's
+    # ServingStats — no fleet gauges leak into the bare snapshot shape)
+    assert set(bare.stats.snapshot()) == set(fleet.stats.snapshot())
+
+    # same typed rejections
+    for model in (bare, fleet):
+        with pytest.raises(ValueError):
+            model.submit([1] * 100, GREEDY)
+        with pytest.raises(DeadlineExceededError):
+            model.submit(PROMPTS[0], GREEDY, deadline_s=-1.0)
+    for _ in range(4):
+        bare.submit(PROMPTS[0], GREEDY)
+        fleet.submit(PROMPTS[0], GREEDY)
+    with pytest.raises(QueueFullError):
+        bare.submit(PROMPTS[0], GREEDY)
+    with pytest.raises(QueueFullError):
+        fleet.submit(PROMPTS[0], GREEDY)
+
+    # zero extra retraces from routing / fleet telemetry
+    assert fleet.replicas[0].engine.recompiles() == {}
+    assert bare.engine.recompiles() == {}
+
+    bare.stop(drain=False)
+    fleet.stop(drain=False)
+    with pytest.raises(ShuttingDownError):
+        bare.submit(PROMPTS[0], GREEDY)
+    with pytest.raises(ShuttingDownError):
+        fleet.submit(PROMPTS[0], GREEDY)
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_prometheus_and_reports(decoder_params):
+    """Per-replica serving families carry the replica label; the fleet
+    families (replicas-by-state, failovers, migrations, router
+    decisions) render and the exposition stays structurally valid."""
+    fleet = make_fleet(decoder_params, n=2, warmup=False, name="gen")
+    for p in PROMPTS[:3]:
+        fleet.submit(p, GREEDY)
+    models = {("gen", r.id): r.model.stats for r in fleet.replicas}
+    text = render_prometheus(models, fleets={"gen": fleet.prom_fleet()})
+    assert not validate_exposition(text)
+    assert 'flexflow_serving_requests_total{model="gen",replica="r0",outcome="admitted"}' in text
+    assert 'flexflow_serving_fleet_replicas{model="gen",state="active"} 2' in text
+    assert 'flexflow_serving_fleet_failovers_total{model="gen"} 0' in text
+    assert 'flexflow_serving_fleet_migrated_streams_total{model="gen"} 0' in text
+    assert 'flexflow_serving_router_decisions_total{model="gen",reason=' in text
+    # label escaping survives the replica label path
+    tricky = render_prometheus({("m\"x", "r\\0"): fleet.replicas[0].model.stats})
+    assert not validate_exposition(tricky)
+    assert 'model="m\\"x",replica="r\\\\0"' in tricky
+
+    rep = fleet.report()
+    assert {r["id"] for r in rep["replicas"]} == {"r0", "r1"}
+    for row in rep["replicas"]:
+        assert {"state", "queue_depth", "running", "blocks_free",
+                "load_score", "breaker", "residency"} <= set(row)
+    assert "router_decisions" in rep and "recent_events" in rep
+
+
+def test_server_integration_fleet_endpoints(decoder_params):
+    """InferenceServer surfaces a registered fleet per replica: tuple
+    stats keys for /metrics, per-replica debug units, and the /v2/fleet
+    payload — no HTTP socket needed."""
+    fleet = make_fleet(decoder_params, n=2, warmup=False, name="gen")
+    server = InferenceServer(port=0)
+    server.register_generation(fleet)
+    stats = server._all_stats()
+    assert ("gen", "r0") in stats and ("gen", "r1") in stats
+    labels = [label for label, _ in server._generation_units()]
+    assert labels == ["gen/r0", "gen/r1"]
+    text = server.metrics_text()
+    assert not validate_exposition(text)
+    assert 'replica="r0"' in text and "flexflow_serving_fleet_replicas" in text
+    payload = server.fleet_report()
+    assert "gen" in payload["models"]
+    assert len(payload["models"]["gen"]["replicas"]) == 2
+    # readiness rides the fleet view: one tripped breaker degrades, two
+    # means the whole fleet (and so the server) goes not-ready
+    assert server.model_ready("gen")
+    fleet.replicas[0].model.breaker.trip()
+    assert server.model_ready("gen")
+    fleet.replicas[1].model.breaker.trip()
+    assert not server.model_ready("gen")

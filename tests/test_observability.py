@@ -255,13 +255,35 @@ def _golden_ledger():
     return led
 
 
+def _golden_replica_stats():
+    """A fleet replica's stats for the golden rendering: keyed by
+    (model, replica), so every serving family carries the replica
+    label (binary-exact values only)."""
+    s = ServingStats(latency_window=8)
+    s.incr("admitted", 2)
+    s.incr("completed", 2)
+    s.latency.record(0.25)
+    s.add_gauge("queue_depth", lambda: 1)
+    return s
+
+
+_GOLDEN_FLEET = {
+    "states": {"active": 1, "draining": 1, "dead": 0},
+    "failovers_total": 1,
+    "migrated_streams_total": 3,
+    "replaced_total": 1,
+    "router_decisions": {"affinity": 2, "least_loaded": 5},
+}
+
+
 def test_prometheus_golden_exposition():
     """The full exposition text is pinned: a metric rename breaks THIS
     test instead of everyone's dashboards."""
     text = render_prometheus(
-        {"lm": _golden_stats()},
+        {"lm": _golden_stats(), ("gen", "r0"): _golden_replica_stats()},
         fault_sites={"generation.decode_step": {"calls": 5, "fires": 1}},
         ledger=_golden_ledger(),
+        fleets={"gen": _GOLDEN_FLEET},
     )
     assert not validate_exposition(text)
     golden_path = os.path.join(os.path.dirname(__file__), "data", "prometheus_golden.txt")

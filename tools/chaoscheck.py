@@ -36,7 +36,24 @@ request mix re-run under each injected failure class —
                past its deadline, and the /v2/stats snapshot carries the
                recovery/quarantine counts
 
-Usage: python tools/chaoscheck.py [--sweep-only | --no-sweep]
+Part 3 (``--fleet``) is the **fleet sweep** (ISSUE 8): the same request
+mix against a live 2-replica Fleet —
+
+  replica crash  one replica's decode steps fail persistently
+                 (replica_kill, scoped) -> its restart budget exhausts
+                 and its RUNNING streams journal-replay onto the
+                 survivor byte-identically; the dead replica is
+                 replaced by a fresh warmed replica
+  wedged replica a decode step on one replica hangs on a gate -> ITS
+                 watchdog trips -> the fleet supervisor drains the
+                 replica (no new placements) while fresh traffic flows
+                 to the survivor; once unwedged the residents finish
+                 exactly and the replica is retired + replaced
+  brownout       one replica's breaker is OPEN -> the router places
+                 everything on the survivor (the fleet stays ready);
+                 nothing ever lands on the open replica
+
+Usage: python tools/chaoscheck.py [--sweep-only | --no-sweep] [--fleet]
                                   [extra pytest args]
 """
 import argparse
@@ -323,19 +340,172 @@ def run_recovery_sweep() -> bool:
     return not failures
 
 
+def run_fleet_sweep() -> bool:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO)
+
+    import jax  # noqa: F401
+
+    from flexflow_tpu.generation import (
+        GenerationEngine,
+        RecoveryPolicy,
+        SamplingParams,
+        WatchdogPolicy,
+        init_decoder_params,
+    )
+    from flexflow_tpu.models.transformer import TransformerConfig
+    from flexflow_tpu.runtime.faults import FaultPlan, replica_kill
+    from flexflow_tpu.serving.fleet import Fleet, ReplicaState
+
+    import jax as _jax
+
+    cfg = TransformerConfig(
+        num_layers=1, hidden_size=32, num_heads=4, ff_size=64,
+        seq_length=64, vocab_size=50, causal=True,
+    )
+    params = init_decoder_params(_jax.random.key(0), cfg)
+
+    def factory():
+        return GenerationEngine(
+            params, cfg, max_batch_slots=3, block_size=8,
+            prompt_buckets=(8, 32, 64),
+        )
+
+    prompts = [[1, 2, 3], [4, 5, 6, 7], [9, 8, 7, 6, 5], [1, 2, 3, 4, 4]]
+    sampling = SamplingParams(max_new_tokens=10)
+    tight = RecoveryPolicy(max_restarts=1, sleep=lambda _s: None)
+
+    # fault-free per-request reference on one bare engine (batch
+    # composition never changes a request's tokens)
+    ref_eng = factory()
+    ref = [ref_eng.generate([p], sampling)[0] for p in prompts]
+
+    report, failures = {}, []
+
+    def check(scenario, cond, msg):
+        if not cond:
+            failures.append(f"{scenario}: {msg}")
+
+    def drive(fleet, handles, steps=500):
+        for _ in range(steps):
+            if all(h.done() for h in handles):
+                return
+            fleet.step()
+
+    # -------------------------------------- replica crash -> failover
+    fleet = Fleet(factory, 2, scheduler_kwargs=dict(recovery=tight))
+    plan = FaultPlan(seed=0)
+    replica_kill(plan, "r0", every=1)
+    with plan.active():
+        handles = [fleet.submit(p, sampling) for p in prompts]
+        drive(fleet, handles)
+    got = [h.result(timeout=0) for h in handles]
+    fs = fleet.fleet_stats.snapshot()
+    check("crash", got == ref,
+          f"streams diverged across the failover: {got} != {ref}")
+    check("crash", fs["failovers"] == 1, f"failovers = {fs['failovers']}, want 1")
+    check("crash", fs["migrated_streams"] >= 1, "no stream migrated")
+    check("crash", fs["replaced"] == 1, "dead replica never replaced")
+    check("crash", "r0" not in [r.id for r in fleet.replicas],
+          "murdered replica still in the fleet")
+    check("crash", all(r.state == ReplicaState.ACTIVE for r in fleet.replicas),
+          "fleet not whole after replacement")
+    for r in fleet.replicas:
+        check("crash", r.engine.allocator.num_free == r.engine.allocator.num_total,
+              f"leaked blocks on {r.id}")
+    report["crash"] = {"failovers": fs["failovers"],
+                       "migrated_streams": fs["migrated_streams"],
+                       "replaced": fs["replaced"], "exact": got == ref}
+
+    # ----------------------------- wedged replica -> watchdog drain -> replace
+    # real clocks: replica loop threads + watchdog threads + the fleet
+    # monitor must cooperate while one decode hangs on the gate
+    fleet = Fleet(
+        factory, 2, poll_s=0.05,
+        scheduler_kwargs=dict(
+            recovery=RecoveryPolicy(sleep=lambda _s: None),
+            watchdog=WatchdogPolicy(stall_timeout_s=1.0, poll_s=0.05),
+        ),
+    )
+    gate = threading.Event()
+    plan = FaultPlan(seed=0)
+    replica_kill(plan, "r0", mode="stall", gate=gate, nth=(2,))
+    with plan.active():
+        fleet.start()
+        handles = [fleet.submit(p, sampling) for p in prompts]
+        t0 = time.monotonic()
+        while (fleet.fleet_stats.snapshot()["drains"] == 0
+               and time.monotonic() - t0 < 15):
+            time.sleep(0.02)
+        fs_mid = fleet.fleet_stats.snapshot()
+        still_ready = fleet.ready()
+        # fresh traffic during the wedge must route around the drain
+        h_during = fleet.submit([2, 4, 6], sampling)
+        gate.set()
+        got = [h.result(timeout=30) for h in handles]
+        h_during.result(timeout=30)
+        t0 = time.monotonic()
+        while (fleet.fleet_stats.snapshot()["replaced"] == 0
+               and time.monotonic() - t0 < 15):
+            time.sleep(0.02)
+    fs = fleet.fleet_stats.snapshot()
+    fleet.stop()
+    check("wedge", fs_mid["drains"] >= 1, "watchdog trip never drained the replica")
+    check("wedge", still_ready, "one wedged replica took fleet readiness down")
+    check("wedge", got == ref,
+          f"streams diverged across the wedge: {got} != {ref}")
+    check("wedge", fs["replaced"] >= 1, "drained replica never replaced")
+    check("wedge", fs["failovers"] == 0,
+          "a recoverable wedge must drain, not fail over")
+    report["wedge"] = {"drains": fs["drains"], "replaced": fs["replaced"],
+                       "exact": got == ref}
+
+    # --------------------------------------------- brownout (breaker OPEN)
+    fleet = Fleet(factory, 2, scheduler_kwargs=dict(recovery=tight))
+    r0, r1 = fleet.replicas
+    r0.model.breaker.trip()
+    brown_ready = fleet.ready()
+    handles = [fleet.submit(p, sampling) for p in prompts]
+    placed_on_open = len(r0.scheduler._queue) + len(r0.scheduler._running)
+    drive(fleet, handles)
+    got = [h.result(timeout=0) for h in handles]
+    fs = fleet.fleet_stats.snapshot()
+    check("brownout", brown_ready, "fleet went not-ready with a healthy survivor")
+    check("brownout", placed_on_open == 0,
+          f"{placed_on_open} request(s) placed on the breaker-OPEN replica")
+    check("brownout", got == ref, "streams diverged during the brownout")
+    check("brownout", fs["router_decisions"].get("only_candidate", 0) >= len(prompts),
+          f"router decisions missing only_candidate: {fs['router_decisions']}")
+    report["brownout"] = {"router_decisions": fs["router_decisions"],
+                          "exact": got == ref}
+
+    report["ok"] = not failures
+    print(json.dumps({"fleet_sweep": report}, indent=2))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print("OK: fleet sweep — replica crash failed over byte-exactly, the "
+              "wedged replica drained + got replaced, and the brownout routed "
+              "around the open breaker")
+    return not failures
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--sweep-only", action="store_true",
-                    help="skip pytest; run only the generation-recovery sweep")
+                    help="skip pytest; run only the in-process sweeps")
     ap.add_argument("--no-sweep", action="store_true",
                     help="run only the pytest chaos/recovery suites")
+    ap.add_argument("--fleet", action="store_true",
+                    help="also run the live fleet sweep (crash-failover, "
+                         "watchdog drain/replace, router brownout)")
     args, pytest_args = ap.parse_known_args()
 
     rc = 0
     if not args.sweep_only:
         cmd = [
             sys.executable, "-m", "pytest", "tests", "-q",
-            "-m", "chaos or recovery",
+            "-m", "chaos or recovery or fleet",
             "-p", "no:cacheprovider",
             *pytest_args,
         ]
@@ -343,6 +513,9 @@ def main() -> int:
         rc = subprocess.call(cmd, cwd=REPO, env=env)
     if not args.no_sweep and rc == 0:
         if not run_recovery_sweep():
+            rc = 1
+    if args.fleet and rc == 0:
+        if not run_fleet_sweep():
             rc = 1
     return rc
 
