@@ -1,0 +1,55 @@
+"""flexlint policy: which files are exempt from which rule, and why.
+
+This file is the reviewed, centralized counterpart to inline
+``# flexlint: disable=`` comments: inline suppressions are for single
+statements; entries here are for whole files whose PURPOSE exempts them
+(a calibration harness exists to measure physical wall time). Every
+entry carries its reason so a reviewer can re-litigate it.
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Union
+
+# --------------------------------------------------------------- clocks
+# Wall-clock whitelist for the clock-discipline rule. Keys are
+# repo-relative paths (a trailing "/" whitelists the directory); values
+# are "*" (any of time.time / time.monotonic / time.perf_counter) or
+# the frozenset of allowed function names. Everything else must take an
+# injectable clock so virtual-clock tests control time.
+CLOCK_WHITELIST: Dict[str, Union[str, FrozenSet[str]]] = {
+    # Offline bench/diagnostic harnesses: measuring physical wall time
+    # is their job (genbench/perfwatch/chaoscheck/obsreport/calib_debug
+    # / mfu_profile / tpu_evidence), and their watchdog waits bound
+    # real blocking calls.
+    "tools/": "*",
+    # Kernel calibration measures device wall time by definition.
+    "flexflow_tpu/search/calibration.py": "*",
+    # The op profiler is a physical-time measurement instrument.
+    "flexflow_tpu/runtime/profiling.py": "*",
+    # PR 6 dual-stamp decision: device-step phase DURATIONS are
+    # physical profiling data (perf_counter) even in virtual-clock
+    # tests; scheduler-plane timestamps still ride the injectable
+    # clock. Only perf_counter is exempt — time.time/monotonic in these
+    # files is still a violation.
+    "flexflow_tpu/generation/engine.py": frozenset({"perf_counter"}),
+    "flexflow_tpu/generation/scheduler.py": frozenset({"perf_counter"}),
+    "flexflow_tpu/runtime/executor.py": frozenset({"perf_counter"}),
+}
+
+# ----------------------------------------------------------- fault sites
+# Files the fault-site rule does not police: the registry itself (it
+# DEFINES the literals) and this analysis package (rule fixtures).
+SITE_RULE_EXCLUDE = (
+    "flexflow_tpu/runtime/faults.py",
+    "flexflow_tpu/analysis/",
+)
+
+# Site literals must start with one of these segments to be treated as
+# fault-site names when passed to FaultPlan.on(...) (tests register
+# synthetic sites like "site.a"; those live under tests/ which is not
+# scanned, but the prefix filter also keeps .on(...) of unrelated APIs
+# out of this rule's jurisdiction).
+SITE_PREFIXES = (
+    "executor.", "elastic.", "checkpoint.", "serving.", "generation.",
+    "fleet.",
+)

@@ -337,7 +337,7 @@ class GenerationEngine:
         """Prefill one sequence into its allocated blocks and sample its
         first generated token. ``block_table`` is the sequence's block
         ids (padded internally to the engine's fixed table width)."""
-        faults.inject("generation.prefill", prompt)
+        faults.inject(faults.GENERATION_PREFILL, prompt)
         self.step_counts["prefill"] += 1
         t0 = time.perf_counter()
         n = len(prompt)
@@ -406,7 +406,7 @@ class GenerationEngine:
         the call ``last_finite[i]`` says whether slot i's logits were
         finite — the supervisor's per-slot NaN blame vector."""
         masked = np.where(active, tokens, 0).astype(np.int32)
-        masked, bias = faults.inject("generation.decode_step", (masked, self._zero_bias))
+        masked, bias = faults.inject(faults.GENERATION_DECODE_STEP, (masked, self._zero_bias))
         self.step_counts["decode"] += 1
         t0 = time.perf_counter()
         traces_before = self.trace_counts.get("decode", 0)
@@ -489,7 +489,7 @@ class GenerationEngine:
         adaptive k only changes ``n_draft`` values, never the shape.
         """
         window = window_tokens.astype(np.int32)
-        window, bias = faults.inject("generation.verify", (window, self._zero_bias))
+        window, bias = faults.inject(faults.GENERATION_VERIFY, (window, self._zero_bias))
         self.step_counts["verify"] += 1
         # useful verify work: per live slot, n_draft+1 window tokens;
         # window token j at position start+j attends to start+j+1 live

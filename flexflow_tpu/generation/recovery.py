@@ -374,7 +374,7 @@ class EngineSupervisor:
                 # visible to the watchdog (deadline reaping keeps running
                 # and a fresh trip is flagged for this section's seq)
                 with sched._stamped():
-                    faults.inject("generation.journal_replay", sched.journal.entries())
+                    faults.inject(faults.GENERATION_JOURNAL_REPLAY, sched.journal.entries())
                     sched.engine.reset()
                     sched._rebuild_from_journal()
             except Exception as e:  # double fault: burn another budget unit

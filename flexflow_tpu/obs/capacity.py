@@ -416,10 +416,15 @@ class ProgramRegistry:
     callback are swallowed: a logging hook must never break tracing.
     """
 
-    def __init__(self, max_retraces: int = 64):
+    def __init__(self, max_retraces: int = 64,
+                 clock: Callable[[], float] = time.time):
+        # injectable epoch clock for retrace-record stamps (wall time is
+        # the right default — operators correlate retraces with logs —
+        # but virtual-clock tests must be able to pin it)
+        self._clock = clock
         self._lock = threading.Lock()
-        self.entries: Dict[str, ProgramEntry] = {}
-        self.retraces: deque = deque(maxlen=max_retraces)
+        self.entries: Dict[str, ProgramEntry] = {}  # guarded-by: _lock
+        self.retraces: deque = deque(maxlen=max_retraces)  # guarded-by: _lock
         self.on_retrace: Optional[Callable[[str, str], None]] = None
 
     def note_trace(self, name: str, args: Dict[str, object]) -> Optional[str]:
@@ -450,7 +455,7 @@ class ProgramRegistry:
             entry.signature = sig
             entry.last_blame = blame
             self.retraces.append({
-                "t": time.time(),
+                "t": self._clock(),
                 "program": name,
                 "blame": blame,
                 "traces": entry.traces,

@@ -63,13 +63,14 @@ class FlightRecorder:
         self.sched_clock = sched_clock
         self.incident_window = incident_window
         self._lock = threading.Lock()
-        self._ring: deque = deque(maxlen=self.capacity)
-        self._seq = 0
-        self.incidents: deque = deque(maxlen=max(1, max_incidents))
+        self._ring: deque = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self.incidents: deque = deque(maxlen=max(1, max_incidents))  # guarded-by: _lock
 
     @property
     def seq(self) -> int:
-        return self._seq
+        with self._lock:
+            return self._seq
 
     # ------------------------------------------------------------ recording
     def record_step(
@@ -110,18 +111,27 @@ class FlightRecorder:
         marker_seq = self.record_event("incident:" + kind, **fields)
         with self._lock:
             records = list(self._ring)[-self.incident_window:]
-        snap = {
-            "kind": kind,
-            "t": self.clock(),
-            **({"t_sched": self.sched_clock()} if self.sched_clock is not None else {}),
-            "seq": marker_seq,
-            **fields,
-            "records": records,
-        }
-        self.incidents.append(snap)
+            snap = {
+                "kind": kind,
+                "t": self.clock(),
+                **({"t_sched": self.sched_clock()} if self.sched_clock is not None else {}),
+                "seq": marker_seq,
+                **fields,
+                "records": records,
+            }
+            # append under the same lock: a scrape thread listing
+            # incidents mid-append must not race the supervisor
+            self.incidents.append(snap)
         return snap
 
     # ------------------------------------------------------------ snapshots
+    def incident_snapshots(self) -> List[Dict]:
+        """Locked copy of the retained incident postmortems — the read
+        path for scrape threads (iterating the deque raw races a
+        supervisor appending mid-incident, exactly when it matters)."""
+        with self._lock:
+            return list(self.incidents)
+
     def snapshot(self, last: Optional[int] = None) -> List[Dict]:
         """Ring contents in order, oldest first (``last`` trims to the
         trailing N)."""

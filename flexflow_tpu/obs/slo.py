@@ -161,6 +161,7 @@ class SLOMonitor:
         # fault, and must not be able to burn the error budget
         self.availability_skip = frozenset(availability_skip)
         self._lock = threading.Lock()
+        # guarded-by: _lock
         self._windows: Dict[str, Dict[str, _BurnWindow]] = {
             o.name: {
                 "fast": _BurnWindow(fast_window_s, clock),
@@ -168,7 +169,7 @@ class SLOMonitor:
             }
             for o in self.objectives
         }
-        self.observed = 0  # cumulative requests folded in
+        self.observed = 0  # cumulative requests folded in; guarded-by: _lock
 
     # ------------------------------------------------------------ feeding
     def observe(
@@ -262,8 +263,10 @@ class SLOMonitor:
                 },
                 "breaching": obj.name in breaching,
             })
+        with self._lock:
+            observed = self.observed
         return {
-            "observed": self.observed,
+            "observed": observed,
             "healthy": not breaching,
             "breaching": sorted(breaching),
             "objectives": objectives,

@@ -72,7 +72,7 @@ class RequestTrace:
         self._lock = threading.Lock()
         # (t, name, fields-or-None); bounded so a 100k-token stream
         # cannot grow its trace without limit (progress events roll off)
-        self.events: deque = deque(maxlen=max_events)
+        self.events: deque = deque(maxlen=max_events)  # guarded-by: _lock
         self.prompt_len = 0
         self.t_accept: Optional[float] = None
         self.t_admit: Optional[float] = None
@@ -267,8 +267,8 @@ class TraceRing:
     def __init__(self, capacity: int = 256):
         self.capacity = max(1, capacity)
         self._lock = threading.Lock()
-        self._ring: deque = deque(maxlen=self.capacity)
-        self.total = 0  # cumulative adds (ring occupancy is bounded)
+        self._ring: deque = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self.total = 0  # cumulative adds (ring is bounded); guarded-by: _lock
 
     def __len__(self) -> int:
         with self._lock:

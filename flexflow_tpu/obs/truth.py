@@ -137,16 +137,16 @@ class PredictionLedger:
         self.max_entries = max_entries
         self.clock = clock
         self._lock = threading.Lock()
-        self._entries: Dict[str, _Entry] = {}
-        self._unpredicted: Dict[str, int] = {}
-        self.alarms: deque = deque(maxlen=max_alarms)
+        self._entries: Dict[str, _Entry] = {}  # guarded-by: _lock
+        self._unpredicted: Dict[str, int] = {}  # guarded-by: _lock
+        self.alarms: deque = deque(maxlen=max_alarms)  # guarded-by: _lock
         self.on_alarm: Optional[Callable[[Dict], None]] = None
-        self._next_id = 0
-        self.predictions_total = 0
-        self.pairs_total = 0
-        self.unpredicted_total = 0
-        self.alarms_total = 0
-        self._summary_cache: Optional[tuple] = None
+        self._next_id = 0  # guarded-by: _lock
+        self.predictions_total = 0  # guarded-by: _lock
+        self.pairs_total = 0  # guarded-by: _lock
+        self.unpredicted_total = 0  # guarded-by: _lock
+        self.alarms_total = 0  # guarded-by: _lock
+        self._summary_cache: Optional[tuple] = None  # guarded-by: _lock
 
     # ------------------------------------------------------------- predict
     def predict(
@@ -214,8 +214,13 @@ class PredictionLedger:
             self.pairs_total += 1
             entry.measured.append(measured_s)
             alarm = self._update_drift_locked(entry, measured_s)
+            if alarm is not None:
+                # same hold that bumped alarms_total: a report() can
+                # never see the counter ahead of the alarms list
+                self.alarms.append(alarm)
         if alarm is not None:
-            self.alarms.append(alarm)
+            # the callback runs OUTSIDE the lock — observers may
+            # re-enter the ledger
             cb = self.on_alarm
             if cb is not None:
                 try:

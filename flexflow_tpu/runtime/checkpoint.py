@@ -61,7 +61,7 @@ def save_checkpoint(path: str, executor, step: int = 0, strategy=None) -> None:
     """Write a checkpoint directory: orbax pytree + strategy.json."""
     from . import faults
 
-    faults.inject("checkpoint.save", path)  # chaos hook: storage failure
+    faults.inject(faults.CHECKPOINT_SAVE, path)  # chaos hook: storage failure
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
     fwd = _canon_map(executor)

@@ -129,7 +129,7 @@ class ElasticTrainer:
         step = 0
         while step < num_steps:
             try:
-                faults.inject("elastic.step", step)
+                faults.inject(faults.ELASTIC_STEP, step)
                 inputs, labels = batches(step)
                 # per-step rng (fit() splits per step the same way);
                 # folding the step index keeps replay deterministic

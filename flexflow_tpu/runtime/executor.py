@@ -734,7 +734,9 @@ class CompiledExecutor:
                 outs, new_state, aux = self._forward_impl(p, st, ins, r, training=True)
                 final = outs[-1]
                 loss = loss_fn(final, lab)
-                for a in aux:
+                # aux is a Python LIST of scalar aux losses — pytree
+                # structure iteration at trace time, not a traced array
+                for a in aux:  # flexlint: disable=jit-discipline
                     loss = loss + a
                 mets = metrics_mod.compute_metrics(metric_types, final, lab)
                 mets["loss"] = loss
@@ -786,9 +788,11 @@ class CompiledExecutor:
                 # every other metric key is a per-batch SUM
                 # (count/correct/*_loss, metrics.py:48-69)
                 def merge(k, v):
-                    if k == "loss":
+                    # k is a static metrics-dict KEY (a Python str at
+                    # trace time), not a traced value
+                    if k == "loss":  # flexlint: disable=jit-discipline
                         return jnp.mean(v)
-                    if k == "rmse_loss":
+                    if k == "rmse_loss":  # flexlint: disable=jit-discipline
                         return jnp.sqrt(jnp.mean(jnp.square(v / mb))) * b
                     return jnp.sum(v)
 
@@ -927,7 +931,7 @@ class CompiledExecutor:
     def train_batch(self, inputs: Sequence[jax.Array], label: jax.Array, rng: jax.Array) -> Dict[str, Any]:
         # chaos hook (no-op unless a FaultPlan is installed): rules can
         # raise a device error, stall, or NaN-poison the batch
-        inputs = faults.inject("executor.train_batch", inputs)
+        inputs = faults.inject(faults.EXECUTOR_TRAIN_BATCH, inputs)
         inputs = self._shard_inputs(inputs)
         if jax.process_count() > 1:
             label = self.shard_label(label)
@@ -1114,7 +1118,7 @@ class CompiledExecutor:
             rng = jax.random.key(0)
         outs = self._forward(self.params, self.state, tuple(inputs), rng)
         # chaos hook: error / stall / NaN-poisoned outputs
-        return faults.inject("executor.predict", outs)
+        return faults.inject(faults.EXECUTOR_PREDICT, outs)
 
     def input_shardings(self):
         """(per-input NamedShardings, label sharding). Labels share the

@@ -19,32 +19,14 @@ Design constraints:
   ``hash()``). Call counting is per-site and lock-protected, so a given
   single-threaded call sequence always fires the same faults.
 
-Injection sites currently threaded through the codebase:
-
-  ``executor.train_batch``      before each train dispatch (value = inputs)
-  ``executor.predict``          around the forward outputs (value = outputs)
-  ``elastic.step``              top of each ElasticTrainer step
-  ``serving.model.infer``       before a served model's device call (value = inputs)
-  ``serving.batcher.dispatch``  before the batcher runs a device batch (value = requests)
-  ``serving.repository.load``   before a repository model load
-  ``checkpoint.save``           top of save_checkpoint
-  ``generation.prefill``        before a generation prefill (value = prompt tokens)
-  ``generation.decode_step``    before each batched decode step (value =
-                                ([B] slot tokens, [B] float32 logit bias); ``nan``
-                                mode poisons the bias, which the engine adds to
-                                the step's logits — per slot with ``select``)
-  ``generation.verify``         before each speculative verification step
-                                (value = ([B, k+1] window tokens, [B] float32
-                                logit bias), same nan-mode contract as decode)
-  ``generation.journal_replay`` top of each supervisor journal-replay engine
-                                restart (value = journal entries); an error here
-                                is a double fault consuming another restart
-                                budget unit (generation/recovery.py)
-  ``fleet.route``               before each fleet routing decision (value =
-                                (prompt tokens, candidate replica ids))
-  ``fleet.replica_spawn``       before a fleet replica is built/warmed (value =
-                                the new replica id); an error here is a failed
-                                replacement spawn (serving/fleet.py)
+Injection sites threaded through the codebase are declared ONCE, in the
+:data:`SITES` registry below. Production call sites reference the
+module-level constants (``faults.GENERATION_DECODE_STEP``), never raw
+strings: a typo'd string would silently become a site no chaos plan
+ever targets, while a typo'd constant is a NameError at import. The
+``fault-site-registry`` flexlint rule enforces this, and the README
+fault-site table is GENERATED from this registry
+(``python tools/flexlint.py --emit-site-table``).
 
 **Scopes**: a fleet replica runs its scheduler steps inside
 ``with scope(replica_id):`` — rules registered with ``scope=`` (or via the
@@ -69,9 +51,61 @@ import dataclasses
 import random
 import threading
 import time
+from types import MappingProxyType
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+# --------------------------------------------------------------- registry
+# Canonical injection sites. The constant is the only sanctioned way to
+# name a site from production code; the description is the README table
+# cell (tools/flexlint.py --emit-site-table renders it verbatim).
+EXECUTOR_TRAIN_BATCH = "executor.train_batch"
+EXECUTOR_PREDICT = "executor.predict"
+ELASTIC_STEP = "elastic.step"
+SERVING_MODEL_INFER = "serving.model.infer"
+SERVING_BATCHER_DISPATCH = "serving.batcher.dispatch"
+SERVING_REPOSITORY_LOAD = "serving.repository.load"
+CHECKPOINT_SAVE = "checkpoint.save"
+GENERATION_PREFILL = "generation.prefill"
+GENERATION_DECODE_STEP = "generation.decode_step"
+GENERATION_VERIFY = "generation.verify"
+GENERATION_JOURNAL_REPLAY = "generation.journal_replay"
+FLEET_ROUTE = "fleet.route"
+FLEET_REPLICA_SPAWN = "fleet.replica_spawn"
+
+# site -> "where it fires" (read-only: registering a site means adding a
+# constant + an entry here + the inject() call, in one reviewed place)
+SITES = MappingProxyType({
+    EXECUTOR_TRAIN_BATCH: "before each train dispatch (value: inputs)",
+    EXECUTOR_PREDICT: "around forward outputs (value: outputs)",
+    ELASTIC_STEP: "top of each `ElasticTrainer` step",
+    SERVING_MODEL_INFER: "before a served model's device call (value: inputs)",
+    SERVING_BATCHER_DISPATCH: "before the batcher runs a device batch",
+    SERVING_REPOSITORY_LOAD: "before a repository model load",
+    CHECKPOINT_SAVE: "top of `save_checkpoint`",
+    GENERATION_PREFILL: "before a generation prefill (value: prompt tokens)",
+    GENERATION_DECODE_STEP: (
+        "before each batched decode step (value: (slot tokens, per-slot "
+        "logit bias); `nan` mode poisons the bias — per-slot with `select`)"
+    ),
+    GENERATION_VERIFY: (
+        "before each speculative verification step (value: (window tokens, "
+        "per-slot logit bias))"
+    ),
+    GENERATION_JOURNAL_REPLAY: (
+        "top of each supervisor journal-replay restart (an error here is a "
+        "double fault)"
+    ),
+    FLEET_ROUTE: (
+        "before each fleet routing decision (value: (prompt tokens, "
+        "candidate replica ids))"
+    ),
+    FLEET_REPLICA_SPAWN: (
+        "before a fleet replica is built/warmed (value: the new replica id); "
+        "an error here is a failed replacement spawn"
+    ),
+})
 
 
 class FaultInjected(RuntimeError):
@@ -119,7 +153,7 @@ def replica_kill(
     plan: "FaultPlan",
     replica: str,
     *,
-    site: str = "generation.decode_step",
+    site: str = GENERATION_DECODE_STEP,
     mode: str = "error",
     error: Any = None,
     gate: Optional[threading.Event] = None,

@@ -331,9 +331,13 @@ class DynamicBatcher:
                     first = item
         batch = [first]
         total = first.n
-        deadline = time.monotonic() + self.max_delay_s
+        # the coalescing window bounds a REAL blocking queue.get below,
+        # so it must run on physical time: the injectable self.clock is
+        # virtual in chaos tests and would turn max_delay_s into either
+        # zero or forever. Request deadlines still use self.clock.
+        deadline = time.monotonic() + self.max_delay_s  # flexlint: disable=clock-discipline
         while total < self.model.max_batch:
-            remaining = deadline - time.monotonic()
+            remaining = deadline - time.monotonic()  # flexlint: disable=clock-discipline
             if remaining <= 0:
                 break
             try:
@@ -429,7 +433,7 @@ class DynamicBatcher:
                         r.future.set_exception(err)
                 continue
             try:
-                live = faults.inject("serving.batcher.dispatch", live)
+                live = faults.inject(faults.SERVING_BATCHER_DISPATCH, live)
                 self._run(live)
             except Exception as e:  # injected dispatch fault / scatter bug
                 for r in live:

@@ -200,7 +200,7 @@ class FleetRouter:
         its lone replica so submit raises exactly the bare
         GenerationModel's typed error (parity)."""
         reps = self.fleet._replicas_snapshot()
-        faults.inject("fleet.route", (list(prompt), [r.id for r in reps]))
+        faults.inject(faults.FLEET_ROUTE, (list(prompt), [r.id for r in reps]))
         cands = [r for r in reps if r.eligible()]
         if not cands:
             if len(reps) == 1:
@@ -382,12 +382,12 @@ class Fleet:
             capacity=256, enabled=observability, sched_clock=clock
         )
         self._lock = threading.RLock()
-        self._pending: deque = deque()  # requests awaiting ANY replica
+        self._pending: deque = deque()  # requests awaiting ANY replica; guarded-by: _lock
         # counters folded in from retired replicas AND fleet-pending
         # terminal outcomes, so the aggregate /v2/stats view stays
         # cumulative across replacements and never under-reports
         # failures that happened outside any replica
-        self._folded_counters: Dict[str, int] = {}
+        self._folded_counters: Dict[str, int] = {}  # guarded-by: _lock
         self._rid = itertools.count()
         self._spawn_fail_streak = 0
         self._draining = False
@@ -399,8 +399,8 @@ class Fleet:
         # replaced-but-still-busy replicas: out of the routing set, kept
         # stepping until their residents finish (or expire), then torn
         # down — a drain timeout must never abort live streams
-        self._retiring: List[Replica] = []
-        self.replicas: List[Replica] = [self._spawn() for _ in range(n)]
+        self._retiring: List[Replica] = []  # guarded-by: _lock
+        self.replicas: List[Replica] = [self._spawn() for _ in range(n)]  # guarded-by: _lock
 
     # ----------------------------------------------------------- replicas
     def _replicas_snapshot(self) -> List[Replica]:
@@ -415,7 +415,7 @@ class Fleet:
         when the fleet speculates by default — the verify jit) so the
         replica's first real request never pays a retrace."""
         rid = f"r{next(self._rid)}"
-        faults.inject("fleet.replica_spawn", rid)
+        faults.inject(faults.FLEET_REPLICA_SPAWN, rid)
         engine = self.engine_factory()
         if self.warmup:
             engine.generate(
@@ -806,6 +806,13 @@ class Fleet:
             self._started = False
             self._stopped = True
 
+    def _pending_count(self) -> int:
+        """Locked fleet-pending depth — the read path for step/has_work
+        and the scrape-facing reports (writers swap the deque wholesale
+        under the lock)."""
+        with self._lock:
+            return len(self._pending)
+
     def step(self) -> bool:
         """One synchronous fleet iteration (virtual-clock tests): step
         every live replica's scheduler once, then run the supervisor's
@@ -817,7 +824,7 @@ class Fleet:
             if rep.state != ReplicaState.DEAD:
                 did = rep.scheduler.step() or did
         self.check()
-        return did or bool(self._pending)
+        return did or self._pending_count() > 0
 
     def ready(self) -> bool:
         return (
@@ -829,7 +836,9 @@ class Fleet:
     def has_work(self) -> bool:
         with self._lock:
             members = list(self.replicas) + list(self._retiring)
-        return bool(self._pending) or any(r.scheduler.has_work() for r in members)
+        return self._pending_count() > 0 or any(
+            r.scheduler.has_work() for r in members
+        )
 
     # ------------------------------------------- GenerationModel surface
     def _solo(self) -> Optional[GenerationModel]:
@@ -912,7 +921,7 @@ class Fleet:
                 r.id: {"state": r.state, **r.model.readiness_rationale()}
                 for r in self._replicas_snapshot()
             },
-            "pending": len(self._pending),
+            "pending": self._pending_count(),
         }
 
     sampling_from = staticmethod(GenerationModel.sampling_from)
@@ -966,7 +975,7 @@ class Fleet:
                     )
                 ],
             })
-        out = {"name": self.name, "replicas": reps, "pending": len(self._pending)}
+        out = {"name": self.name, "replicas": reps, "pending": self._pending_count()}
         out.update(self.fleet_stats.snapshot())
         out["recent_events"] = self.fleet_flight.snapshot(32)
         return out

@@ -114,7 +114,7 @@ class InferenceModel:
         # chaos hook: rules can raise (device loss), stall, or poison;
         # `when` predicates see the stacked inputs, so a fault can track a
         # specific poisoned request through batch bisection
-        inputs = faults.inject("serving.model.infer", inputs)
+        inputs = faults.inject(faults.SERVING_MODEL_INFER, inputs)
         if len(inputs) != len(self.inputs):
             raise ValueError(f"model takes {len(self.inputs)} inputs, got {len(inputs)}")
         n = inputs[0].shape[0]

@@ -63,7 +63,7 @@ def load_model(root: str, name: str) -> InferenceModel:
     weights); compiles for inference on the current mesh."""
     from ..runtime import faults
 
-    faults.inject("serving.repository.load", name)
+    faults.inject(faults.SERVING_REPOSITORY_LOAD, name)
     from ..config import FFConfig
     from ..model import FFModel, Tensor
     from ..parallel.propagation import infer_all_specs

@@ -313,7 +313,8 @@ class InferenceServer:
             trace = unit.flight.to_chrome_trace(pid=pid, name=label)
             events.extend(trace["traceEvents"])
             incidents.extend(
-                {**inc, "model": label} for inc in list(unit.flight.incidents)
+                {**inc, "model": label}
+                for inc in unit.flight.incident_snapshots()
             )
         return {
             "traceEvents": events,

@@ -28,10 +28,10 @@ class LatencyWindow:
 
     def __init__(self, maxlen: int = 512):
         self._lock = threading.Lock()
-        self._window: deque = deque(maxlen=maxlen)
-        self.count = 0
-        self.total_s = 0.0
-        self.max_s = 0.0
+        self._window: deque = deque(maxlen=maxlen)  # guarded-by: _lock
+        self.count = 0  # guarded-by: _lock
+        self.total_s = 0.0  # guarded-by: _lock
+        self.max_s = 0.0  # guarded-by: _lock
 
     def record(self, seconds: float) -> None:
         with self._lock:
@@ -77,9 +77,9 @@ class Histogram:
             bounds = bounds + (math.inf,)
         self.bounds = bounds
         self._lock = threading.Lock()
-        self._counts = [0] * len(bounds)  # per-bucket (non-cumulative)
-        self.count = 0
-        self.sum = 0.0
+        self._counts = [0] * len(bounds)  # per-bucket (non-cumulative); guarded-by: _lock
+        self.count = 0  # guarded-by: _lock
+        self.sum = 0.0  # guarded-by: _lock
 
     def observe(self, value: float) -> None:
         # bucket i is the first bound >= value (the last bound is +Inf,
@@ -112,16 +112,16 @@ class ServingStats:
 
     def __init__(self, latency_window: int = 512):
         self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {c: 0 for c in self.COUNTERS}
+        self._counts: Dict[str, int] = {c: 0 for c in self.COUNTERS}  # guarded-by: _lock
         self.latency = LatencyWindow(latency_window)
         self._window_len = latency_window
         # name -> zero-arg callable returning a number (queue depth,
         # cache occupancy, tokens/s ...), evaluated at snapshot time.
         # Registration and iteration share self._lock: a model loading
         # mid-scrape must not mutate the dict under snapshot()'s feet.
-        self.gauges: Dict[str, Callable[[], float]] = {}
-        self._windows: Dict[str, LatencyWindow] = {}
-        self._histograms: Dict[str, Histogram] = {}
+        self.gauges: Dict[str, Callable[[], float]] = {}  # guarded-by: _lock
+        self._windows: Dict[str, LatencyWindow] = {}  # guarded-by: _lock
+        self._histograms: Dict[str, Histogram] = {}  # guarded-by: _lock
 
     def incr(self, counter: str, n: int = 1) -> None:
         with self._lock:
@@ -197,10 +197,10 @@ class SpeculationStats:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.windows = 0
-        self.proposed = 0
-        self.accepted = 0
-        self.emitted = 0
+        self.windows = 0  # guarded-by: _lock
+        self.proposed = 0  # guarded-by: _lock
+        self.accepted = 0  # guarded-by: _lock
+        self.emitted = 0  # guarded-by: _lock
 
     def record_window(self, proposed: int, accepted: int, emitted: int) -> None:
         with self._lock:
@@ -225,10 +225,22 @@ class SpeculationStats:
         with self._lock:
             return self.emitted / self.windows if self.windows else 0.0
 
+    def counts(self) -> Dict[str, int]:
+        """Locked snapshot of the raw counters — the gauge read path
+        (gauge callables run on scrape threads while the verify loop is
+        mid-record_window)."""
+        with self._lock:
+            return {
+                "windows": self.windows,
+                "proposed": self.proposed,
+                "accepted": self.accepted,
+                "emitted": self.emitted,
+            }
+
     def register_gauges(self, stats: "ServingStats", prefix: str = "spec_") -> None:
-        stats.add_gauge(prefix + "windows", lambda: self.windows)
-        stats.add_gauge(prefix + "tokens_proposed", lambda: self.proposed)
-        stats.add_gauge(prefix + "tokens_accepted", lambda: self.accepted)
+        stats.add_gauge(prefix + "windows", lambda: self.counts()["windows"])
+        stats.add_gauge(prefix + "tokens_proposed", lambda: self.counts()["proposed"])
+        stats.add_gauge(prefix + "tokens_accepted", lambda: self.counts()["accepted"])
         stats.add_gauge(prefix + "acceptance_rate", self.acceptance_rate)
         stats.add_gauge(prefix + "mean_accepted_len", self.mean_accepted_len)
         stats.add_gauge(prefix + "mean_emitted_len", self.mean_emitted_len)
@@ -309,7 +321,7 @@ class FleetStats:
         self._lock = threading.Lock()
         for f in self.FIELDS:
             setattr(self, f, 0)
-        self._decisions: Dict[str, int] = {}
+        self._decisions: Dict[str, int] = {}  # guarded-by: _lock
 
     def incr(self, field: str, n: int = 1) -> None:
         if field not in self.FIELDS:
@@ -346,10 +358,10 @@ class GoodputStats:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.tokens_total = 0
-        self.tokens_good = 0
-        self.requests_total = 0
-        self.requests_good = 0
+        self.tokens_total = 0  # guarded-by: _lock
+        self.tokens_good = 0  # guarded-by: _lock
+        self.requests_total = 0  # guarded-by: _lock
+        self.requests_good = 0  # guarded-by: _lock
 
     def record(self, n_tokens: int, good: bool) -> None:
         with self._lock:
@@ -363,9 +375,14 @@ class GoodputStats:
         with self._lock:
             return self.tokens_good / self.tokens_total if self.tokens_total else 0.0
 
+    def totals(self) -> Tuple[int, int]:
+        """Locked (tokens_total, tokens_good) — the gauge read path."""
+        with self._lock:
+            return self.tokens_total, self.tokens_good
+
     def register_gauges(self, stats: "ServingStats") -> None:
-        stats.add_gauge("goodput_tokens_total", lambda: self.tokens_total)
-        stats.add_gauge("goodput_tokens_good", lambda: self.tokens_good)
+        stats.add_gauge("goodput_tokens_total", lambda: self.totals()[0])
+        stats.add_gauge("goodput_tokens_good", lambda: self.totals()[1])
         stats.add_gauge("goodput_ratio", self.ratio)
 
 
@@ -378,24 +395,25 @@ class TokenRate:
         self._clock = clock
         self._window_s = window_s
         self._lock = threading.Lock()
-        self._events: deque = deque()  # (t, n_tokens)
-        self.total = 0
+        self._events: deque = deque()  # (t, n_tokens); guarded-by: _lock
+        self.total = 0  # guarded-by: _lock
 
     def record(self, n_tokens: int) -> None:
         now = self._clock()
         with self._lock:
             self.total += n_tokens
             self._events.append((now, n_tokens))
-            self._trim(now)
+            self._trim_locked(now)
 
-    def _trim(self, now: float) -> None:
+    def _trim_locked(self, now: float) -> None:
+        # caller holds self._lock
         while self._events and now - self._events[0][0] > self._window_s:
             self._events.popleft()
 
     def rate(self) -> float:
         now = self._clock()
         with self._lock:
-            self._trim(now)
+            self._trim_locked(now)
             if not self._events:
                 return 0.0
             span = max(now - self._events[0][0], 1e-9)

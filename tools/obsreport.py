@@ -357,7 +357,7 @@ def selfcheck() -> int:
                               {"prompt": [2, 7, 1, 8], "max_new_tokens": 8})
         check(code == 200 and len(resp.get("tokens", [])) == 8,
               f"restart did not replay the stream: {code} {resp}")
-        incidents = list(model.flight.incidents)
+        incidents = model.flight.incident_snapshots()
         restart = [i for i in incidents if i["kind"] == "restart"]
         check(restart, f"no restart incident recorded: {[i['kind'] for i in incidents]}")
         check(any(r.get("kind") == "step_failed" for r in restart[-1]["records"]),
