@@ -20,6 +20,13 @@ is the TPU-native serving answer for decoder transformers:
   admission is cache-capacity aware, and cache exhaustion preempts by
   recompute.
 
+* :mod:`prefix` — cross-request prefix caching: a radix index over
+  token-block content with refcounted copy-on-write blocks and a
+  host-RAM offload tier (swap-in vs recompute decided on the cost-model
+  roofline, CRC-verified, chaos-covered). Admission matches the longest
+  cached prefix and prefills only the suffix; streams are byte-identical
+  with caching on or off.
+
 * :mod:`speculative` — speculative decoding (SpecInfer / Leviathan et
   al.): model-free n-gram and small-draft-model drafters, ONE
   fixed-shape batched verification step over the block cache
@@ -43,6 +50,7 @@ HTTP (SSE) and gRPC.
 from .cache import BlockAllocator, CacheConfig, KVCache
 from .decoder import DecoderParams, forward_full, init_decoder_params
 from .engine import GenerationEngine, SamplingParams
+from .prefix import PrefixCache, PrefixEntry
 from .recovery import (
     EngineFailedError,
     EngineSupervisor,
@@ -80,6 +88,8 @@ __all__ = [
     "KVCache",
     "NgramDrafter",
     "PoisonedRequestError",
+    "PrefixCache",
+    "PrefixEntry",
     "RecoveryPolicy",
     "Request",
     "SamplingParams",

@@ -37,8 +37,27 @@ from ..obs.truth import PredictionLedger
 from ..runtime import faults
 from .cache import BlockAllocator, CacheConfig, KVCache, slot_mapping
 from .decoder import DecoderParams, decode_step, prefill, verify_step
+from .prefix import PrefixCache, PrefixEntry
 
 NEG_INF = -1e30
+
+
+@dataclasses.dataclass
+class PrefixPlan:
+    """Admission-time reuse decision for one prompt (engine.prefix_plan):
+    the cached entries to share, the boundary entry to COW-copy when the
+    prompt is fully covered (its last position must still be recomputed
+    for logits, and that write lands inside the last matched block), the
+    token count reuse covers, and how many shared entries are already
+    device-resident (the rest swap in from the host tier)."""
+
+    entries: List[PrefixEntry]
+    cow: Optional[PrefixEntry]
+    reuse_tokens: int
+    n_resident: int
+
+
+EMPTY_PREFIX_PLAN = PrefixPlan([], None, 0, 0)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,6 +135,8 @@ class GenerationEngine:
         max_seq_len: Optional[int] = None,
         block_size: int = 16,
         max_spec_tokens: int = 4,
+        prefix_cache: bool = True,
+        host_cache_bytes: Optional[int] = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -219,6 +240,19 @@ class GenerationEngine:
         self._prefill_jit = jax.jit(self._prefill_impl)
         self._decode_jit = jax.jit(self._decode_impl)
         self._verify_jit = jax.jit(self._verify_impl)
+        # cross-request prefix caching (generation/prefix.py): radix
+        # index + refcounted COW blocks + host-RAM offload tier. The
+        # block-level device programs below are admission-time only
+        # (suffix prefill per bucket, one copy/read/write each) — the
+        # steady-state decode/verify programs are untouched.
+        self.prefix_cache = PrefixCache(
+            self.allocator, cache_config,
+            enabled=prefix_cache, host_budget_bytes=host_cache_bytes,
+        )
+        self._prefix_prefill_jit = jax.jit(self._prefix_prefill_impl)
+        self._copy_block_jit = jax.jit(self._copy_block_impl)
+        self._read_block_jit = jax.jit(self._read_block_impl)
+        self._write_block_jit = jax.jit(self._write_block_impl)
 
     # ------------------------------------------------------------ geometry
     def reset(self) -> None:
@@ -230,6 +264,11 @@ class GenerationEngine:
         into the fresh cache."""
         self.cache.reset()
         self.allocator.reset()
+        # the prefix index is provenance-bound to the dead cache: drop
+        # every entry (resident ids AND host copies) wholesale — replay
+        # re-matches against the empty index, which is recompute,
+        # which is byte-exact
+        self.prefix_cache.reset()
         self.last_finite = np.ones((self.max_batch_slots,), bool)
         self.resets += 1
 
@@ -326,6 +365,77 @@ class GenerationEngine:
         )
         return out, jnp.where(n_draft >= 0, n_emitted, 0), ok, cache_k, cache_v
 
+    def _prefix_prefill_impl(
+        self, params, tokens, start, n_real, cache_k, cache_v, block_table, temp, top_k, key
+    ):
+        """Suffix-only prefill against a cached prefix: the [1, W]
+        suffix window attends over the block table (shared prefix
+        blocks + fresh suffix blocks) via the same chunked-append
+        forward speculative verification uses, writes the suffix K/V,
+        and samples the first generated token from the last REAL suffix
+        position's logits. One program per suffix bucket W — admission
+        cost, never steady state."""
+        w = tokens.shape[1]
+        name = f"prefix_prefill[{w}]"
+        self.trace_counts[name] = self.trace_counts.get(name, 0) + 1
+        self.programs.note_trace(name, {
+            "params": params, "tokens": tokens, "start": start,
+            "n_real": n_real, "cache_k": cache_k,
+            "block_table": block_table, "temp": temp, "top_k": top_k,
+            "key": key,
+        })
+        offs = jnp.arange(w, dtype=jnp.int32)
+        positions = jnp.where(offs < n_real, start + offs, -1)[None, :]
+        logits, cache_k, cache_v = verify_step(
+            params, tokens, positions, cache_k, cache_v, block_table[None],
+            backend=self.backend,
+        )
+        last = logits[0, n_real - 1]
+        ok = jnp.all(jnp.isfinite(last))  # blame: poisoned prompt
+        token = _sample(last[None], temp[None], top_k[None], key[None])[0]
+        return token, ok, cache_k, cache_v
+
+    def _copy_block_impl(self, cache_k, cache_v, src, dst):
+        """COW: duplicate one block's K/V across all layers (the first
+        divergent append into a shared block lands in the copy)."""
+        self.trace_counts["kv_cow_copy"] = self.trace_counts.get("kv_cow_copy", 0) + 1
+        self.programs.note_trace("kv_cow_copy", {
+            "cache_k": cache_k, "src": src, "dst": dst,
+        })
+        k = jax.lax.dynamic_index_in_dim(cache_k, src, axis=1)
+        v = jax.lax.dynamic_index_in_dim(cache_v, src, axis=1)
+        return (
+            jax.lax.dynamic_update_slice_in_dim(cache_k, k, dst, axis=1),
+            jax.lax.dynamic_update_slice_in_dim(cache_v, v, dst, axis=1),
+        )
+
+    def _read_block_impl(self, cache_k, cache_v, src):
+        """Host-tier swap-out read: one block's K/V ([L, bs, H, D]
+        each), fetched with a traced index so every block id shares ONE
+        program."""
+        self.trace_counts["kv_block_read"] = self.trace_counts.get("kv_block_read", 0) + 1
+        self.programs.note_trace("kv_block_read", {"cache_k": cache_k, "src": src})
+        return (
+            jax.lax.dynamic_index_in_dim(cache_k, src, axis=1, keepdims=False),
+            jax.lax.dynamic_index_in_dim(cache_v, src, axis=1, keepdims=False),
+        )
+
+    def _write_block_impl(self, cache_k, cache_v, dst, host_k, host_v):
+        """Host-tier swap-in write: place one block's K/V back into the
+        device cache at ``dst``."""
+        self.trace_counts["kv_block_write"] = self.trace_counts.get("kv_block_write", 0) + 1
+        self.programs.note_trace("kv_block_write", {
+            "cache_k": cache_k, "dst": dst, "host_k": host_k,
+        })
+        return (
+            jax.lax.dynamic_update_slice_in_dim(
+                cache_k, host_k[:, None].astype(cache_k.dtype), dst, axis=1
+            ),
+            jax.lax.dynamic_update_slice_in_dim(
+                cache_v, host_v[:, None].astype(cache_v.dtype), dst, axis=1
+            ),
+        )
+
     # ----------------------------------------------------------- host API
     def prefill_one(
         self,
@@ -333,11 +443,18 @@ class GenerationEngine:
         block_table: Sequence[int],
         sampling: SamplingParams,
         key: jax.Array,
+        prefix_len: int = 0,
     ) -> int:
         """Prefill one sequence into its allocated blocks and sample its
         first generated token. ``block_table`` is the sequence's block
-        ids (padded internally to the engine's fixed table width)."""
+        ids (padded internally to the engine's fixed table width).
+        ``prefix_len`` > 0 means positions [0, prefix_len) are already
+        cached (shared prefix blocks at the front of the table): only
+        the suffix is computed, attending to the cached prefix — the
+        O(suffix) admission path prefix caching exists for."""
         faults.inject(faults.GENERATION_PREFILL, prompt)
+        if prefix_len > 0:
+            return self._prefill_suffix(prompt, block_table, sampling, key, prefix_len)
         self.step_counts["prefill"] += 1
         t0 = time.perf_counter()
         n = len(prompt)
@@ -389,6 +506,341 @@ class GenerationEngine:
                 alarm=self._roofline_alarm,
             )
         return out
+
+    def _prefill_suffix(
+        self,
+        prompt: Sequence[int],
+        block_table: Sequence[int],
+        sampling: SamplingParams,
+        key: jax.Array,
+        prefix_len: int,
+    ) -> int:
+        """Suffix-only prefill: positions [prefix_len, len(prompt))
+        computed against the cached prefix. Accounting mirrors
+        prefill(): step/FLOPs/time under the "prefill" kind, compile
+        calls registry-stamped, steady calls ledger-paired."""
+        self.step_counts["prefill"] += 1
+        t0 = time.perf_counter()
+        n = len(prompt)
+        suffix = list(prompt[prefix_len:])
+        w = self.bucket_for(len(suffix))
+        name = f"prefix_prefill[{w}]"
+        traces_before = self.trace_counts.get(name, 0)
+        tokens = np.zeros((1, w), np.int32)
+        tokens[0, : len(suffix)] = suffix
+        table = np.zeros((self.max_blocks_per_seq,), np.int32)
+        table[: len(block_table)] = block_table
+        token, ok, ck, cv = self._prefix_prefill_jit(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.int32(prefix_len),
+            jnp.int32(len(suffix)),
+            self.cache.k,
+            self.cache.v,
+            jnp.asarray(table),
+            jnp.float32(sampling.temperature),
+            jnp.int32(sampling.top_k),
+            key,
+        )
+        self.cache.update(ck, cv)
+        self.last_finite = np.asarray(ok).reshape(1)
+        out = int(token)  # forces the result sync before the clock stops
+        elapsed = time.perf_counter() - t0
+        # useful work = suffix tokens only, each attending its full live
+        # context (causal): ctx = sum_{p=prefix_len}^{n-1} (p + 1)
+        ctx = (n * (n + 1) - prefix_len * (prefix_len + 1)) // 2
+        flops = self.flops_model.verify_flops(len(suffix), ctx)
+        self.flops_by_kind["prefill"] += flops
+        self.device_time_s["prefill"] += elapsed
+        if self.trace_counts.get(name, 0) > traces_before:
+            self.programs.set_compile_time(name, elapsed)
+        else:
+            # EXECUTED work: the program computes the full padded W
+            # window (padding attends to nothing — see verify())
+            self.ledger.observe(
+                name,
+                self.flops_model.roofline_s(
+                    self.flops_model.verify_flops(w, ctx),
+                    self.flops_model.verify_bytes(w, ctx),
+                ),
+                elapsed,
+                label=f"{name} ({self.flops_model.chip.name})",
+                provenance="serving roofline (ServingFlops x chip peak)",
+                alarm=self._roofline_alarm,
+            )
+        return out
+
+    # ------------------------------------------------------ prefix caching
+    def prefix_plan(self, prompt: Sequence[int]) -> PrefixPlan:
+        """Match ``prompt`` against the radix index and decide what to
+        reuse. Offloaded entries in the matched run are only used when
+        the host->device transfer beats recomputing the same positions
+        on the chip roofline (the PR 7 cost-model idiom); otherwise the
+        run truncates at the first offloaded entry. A failed lookup
+        (``generation.prefix_lookup`` chaos) degrades to a miss — full
+        recompute, byte-exact."""
+        pc = self.prefix_cache
+        if not pc.enabled or len(prompt) < 2:
+            return EMPTY_PREFIX_PLAN
+        try:
+            faults.inject(faults.GENERATION_PREFIX_LOOKUP, list(prompt))
+            run = pc.match(prompt)
+        except Exception:
+            pc.recompute_fallbacks += 1
+            return EMPTY_PREFIX_PLAN
+        if not run:
+            return EMPTY_PREFIX_PLAN
+        bs = self.cache_config.block_size
+        reuse = min(len(run) * bs, len(prompt) - 1)
+        n_shared = reuse // bs
+        cow = run[n_shared] if (reuse % bs and len(run) > n_shared) else None
+        entries = run[:n_shared]
+        off_idx = [i for i, e in enumerate(entries) if not e.resident]
+        cow_off = cow is not None and not cow.resident
+        if off_idx or cow_off:
+            n_off = len(off_idx) + (1 if cow_off else 0)
+            first = off_idx[0] if off_idx else n_shared
+            # the recompute alternative: truncate at the first offloaded
+            # entry and prefill positions [first*bs, reuse) instead
+            start = first * bs
+            n_tok = reuse - start
+            ctx = (reuse * (reuse + 1) - start * (start + 1)) // 2
+            recompute_s = self.flops_model.roofline_s(
+                self.flops_model.verify_flops(n_tok, ctx),
+                self.flops_model.verify_bytes(n_tok, ctx),
+            )
+            if pc.swap_in_cost_s(n_off) >= recompute_s:
+                pc.recompute_fallbacks += 1
+                entries = entries[:first]
+                reuse = first * bs
+                cow = None
+        n_resident = sum(1 for e in entries if e.resident)
+        return PrefixPlan(entries, cow, reuse, n_resident)
+
+    def prepare_prefix(
+        self,
+        prompt: Sequence[int],
+        plan: PrefixPlan,
+        new_blocks: List[int],
+    ) -> Optional[Tuple[List[int], set, List[PrefixEntry], int]]:
+        """Assemble one admission's block table from a plan: shared
+        entries first (swapping offloaded ones back in), then the
+        private blocks (COW boundary copy, suffix, growth room).
+        Returns (table, shared_idx, held entries, prefix_len), or None
+        when a mid-assembly swap-in fallback could not replace the lost
+        shared blocks — everything is handed back and the caller
+        retries admission later.
+
+        A failed or corrupted swap-in truncates reuse at that entry and
+        falls back to recomputing the rest — the exactness invariant
+        makes the fallback invisible in the token stream."""
+        pc = self.prefix_cache
+        bs = self.cache_config.block_size
+        entries = list(plan.entries)
+        cow = plan.cow
+        reuse = plan.reuse_tokens
+        pc.acquire(entries)
+        if cow is not None:
+            # hold the boundary entry too: the reclaim fallback below
+            # must not evict the COW source out from under the copy
+            pc.acquire([cow])
+        pool = list(new_blocks)
+        shared: List[int] = []
+        kept: List[PrefixEntry] = []
+        failed_at: Optional[int] = None
+        for i, e in enumerate(entries):
+            if e.resident:
+                shared.append(e.block)
+                kept.append(e)
+                continue
+            if not pool:
+                failed_at = i  # stale plan: no swap target left
+                break
+            dst = pool.pop(0)
+            if self._swap_in(e, dst):
+                shared.append(e.block)
+                kept.append(e)
+            else:
+                pool.insert(0, dst)
+                failed_at = i
+                break
+        need_total = self.cache_config.blocks_for(len(prompt) + 1)
+        if failed_at is not None:
+            pc.release(entries[failed_at:])
+            entries = list(kept)
+            reuse = len(kept) * bs
+            if cow is not None:
+                pc.release([cow])
+                cow = None
+        # re-balance the private pool against the full table budget.
+        # The plan's resident count can go stale between planning and
+        # assembly: a reclaim (this admission's own, or the allocator
+        # retry's) may evict a planned-resident entry, whose swap-in
+        # then consumes a pool block budgeted for the suffix — a short
+        # table would silently map suffix positions to the scratch
+        # block and corrupt the stream. Top the pool back up (or hand
+        # everything back and let the caller retry).
+        short = need_total - len(shared) - len(pool)
+        if short > 0:
+            extra = self.allocator.allocate(short)
+            if extra is None and self.reclaim_cached(short):
+                extra = self.allocator.allocate(short)
+            if extra is None:
+                if cow is not None:
+                    pc.release([cow])
+                pc.release(kept)
+                self.allocator.free(pool)
+                return None
+            pool.extend(extra)
+        if cow is not None:
+            # the boundary block: the copy target doubles as the plain
+            # private block when the COW source is unusable (corrupt
+            # offloaded content) — the table shape is identical either
+            # way, only prefix_len changes
+            if pool and self._cow_copy(cow, pool[0]):
+                pc.cow_copies_total += 1
+            else:
+                reuse = len(kept) * bs
+            pc.release([cow])
+        table = shared + pool
+        if len(table) > need_total:
+            surplus = table[need_total:]
+            del table[need_total:]
+            self.allocator.free(surplus)
+        return table, set(range(len(shared))), kept, reuse
+
+    def _swap_in(self, entry: PrefixEntry, dst: int) -> bool:
+        """Bring one offloaded entry's K/V back to device block ``dst``.
+        CRC-verified; the (predicted, measured) transfer time joins the
+        PredictionLedger so drift telemetry covers the swap heuristic."""
+        pc = self.prefix_cache
+        predicted = pc.swap_in_cost_s(1)
+        traces_before = self.trace_counts.get("kv_block_write", 0)
+        t0 = time.perf_counter()
+        try:
+            faults.inject(faults.GENERATION_KV_OFFLOAD, ("in", 1))
+            buf = pc.take_host_copy(entry)
+            if buf is None:  # corrupted or already dropped
+                raise ValueError("host-tier block failed CRC verification")
+            hk, hv = buf
+            ck, cv = self._write_block_jit(
+                self.cache.k, self.cache.v, jnp.int32(dst),
+                jnp.asarray(hk), jnp.asarray(hv),
+            )
+            self.cache.update(ck, cv)
+        except Exception:
+            pc.swap_in_failures += 1
+            pc.recompute_fallbacks += 1
+            return False
+        pc.note_swapped_in(entry, dst)
+        elapsed = time.perf_counter() - t0
+        if self.trace_counts.get("kv_block_write", 0) == traces_before:
+            self.ledger.observe(
+                "kv_swap_in", predicted, elapsed,
+                label="kv_swap_in (host tier)",
+                provenance="host-tier transfer model (link bytes/s)",
+                alarm=self._roofline_alarm,
+            )
+        return True
+
+    def _cow_copy(self, src: PrefixEntry, dst: int) -> bool:
+        """Materialize a private copy of ``src``'s block at ``dst`` —
+        from device (resident) or the host tier (offloaded). The source
+        entry is untouched: its content stays shared."""
+        if src.resident:
+            ck, cv = self._copy_block_jit(
+                self.cache.k, self.cache.v,
+                jnp.int32(src.block), jnp.int32(dst),
+            )
+            self.cache.update(ck, cv)
+            return True
+        pc = self.prefix_cache
+        try:
+            faults.inject(faults.GENERATION_KV_OFFLOAD, ("in", 1))
+            buf = pc.take_host_copy(src)
+            if buf is None:
+                raise ValueError("host-tier block failed CRC verification")
+            hk, hv = buf
+            ck, cv = self._write_block_jit(
+                self.cache.k, self.cache.v, jnp.int32(dst),
+                jnp.asarray(hk), jnp.asarray(hv),
+            )
+            self.cache.update(ck, cv)
+        except Exception:
+            pc.swap_in_failures += 1
+            pc.recompute_fallbacks += 1
+            return False
+        pc.swaps_in_total += 1
+        return True
+
+    def register_prefix(
+        self,
+        prompt: Sequence[int],
+        table: List[int],
+        shared_idx: set,
+        entries: List[PrefixEntry],
+        prefix_len: int = 0,
+    ) -> None:
+        """Post-prefill registration: the prompt's freshly written full
+        blocks join the radix index (ownership moves to the index; the
+        sequence keeps a ref). Called only after the finiteness check —
+        poisoned K/V must never become shared content. Reuse telemetry
+        counts HERE, not at table assembly, so a failed or poisoned
+        prefill (whose retry would double-count) never inflates
+        hit/reuse ratios with reuse that produced no token."""
+        pc = self.prefix_cache
+        if not pc.enabled:
+            return
+        pc.lookups += 1
+        if prefix_len > 0:
+            pc.hits += 1
+            pc.tokens_reused_total += prefix_len
+            pc.blocks_reused_total += len(entries)
+        self.prefix_cache.register_chain(
+            prompt, table, shared_idx, entries, len(prompt)
+        )
+
+    def stash_prefix(self, state) -> None:
+        """Preemption stash: register the victim's full blocks below
+        ``cached_len`` (prompt AND generated content) so its recompute
+        re-admission — and any request sharing the prefix — matches
+        them instead of recomputing; under continued pressure they
+        offload to the host tier and swap back in."""
+        if not self.prefix_cache.enabled:
+            return
+        req = state.req
+        tokens = list(req.original_prompt) + list(req.generated)
+        upto = min(state.cached_len, len(tokens))
+        self.prefix_cache.register_chain(
+            tokens, state.blocks, state.shared_idx, state.shared_entries, upto
+        )
+
+    def release_admission(
+        self, table: List[int], shared_idx: set, entries: List[PrefixEntry]
+    ) -> None:
+        """Undo one admission's block bookkeeping (failed or poisoned
+        prefill): private blocks back to the allocator, shared refs
+        dropped (the content stays cached for the next request)."""
+        self.allocator.free(
+            [b for i, b in enumerate(table) if i not in shared_idx]
+        )
+        self.prefix_cache.release(entries)
+
+    def reclaim_cached(self, n_blocks: int) -> int:
+        """Free device blocks held by unreferenced cached prefixes (LRU;
+        content offloads to the host tier when budget allows). The
+        allocator's last resort before preemption."""
+        if not self.prefix_cache.enabled:
+            return 0
+
+        def read(block_id: int):
+            faults.inject(faults.GENERATION_KV_OFFLOAD, ("out", 1))
+            k, v = self._read_block_jit(
+                self.cache.k, self.cache.v, jnp.int32(block_id)
+            )
+            return np.asarray(k), np.asarray(v)
+
+        return self.prefix_cache.reclaim(max(1, n_blocks), read)
 
     def decode(
         self,

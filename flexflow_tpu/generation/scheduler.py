@@ -327,15 +327,24 @@ class Request:
 class _Running:
     """Slot-resident state for an admitted request."""
 
-    __slots__ = ("req", "slot", "blocks", "cached_len", "admitted_seq", "step_k")
+    __slots__ = (
+        "req", "slot", "blocks", "cached_len", "admitted_seq", "step_k",
+        "shared_idx", "shared_entries",
+    )
 
-    def __init__(self, req: Request, slot: int, blocks: List[int], cached_len: int, admitted_seq: int):
+    def __init__(self, req: Request, slot: int, blocks: List[int], cached_len: int, admitted_seq: int,
+                 shared_idx=None, shared_entries=None):
         self.req = req
         self.slot = slot
         self.blocks = blocks
         self.cached_len = cached_len  # cache positions written so far
         self.admitted_seq = admitted_seq  # admission order, for LIFO preemption
         self.step_k = 0  # drafts planned for THIS step (<= req.spec_k)
+        # prefix caching (generation/prefix.py): table positions whose
+        # blocks are index-owned (refcounted, immutable, freed by the
+        # index — never by this sequence) and the held entries
+        self.shared_idx = shared_idx if shared_idx is not None else set()
+        self.shared_entries = shared_entries if shared_entries is not None else []
 
 
 class ContinuousBatchingScheduler:
@@ -437,8 +446,36 @@ class ContinuousBatchingScheduler:
         self.capacity = CacheTelemetry(
             engine.allocator, clock=self.clock,
             pressure_threshold=pressure_threshold, enabled=observability,
+            reclaimable=lambda: engine.prefix_cache.evictable_blocks,
         )
         self.capacity.register_gauges(self.stats, lambda: list(self._running.values()))
+        # prefix-cache telemetry (flexflow_serving_prefix_cache_*):
+        # hit ratio, reuse volume, COW copies, host-tier swaps and
+        # residency — counters ride as gauges like the cache_* family
+        pc = engine.prefix_cache
+        self.stats.add_gauge("prefix_cache_hit_ratio", pc.hit_ratio)
+        self.stats.add_gauge(
+            "prefix_cache_blocks_reused_total", lambda: pc.blocks_reused_total
+        )
+        self.stats.add_gauge(
+            "prefix_cache_tokens_reused_total", lambda: pc.tokens_reused_total
+        )
+        self.stats.add_gauge(
+            "prefix_cache_cow_copies_total", lambda: pc.cow_copies_total
+        )
+        self.stats.add_gauge(
+            "prefix_cache_swaps_in_total", lambda: pc.swaps_in_total
+        )
+        self.stats.add_gauge(
+            "prefix_cache_swaps_out_total", lambda: pc.swaps_out_total
+        )
+        self.stats.add_gauge("prefix_cache_host_bytes", lambda: pc.host_bytes)
+        self.stats.add_gauge(
+            "prefix_cache_resident_blocks", lambda: pc.resident_blocks
+        )
+        self.stats.add_gauge(
+            "prefix_cache_offloaded_blocks", lambda: pc.offloaded_blocks
+        )
         self.goodput = GoodputStats()
         self.goodput.register_gauges(self.stats)
         self.slo = SLOMonitor(slo_objectives, clock=self.clock)
@@ -689,6 +726,8 @@ class ContinuousBatchingScheduler:
         self.engine.reset()
         for state in states:
             state.blocks = []
+            state.shared_idx = set()
+            state.shared_entries = []
         sink = self.failover_sink
         if sink is not None:
             with self._lock:
@@ -909,6 +948,7 @@ class ContinuousBatchingScheduler:
             admitting=(adm_req, adm_blocks)
             if adm_req is not None and adm_blocks else None,
             free=free,
+            prefix=self.engine.prefix_cache.snapshot(),
         )
 
     def _loop(self) -> None:
@@ -920,8 +960,16 @@ class ContinuousBatchingScheduler:
     # ---------------------------------------------------------- internals
     def _release(self, state: _Running) -> None:
         self.journal.discard(state.req)
-        self.engine.allocator.free(state.blocks)
+        # private blocks go back to the allocator; shared (index-owned)
+        # blocks only drop this sequence's refcount — their content
+        # stays cached for the next matching prompt
+        self.engine.allocator.free(
+            [b for i, b in enumerate(state.blocks) if i not in state.shared_idx]
+        )
+        self.engine.prefix_cache.release(state.shared_entries)
         state.blocks = []
+        state.shared_idx = set()
+        state.shared_entries = []
         del self._running[state.slot]
         self._free_slots.append(state.slot)
 
@@ -1008,6 +1056,11 @@ class ContinuousBatchingScheduler:
             return False
         victim = max(victims, key=lambda s: s.admitted_seq)
         self.capacity.note_preempt(len(victim.blocks))
+        # stash the victim's computed KV in the radix index before the
+        # release: its re-admission (and any prefix-sharing request)
+        # re-matches the blocks — under continued pressure they offload
+        # to the host tier and swap back in instead of recomputing
+        self.engine.stash_prefix(victim)
         self._release(victim)
         req = victim.req
         req.prompt = req.original_prompt + list(req.generated)
@@ -1032,16 +1085,43 @@ class ContinuousBatchingScheduler:
             if not self.breaker.allow():
                 return False
             req = self._queue[0]
-            need = self.engine.cache_config.blocks_for(len(req.prompt) + 1)
-            blocks = self.engine.allocator.allocate(need)
-            if blocks is None:
-                # admission-rejection blame: remember when the FCFS head
-                # first stalled on blocks and how many it is short — the
-                # eventual admit stamps "queued Nms waiting for K
-                # block(s)" on the request's trace
-                if self.obs_enabled and req.cache_wait_start is None:
-                    req.cache_wait_start = self.clock()
-                req.cache_wait_short = need - self.engine.allocator.num_free
+        # prefix match + block acquisition run OUTSIDE the submit lock:
+        # the reclaim path does per-block device reads (host-tier
+        # swap-outs) that must neither block concurrent submits nor —
+        # via the heartbeat stamp — hide a wedged device from the
+        # watchdog. The allocator and prefix index carry their own
+        # locks; only the queue/slot mutation below needs _lock.
+        plan = self.engine.prefix_plan(req.prompt)
+        need = (
+            self.engine.cache_config.blocks_for(len(req.prompt) + 1)
+            - plan.n_resident
+        )
+        blocks = self.engine.allocator.allocate(need)
+        if blocks is None:
+            # unreferenced cached prefixes are the reclaim of last
+            # resort BEFORE making the head wait (or preempt): LRU
+            # entries offload to host and their device blocks free
+            with self._stamped():
+                reclaimed = self.engine.reclaim_cached(
+                    need - self.engine.allocator.num_free
+                )
+            if reclaimed:
+                blocks = self.engine.allocator.allocate(need)
+        if blocks is None:
+            # admission-rejection blame: remember when the FCFS head
+            # first stalled on blocks and how many it is short — the
+            # eventual admit stamps "queued Nms waiting for K
+            # block(s)" on the request's trace
+            if self.obs_enabled and req.cache_wait_start is None:
+                req.cache_wait_start = self.clock()
+            req.cache_wait_short = need - self.engine.allocator.num_free
+            return False
+        with self._lock:
+            if not self._queue or self._queue[0] is not req or not self._free_slots:
+                # the head changed while blocks were gathered (fleet
+                # steal_queue / adopt mutate the queue from other
+                # threads): hand the blocks back, retry next iteration
+                self.engine.allocator.free(blocks)
                 return False
             self._queue.popleft()
             slot = self._free_slots.pop()
@@ -1053,21 +1133,39 @@ class ContinuousBatchingScheduler:
                 blocks_short=req.cache_wait_short, blame=blame,
             )
             req.cache_wait_start = None
+        # assemble the block table from the prefix plan: swap-ins + the
+        # COW boundary copy are device work, so the watchdog's stall
+        # heartbeat covers them like any other step
+        with self._stamped():
+            prep = self.engine.prepare_prefix(req.prompt, plan, blocks)
+        if prep is None:
+            # a mid-assembly swap-in fallback could not replace the
+            # lost shared blocks: everything was handed back — requeue
+            # the head and retry next iteration
+            self._free_slots.append(slot)
+            with self._lock:
+                self._queue.appendleft(req)
+            return False
+        table, shared_idx, entries, prefix_len = prep
         # blocks first, then the request: cache_report treats a set
-        # _admitting as implying its blocks are readable
-        self._admitting_blocks = blocks
+        # _admitting as implying its blocks are readable (private
+        # blocks only — shared ones are the prefix index's to report)
+        self._admitting_blocks = [
+            b for i, b in enumerate(table) if i not in shared_idx
+        ]
         self._admitting = req
         t_dev = time.perf_counter()
         try:
             token = self._device(
                 lambda: self.engine.prefill_one(
-                    req.prompt, blocks, req.sampling, req.sample_key()
+                    req.prompt, table, req.sampling, req.sample_key(),
+                    prefix_len=prefix_len,
                 )
             )
         except Exception as e:
             self._admitting = None
             self._admitting_blocks = None
-            self.engine.allocator.free(blocks)
+            self.engine.release_admission(table, shared_idx, entries)
             self._free_slots.append(slot)
             if self.supervisor.failed:
                 # half-open probe against a still-dead engine: a HELD
@@ -1097,7 +1195,7 @@ class ContinuousBatchingScheduler:
             # a single-sequence step needs no bisection to assign blame
             self._admitting = None
             self._admitting_blocks = None
-            self.engine.allocator.free(blocks)
+            self.engine.release_admission(table, shared_idx, entries)
             self._free_slots.append(slot)
             err = PoisonedRequestError(
                 f"request {req.id} produced non-finite logits at prefill",
@@ -1112,7 +1210,18 @@ class ContinuousBatchingScheduler:
                 self.stats.incr("failed")
                 self.recovery_stats.incr("quarantined")
             return True
-        state = _Running(req, slot, blocks, cached_len=len(req.prompt), admitted_seq=next(self._admitted_seq))
+        # the prompt's freshly written full blocks join the radix index
+        # AFTER the finiteness gate — poisoned K/V must never become
+        # shared content another request could reuse (reuse telemetry
+        # also counts here, so failed admissions never inflate it)
+        self.engine.register_prefix(
+            req.prompt, table, shared_idx, entries, prefix_len=prefix_len
+        )
+        state = _Running(
+            req, slot, table, cached_len=len(req.prompt),
+            admitted_seq=next(self._admitted_seq),
+            shared_idx=shared_idx, shared_entries=entries,
+        )
         self._running[slot] = state
         # clear only AFTER slot registration: cache_report reads
         # _running first and dedupes by request id, so the blocks are
@@ -1149,6 +1258,7 @@ class ContinuousBatchingScheduler:
             prompt_len=len(req.prompt), occupancy=len(self._running),
             queue_depth=len(self._queue),
             blocks_free=self.engine.allocator.num_free,
+            prefix_reused=prefix_len,
         )
         self.token_rate.record(1)
         if req.finished():
@@ -1187,6 +1297,15 @@ class ContinuousBatchingScheduler:
                 if len(state.blocks) >= need:
                     break
                 got = self.engine.allocator.allocate(1)
+                if got is None:
+                    # evict an unreferenced cached prefix (offloading it
+                    # to the host tier) before shrinking anyone's window
+                    # or preempting a live sequence; the swap-out device
+                    # read rides the heartbeat for the watchdog
+                    with self._stamped():
+                        reclaimed = self.engine.reclaim_cached(1)
+                    if reclaimed:
+                        got = self.engine.allocator.allocate(1)
                 if got is not None:
                     state.blocks.extend(got)
                     continue
@@ -1203,6 +1322,7 @@ class ContinuousBatchingScheduler:
 
     def _preempt_self(self, state: _Running) -> None:
         self.capacity.note_preempt(len(state.blocks))
+        self.engine.stash_prefix(state)  # see _preempt_youngest
         self._release(state)
         req = state.req
         req.prompt = req.original_prompt + list(req.generated)

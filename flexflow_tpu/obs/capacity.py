@@ -79,11 +79,16 @@ class CacheTelemetry:
         clock: Callable[[], float] = time.monotonic,
         pressure_threshold: float = 0.10,
         enabled: bool = True,
+        reclaimable: Optional[Callable[[], int]] = None,
     ):
         self.allocator = allocator
         self.clock = clock
         self.enabled = enabled
         self.pressure_threshold = pressure_threshold
+        # blocks reclaimable on demand (unreferenced cached prefixes —
+        # generation/prefix.py): available for admission, so a warm but
+        # idle cache does not read as pressure
+        self.reclaimable = reclaimable or (lambda: 0)
         # cumulative counters (loop-thread writes only)
         self.preempt_reclaimed_blocks = 0
         self.preempt_reclaims = 0
@@ -105,7 +110,8 @@ class CacheTelemetry:
         if self._last_tick is not None and self._was_under:
             self.time_at_pressure_s += max(0.0, now - self._last_tick)
         total = self.allocator.num_total
-        self._was_under = self.allocator.num_free <= total * self.pressure_threshold
+        available = self.allocator.num_free + self.reclaimable()
+        self._was_under = available <= total * self.pressure_threshold
         self._last_tick = now
 
     def note_preempt(self, n_blocks: int) -> None:
@@ -163,13 +169,16 @@ class CacheTelemetry:
 
     def report(
         self, running: Sequence, queue_depth: int = 0, admitting=None,
-        free: Optional[int] = None,
+        free: Optional[int] = None, prefix: Optional[Dict] = None,
     ) -> Dict:
         """The ``GET /v2/debug/cache`` payload: allocator state,
         watermarks, counters, and the per-request residency table.
 
-        Residency invariant (tests/test_capacity.py): the table's block
-        counts sum to exactly ``used``. That includes an admission in
+        Residency invariant (tests/test_capacity.py): the table's
+        PRIVATE block counts (``blocks - shared_blocks``) plus the
+        prefix index's resident blocks sum to exactly ``used`` —
+        shared blocks are counted once by the index however many
+        sequences reference them. That includes an admission in
         flight — blocks are allocated BEFORE the prefill device call
         (seconds, on a cold compile), so ``admitting`` = (request,
         blocks) renders as a provisional ``"admitting": True`` row
@@ -189,10 +198,16 @@ class CacheTelemetry:
         residency = []
         for s in sorted(running, key=lambda s: s.slot):
             allocated_slots = len(s.blocks) * bs
+            # shared blocks are index-owned (prefix cache): counted in
+            # the prefix tier's residency, not as this request's private
+            # footprint — with sharing, per-row block counts can
+            # legitimately sum past ``used``
+            shared = len(getattr(s, "shared_idx", ()) or ())
             residency.append({
                 "request_id": s.req.id,
                 "slot": s.slot,
                 "blocks": len(s.blocks),
+                "shared_blocks": shared,
                 "allocated_slots": allocated_slots,
                 "live_tokens": s.cached_len,
                 "frag_slots": max(0, allocated_slots - s.cached_len),
@@ -207,6 +222,7 @@ class CacheTelemetry:
                     "request_id": adm_req.id,
                     "slot": None,
                     "blocks": len(adm_blocks),
+                    "shared_blocks": 0,  # private (pre-prefill) blocks only
                     "allocated_slots": allocated_slots,
                     "live_tokens": 0,  # prefill still running
                     "frag_slots": allocated_slots,
@@ -251,6 +267,11 @@ class CacheTelemetry:
             },
             "queue_depth": queue_depth,
             "residency": residency,
+            # prefix-cache tiering (generation/prefix.py): the
+            # conservation invariant becomes
+            #   sum(row private blocks) + prefix resident == used
+            # with host-tier bytes accounted separately from HBM
+            "prefix_cache": prefix or {},
         }
 
 

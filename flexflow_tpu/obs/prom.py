@@ -81,6 +81,15 @@ _HELP = {
     "perf_prediction_error_p50": "Median per-program absolute relative error of step-time predictions.",
     "perf_prediction_error_max": "Worst per-program absolute relative error of step-time predictions.",
     "perf_drift_alarms": "Calibration-drift alarms raised by the engine's truth ledger.",
+    "prefix_cache_hit_ratio": "Admissions that reused cached prefix blocks / all admissions.",
+    "prefix_cache_blocks_reused_total": "Cached KV blocks reused by admissions instead of recomputed (cumulative).",
+    "prefix_cache_tokens_reused_total": "Prompt token positions served from cached KV instead of prefill (cumulative).",
+    "prefix_cache_cow_copies_total": "Copy-on-write block copies at divergent appends into shared blocks (cumulative).",
+    "prefix_cache_swaps_in_total": "KV blocks swapped in from the host-RAM tier (cumulative).",
+    "prefix_cache_swaps_out_total": "KV blocks offloaded to the host-RAM tier (cumulative).",
+    "prefix_cache_host_bytes": "Bytes currently resident in the host-RAM KV tier.",
+    "prefix_cache_resident_blocks": "Device blocks currently owned by the prefix index.",
+    "prefix_cache_offloaded_blocks": "Prefix blocks currently on the host-RAM tier.",
     "flexflow_sim_prediction_error_ratio": "Signed relative error of simulator/cost-model predictions vs measured time, per key quantile.",
     "flexflow_sim_prediction_pairs_total": "Measured samples joined with a registered prediction, per key.",
     "flexflow_sim_prediction_unpredicted_total": "Measured samples that had no registered prediction (counted, not dropped).",

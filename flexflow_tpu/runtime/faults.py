@@ -71,6 +71,8 @@ GENERATION_PREFILL = "generation.prefill"
 GENERATION_DECODE_STEP = "generation.decode_step"
 GENERATION_VERIFY = "generation.verify"
 GENERATION_JOURNAL_REPLAY = "generation.journal_replay"
+GENERATION_PREFIX_LOOKUP = "generation.prefix_lookup"
+GENERATION_KV_OFFLOAD = "generation.kv_offload"
 FLEET_ROUTE = "fleet.route"
 FLEET_REPLICA_SPAWN = "fleet.replica_spawn"
 
@@ -96,6 +98,16 @@ SITES = MappingProxyType({
     GENERATION_JOURNAL_REPLAY: (
         "top of each supervisor journal-replay restart (an error here is a "
         "double fault)"
+    ),
+    GENERATION_PREFIX_LOOKUP: (
+        "before each radix prefix-index lookup at admission (value: prompt "
+        "tokens); an error degrades to a cache miss — full recompute, "
+        "byte-exact output"
+    ),
+    GENERATION_KV_OFFLOAD: (
+        "around host-tier KV block swaps (value: ('in'|'out', n_blocks)); an "
+        "error on swap-in falls back to recompute, on swap-out drops the "
+        "block instead of offloading"
     ),
     FLEET_ROUTE: (
         "before each fleet routing decision (value: (prompt tokens, "

@@ -8,9 +8,12 @@ continuous-batching scheduler, supervisor, circuit breaker, step
 watchdog, and flight ring — and three cooperating pieces:
 
 * :class:`FleetRouter` — places each request by **prefix affinity**
-  (longest shared prefix with a replica's resident / recently routed
-  prompts, so shared system prompts land where their KV neighbors
-  live) and **least-loaded score** computed from the PR 5/6 telemetry
+  (resident-block overlap against each replica's radix prefix index —
+  the engine's REAL reusable KV, generation/prefix.py — plus
+  block-aligned overlap with prompts already queued/running there, so
+  shared system prompts land where their KV actually lives and the
+  engine's prefix cache turns the placement into skipped prefill
+  compute) and **least-loaded score** computed from the PR 5/6 telemetry
   already on every replica: queue depth, slot occupancy, free KV
   blocks, and TTFT error-budget burn. Affinity only breaks load ties
   (within ``TIE_MARGIN``): a skewed replica loses traffic no matter how
@@ -98,8 +101,6 @@ class Replica:
         self.model = model
         self.state = ReplicaState.ACTIVE
         self.since = 0.0  # last state-transition time (fleet clock)
-        # router affinity memory: recently routed prompts (prefix-capped)
-        self.recent_prompts: deque = deque(maxlen=8)
         # health-signal edge detection for the fleet supervisor
         self.seen_watchdog_trips = 0
         self.breaker_open_checks = 0  # consecutive checks observed OPEN
@@ -169,27 +170,37 @@ class FleetRouter:
         return burn
 
     def affinity(self, replica: Replica, prompt: Sequence[int]) -> int:
-        """Longest common prefix (tokens) between ``prompt`` and the
-        replica's resident or recently routed prompts — the requests
-        whose KV blocks are (or were just) hot on that engine. Reads
-        live structures owned by other threads (the loop thread mutates
-        _running; concurrent submits append recent prompts), so a
-        mid-iteration mutation degrades to zero affinity rather than
-        failing the route."""
+        """Reusable-KV overlap (tokens) between ``prompt`` and the
+        replica: the radix prefix index's actual matched run (resident
+        or host-tier blocks the engine would reuse instead of
+        prefilling), plus the block-aligned common prefix with prompts
+        already queued or running there — KV that will be cached by the
+        time this request admits. Replaces the old recently-routed
+        string comparison, which scored KV that might be long evicted
+        and counted sub-block overlap no engine can reuse. Reads live
+        structures owned by other threads (the loop thread mutates
+        _running; the index mutates at admissions), so a mid-iteration
+        mutation degrades to zero affinity rather than failing the
+        route."""
         try:
-            seen: List[Tuple[int, ...]] = list(replica.recent_prompts)
-            for st in list(replica.scheduler._running.values()):
-                seen.append(tuple(st.req.original_prompt[: self.PREFIX_CAP]))
+            engine = replica.engine
+            best = engine.prefix_cache.probe(prompt[: self.PREFIX_CAP])
+            bs = engine.cache_config.block_size
+            cap = max(0, len(prompt) - 1)
+            sched = replica.scheduler
+            pending = [r.original_prompt for r in list(sched._queue)]
+            pending += [
+                st.req.original_prompt for st in list(sched._running.values())
+            ]
+            for p in pending:
+                n = 0
+                for a, b in zip(p[: self.PREFIX_CAP], prompt):
+                    if a != b:
+                        break
+                    n += 1
+                best = max(best, min((n // bs) * bs, cap))
         except RuntimeError:
             return 0
-        best = 0
-        for p in seen:
-            n = 0
-            for a, b in zip(p, prompt):
-                if a != b:
-                    break
-                n += 1
-            best = max(best, n)
         return best
 
     # ------------------------------------------------------------ routing
@@ -234,7 +245,6 @@ class FleetRouter:
             else:
                 choice, reason = near[0], "least_loaded"
         self.stats.note_decision(reason)
-        choice.recent_prompts.append(tuple(prompt[: self.PREFIX_CAP]))
         return choice, reason
 
     def place_failover(self, replicas: List[Replica]) -> Optional[Replica]:

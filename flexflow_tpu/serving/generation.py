@@ -222,6 +222,10 @@ class GenerationModel:
                 "usable_tokens": cc.usable_tokens,
                 "bytes": cc.total_bytes,
             },
+            "prefix_cache": {
+                "enabled": self.engine.prefix_cache.enabled,
+                "host_budget_bytes": self.engine.prefix_cache.host_budget_bytes,
+            },
             "inputs": [{"name": "tokens", "shape": (-1,), "datatype": "INT32"}],
             "outputs": [{"name": "tokens", "shape": (-1,), "datatype": "INT32"}],
         }

@@ -22,6 +22,16 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
 
+def assert_blocks_conserved(engine):
+    """Post-drain allocator invariant under prefix caching: every block
+    still out of the free list is owned by the radix prefix index (warm
+    reusable KV), never leaked by a sequence."""
+    used = engine.allocator.num_total - engine.allocator.num_free
+    assert used == engine.prefix_cache.resident_blocks, (
+        used, engine.prefix_cache.snapshot(),
+    )
+
+
 class FakeClock:
     """Virtual time for injectable-clock tests (deadlines, breaker
     recovery windows, SLO burn windows, time-at-pressure). One shared

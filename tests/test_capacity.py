@@ -68,11 +68,21 @@ def small_engine(decoder_params, num_blocks=None, slots=3, block_size=8, **kw):
 
 def check_conservation(sched):
     """The tentpole's accounting invariants, asserted from the public
-    debug report."""
+    debug report — extended for prefix-cache tiering: per-request
+    PRIVATE blocks plus the index's resident blocks sum to used
+    (shared blocks count once however many streams reference them),
+    and the host tier's byte accounting matches its block count."""
     rep = sched.cache_report()
     blocks = rep["blocks"]
+    pc = rep["prefix_cache"]
     assert blocks["used"] + blocks["free"] == blocks["total"], blocks
-    assert sum(r["blocks"] for r in rep["residency"]) == blocks["used"], rep
+    private = sum(r["blocks"] - r["shared_blocks"] for r in rep["residency"])
+    assert private + pc["resident_blocks"] == blocks["used"], rep
+    assert pc["shared_blocks"] <= pc["resident_blocks"]
+    assert (
+        pc["offloaded_blocks"] * rep["config"]["bytes_per_block"]
+        == pc["host_bytes"]
+    ), pc
     assert all(r["frag_slots"] >= 0 for r in rep["residency"])
     assert rep["fragmentation_slots"] == sum(r["frag_slots"] for r in rep["residency"])
 
@@ -122,12 +132,17 @@ def test_allocator_conservation_property(decoder_params):
             break
         check_conservation(sched)
     rep = sched.cache_report()
-    assert rep["blocks"]["used"] == 0
+    # terminal state: everything still out is warm prefix cache —
+    # shared (index), resident, offloaded, and free sum to totals
+    assert rep["blocks"]["used"] == rep["prefix_cache"]["resident_blocks"]
     assert rep["residency"] == []
     alloc = eng.allocator
     # cumulative conservation: every block handed out came back through
-    # free() or a wholesale reset reclaim
-    assert alloc.total_allocated == alloc.total_freed + alloc.total_reset_reclaimed
+    # free(), a wholesale reset reclaim, or is still index-owned
+    assert alloc.total_allocated == (
+        alloc.total_freed + alloc.total_reset_reclaimed
+        + rep["prefix_cache"]["resident_blocks"]
+    )
     assert alloc.low_water < alloc.num_total  # pressure actually happened
 
 
@@ -150,7 +165,10 @@ def test_fragmentation_and_watermarks(decoder_params):
         if not sched.step():
             break
     rep = sched.cache_report()
-    assert rep["blocks"]["used"] == 0 and rep["fragmentation_slots"] == 0
+    # the finished request's full prompt block stays behind as warm
+    # prefix cache (index-owned); fragmentation is running-only
+    assert rep["blocks"]["used"] == rep["prefix_cache"]["resident_blocks"]
+    assert rep["fragmentation_slots"] == 0
     assert eng.allocator.high_water == eng.allocator.num_total
 
 

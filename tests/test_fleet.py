@@ -127,18 +127,55 @@ GREEDY = SamplingParams(max_new_tokens=12)
 
 
 def test_router_prefix_affinity_wins_ties(decoder_params):
+    """Affinity is reusable KV: at least one FULL cache block (BLOCK
+    tokens) of shared prefix that is — or will be — resident on the
+    replica. Sub-block overlap scores zero: no engine can reuse it."""
+    a = [7] * BLOCK  # template A: exactly one block
+    b = [5] * BLOCK  # template B
     fleet = make_fleet(decoder_params, n=2, warmup=False)
-    fleet.submit([7, 7, 7, 1], GREEDY)   # empty fleet -> least id (r0)
-    fleet.submit([5, 5, 5, 2], GREEDY)   # skew 1 vs 0 -> r1
+    fleet.submit(a + [1], GREEDY)        # empty fleet -> least id (r0)
+    fleet.submit(b + [2], GREEDY)        # skew 1 vs 0 -> r1
     # loads tied again (1, 1): the shared-prefix prompt must follow its
-    # prefix to r1, not fall back to replica order
-    fleet.submit([5, 5, 5, 9, 9], GREEDY)
+    # (soon-to-be-cached) template block to r1, not replica order
+    fleet.submit(b + [9, 9], GREEDY)
     r0, r1 = fleet.replicas
     assert [r.id for r in (r0, r1)] == ["r0", "r1"]
     assert len(r0.scheduler._queue) == 1
     assert len(r1.scheduler._queue) == 2
     assert fleet.fleet_stats.decisions()["affinity"] == 1
     assert fleet.fleet_stats.decisions()["least_loaded"] == 2
+    # sub-block overlap is NOT affinity: rebalance to a (2, 2) tie,
+    # then a 3-token LCP with r1's prompts must not attract — the tie
+    # breaks by replica id instead
+    fleet.submit(a + [3], GREEDY)        # skew (1, 2) -> r0
+    fleet.submit([5, 5, 5, 1, 2, 3], GREEDY)  # tie, 3-token LCP only
+    assert fleet.fleet_stats.decisions()["affinity"] == 1
+    assert len(r0.scheduler._queue) == 3
+
+
+def test_router_affinity_scores_radix_index(decoder_params):
+    """After a replica actually serves a templated request, affinity
+    comes from its engine's RADIX INDEX — real resident KV blocks —
+    not from any recently-routed prompt list: the queue is empty, the
+    request long finished, and the prefix still attracts."""
+    template = [3] * (2 * BLOCK)
+    fleet = make_fleet(decoder_params, n=2, warmup=False)
+    r0, r1 = fleet.replicas
+    # serve one templated request to completion on r1 ONLY
+    h = r1.model.submit(template + [4], GREEDY)
+    while not h.done():
+        fleet.step()
+    assert r1.engine.prefix_cache.resident_blocks == 2
+    assert r1.scheduler.has_work() is False
+    # loads are tied (0, 0); the template must follow its cached blocks
+    fleet.submit(template + [9], GREEDY)
+    assert len(r1.scheduler._queue) == 1
+    assert len(r0.scheduler._queue) == 0
+    assert fleet.fleet_stats.decisions()["affinity"] == 1
+    # and the probe sees exactly the cached token run (capped len-1)
+    assert fleet.router.affinity(r1, template + [9]) == 2 * BLOCK
+    assert fleet.router.affinity(r0, template + [9]) == 0
+    fleet.stop()
 
 
 def test_router_least_loaded_under_skew(decoder_params):

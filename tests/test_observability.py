@@ -233,6 +233,16 @@ def _golden_stats():
     s.add_gauge("perf_prediction_error_p50", lambda: 0.5)
     s.add_gauge("perf_prediction_error_max", lambda: 2)
     s.add_gauge("perf_drift_alarms", lambda: 1)
+    # prefix caching / KV tiering families (binary-exact values)
+    s.add_gauge("prefix_cache_hit_ratio", lambda: 0.75)
+    s.add_gauge("prefix_cache_blocks_reused_total", lambda: 6)
+    s.add_gauge("prefix_cache_tokens_reused_total", lambda: 96)
+    s.add_gauge("prefix_cache_cow_copies_total", lambda: 1)
+    s.add_gauge("prefix_cache_swaps_in_total", lambda: 2)
+    s.add_gauge("prefix_cache_swaps_out_total", lambda: 3)
+    s.add_gauge("prefix_cache_host_bytes", lambda: 4096)
+    s.add_gauge("prefix_cache_resident_blocks", lambda: 5)
+    s.add_gauge("prefix_cache_offloaded_blocks", lambda: 2)
     return s
 
 

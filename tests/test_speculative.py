@@ -43,6 +43,8 @@ from flexflow_tpu.runtime import faults
 from flexflow_tpu.runtime.faults import FaultInjected, FaultPlan, TransientDeviceError
 from flexflow_tpu.serving import RetryPolicy
 
+from conftest import assert_blocks_conserved  # noqa: E402
+
 pytestmark = pytest.mark.speculative
 
 CFG = TransformerConfig(
@@ -445,7 +447,7 @@ def test_preempt_with_speculation_recomputes_exactly(spec_engine, decoder_params
     got = [h.result(timeout=0) for h in handles]
     assert got == want
     assert sched.preemptions > 0, "cache was too roomy to exercise preemption"
-    assert tight.allocator.num_free == tight.allocator.num_total
+    assert_blocks_conserved(tight)
 
 
 def test_block_boundary_partial_acceptance_accounting(decoder_params):
@@ -471,7 +473,7 @@ def test_block_boundary_partial_acceptance_accounting(decoder_params):
     for h in handles:
         out = h.result(timeout=0)
         assert 1 <= len(out) <= 18
-    assert engine.allocator.num_free == engine.allocator.num_total
+    assert_blocks_conserved(engine)
     ss = sched.spec_stats
     assert ss.accepted <= ss.proposed
     assert ss.emitted >= ss.accepted
@@ -540,7 +542,7 @@ def test_chaos_verify_poison_fails_batch(spec_engine):
                 break
     with pytest.raises(FaultInjected):
         h.result(timeout=0)
-    assert engine.allocator.num_free == engine.allocator.num_total
+    assert_blocks_conserved(engine)
 
 
 # ---------------------------------------------------------------------------

@@ -67,6 +67,13 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def no_leaked_blocks(engine) -> bool:
+    """Post-drain allocator invariant under prefix caching: blocks not
+    on the free list are exactly the radix index's warm reusable KV."""
+    used = engine.allocator.num_total - engine.allocator.num_free
+    return used == engine.prefix_cache.resident_blocks
+
+
 def run_recovery_sweep() -> bool:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, REPO)
@@ -140,7 +147,7 @@ def run_recovery_sweep() -> bool:
     rs = sched.recovery_stats
     check("crash", got == ref, f"streams diverged after crash replay: {got} != {ref}")
     check("crash", rs.recoveries == 1, f"expected 1 recovery, got {rs.recoveries}")
-    check("crash", eng.allocator.num_free == eng.allocator.num_total, "leaked blocks")
+    check("crash", no_leaked_blocks(eng), "leaked blocks")
     report["crash"] = {"recoveries": rs.recoveries,
                       "replayed_tokens": rs.replayed_tokens, "exact": got == ref}
 
@@ -218,7 +225,7 @@ def run_recovery_sweep() -> bool:
                       f"survivor stream {i} diverged")
         check("nan", rs.quarantined == 1, f"expected 1 quarantine, got {rs.quarantined}")
         check("nan", rs.recoveries == 0, "partial NaN blame must not restart the engine")
-        check("nan", eng.allocator.num_free == eng.allocator.num_total, "leaked blocks")
+        check("nan", no_leaked_blocks(eng), "leaked blocks")
         report["nan"] = {"quarantined": rs.quarantined, "poison_token": poison_tok}
 
     # ------------------------------------------------- double fault (replay)
@@ -411,7 +418,7 @@ def run_fleet_sweep() -> bool:
     check("crash", all(r.state == ReplicaState.ACTIVE for r in fleet.replicas),
           "fleet not whole after replacement")
     for r in fleet.replicas:
-        check("crash", r.engine.allocator.num_free == r.engine.allocator.num_total,
+        check("crash", no_leaked_blocks(r.engine),
               f"leaked blocks on {r.id}")
     report["crash"] = {"failovers": fs["failovers"],
                        "migrated_streams": fs["migrated_streams"],

@@ -27,6 +27,20 @@ tokens-per-engine-step speedup vs the baseline. The retrace guard
 extends to the verify program (exactly one compile), and the run fails
 below ``--min-speedup`` (default 1.5x).
 
+``--shared-prefix`` benchmarks CROSS-REQUEST PREFIX CACHING
+(generation/prefix.py) on its home workload: N requests drawn from K
+shared templates (long common prefix + short unique suffix — the
+system-prompt/few-shot shape). The same stream runs on a cache-off and
+a cache-on engine (programs warmed on both, so the measurement is
+steady state): reports TTFT p50/p95 per arm, prefill tokens computed
+vs reused, COW copies and host-tier swaps, and FAILS unless cache-on
+improves TTFT p50 by ``--min-ttft-improvement`` (default 2x), reuses
+at least ``--min-reuse`` (default 50%) of prefill tokens, adds ZERO
+steady-state retraces, and produces byte-identical token streams.
+The other modes build their engines with the prefix cache DISABLED so
+their BENCH_HISTORY trajectories stay comparable across the feature
+boundary.
+
 ``--trace-out FILE`` benchmarks the OBSERVABILITY layer instead: the
 same steady-state request stream runs with tracing disabled and enabled
 (interleaved, best-of-``--trace-repeats``), asserting that per-request
@@ -63,6 +77,7 @@ import numpy as np
 sys.path.insert(0, ".")
 
 from flexflow_tpu.generation import (  # noqa: E402
+    CacheConfig,
     ContinuousBatchingScheduler,
     GenerationEngine,
     SamplingParams,
@@ -151,6 +166,12 @@ def _history_metrics(mode: str, report: dict) -> dict:
         }
     if mode == "trace_overhead":
         return {"tracing_overhead": report.get("tracing_overhead")}
+    if mode == "shared_prefix":
+        return {
+            "ttft_p50_improvement": report.get("ttft_p50_improvement"),
+            "prefill_reuse_ratio": report.get("prefill_reuse_ratio"),
+            "ttft_p50_cached_s": report.get("ttft_p50_cached_s"),
+        }
     return {}
 
 
@@ -243,14 +264,14 @@ def speculate_bench(args, cfg, params) -> tuple:
     spec = SpeculationConfig(k=args.spec_k, method="ngram")
 
     base_eng = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16,
-                                max_spec_tokens=args.spec_k)
+                                max_spec_tokens=args.spec_k, prefix_cache=False)
     base_eng.generate([prompts[0]], SamplingParams(max_new_tokens=2))
     for b in sorted({base_eng.bucket_for(len(p)) for p in prompts}):
         base_eng.generate([[1] * min(b, args.seq_len - 2)], SamplingParams(max_new_tokens=2))
     base_warm_steps = dict(base_eng.step_counts)
     base_out, base_sched, base_s = run_stream(base_eng, prompts, sampling)
     spec_eng = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16,
-                                max_spec_tokens=args.spec_k)
+                                max_spec_tokens=args.spec_k, prefix_cache=False)
     # warm every prefill bucket + the verify/decode programs so the
     # measured stream is steady state for the retrace guard
     spec_eng.generate([prompts[0]], SamplingParams(max_new_tokens=4), speculation=spec)
@@ -324,6 +345,148 @@ def speculate_bench(args, cfg, params) -> tuple:
     return report, ok
 
 
+def shared_prefix_bench(args, cfg, params) -> tuple:
+    """Cross-request prefix caching on the shared-template workload:
+    the same stream through a cache-off and a cache-on engine. Returns
+    (report dict, ok bool)."""
+    rs = np.random.RandomState(2)
+    max_new = args.max_new if args.max_new_set else 4
+    template_len = args.template_len
+    if template_len + 16 + max_new >= args.seq_len:
+        print(
+            f"--template-len {template_len} leaves no room for suffix + "
+            f"--max-new {max_new} under --seq-len {args.seq_len}",
+            file=sys.stderr,
+        )
+        return {}, False
+    templates = [
+        rs.randint(0, args.vocab, template_len).tolist()
+        for _ in range(args.templates)
+    ]
+    prompts = [
+        templates[i % args.templates]
+        + rs.randint(0, args.vocab, int(rs.randint(4, 12))).tolist()
+        for i in range(args.requests)
+    ]
+    sampling = SamplingParams(max_new_tokens=max_new)
+    # cache sized so reuse, not eviction, is what gets measured: room
+    # for every slot at max_seq_len PLUS every template's warm blocks
+    bs = 16
+    per_seq = -(-args.seq_len // bs)
+    per_template = -(-template_len // bs)
+    cache = CacheConfig(
+        num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+        head_dim=cfg.hidden_size // cfg.num_heads, block_size=bs,
+        num_blocks=1 + per_seq * args.slots + per_template * args.templates + 4,
+    )
+
+    def build(enabled):
+        eng = GenerationEngine(
+            params, cfg, cache_config=cache, max_batch_slots=args.slots,
+            prefix_cache=enabled,
+        )
+        # warm the decode program + every full-prompt bucket; the
+        # cache-on engine additionally warms the suffix-prefill bucket
+        # AND the template blocks themselves (steady state for a
+        # serving fleet is a hot template cache — and the retrace
+        # guard requires zero compiles inside the measured stream)
+        eng.generate([prompts[0]], SamplingParams(max_new_tokens=2))
+        for b in sorted({eng.bucket_for(len(p)) for p in prompts}):
+            eng.generate([[1] * min(b, args.seq_len - 2)], SamplingParams(max_new_tokens=1))
+        if enabled:
+            for t in templates:
+                eng.generate([t + [1, 2, 3, 4]], SamplingParams(max_new_tokens=1))
+        return eng
+
+    eng_off = build(False)
+    warm_off = dict(eng_off.trace_counts)
+    eng_on = build(True)
+    warm_on = dict(eng_on.trace_counts)
+    pc = eng_on.prefix_cache
+
+    def ttft(sched):
+        snap = sched.stats.window_snapshots().get("ttft", {})
+        return snap.get("p50_s"), snap.get("p95_s")
+
+    # interleave the arms best-of-N (same discipline as the tracing-
+    # overhead bench): host jitter on a loaded CI box easily exceeds
+    # the per-arm gap of a single pass, and interleaving hits both
+    # arms with the same drift
+    off_runs, on_runs = [], []
+    out_off = out_on = None
+    reused = 0
+    prompt_tokens = sum(len(p) for p in prompts)
+    for _ in range(args.prefix_repeats):
+        out_off, sched_off, s_off = run_stream(eng_off, prompts, sampling)
+        off_runs.append((ttft(sched_off), s_off, sched_off))
+        reused_before = pc.tokens_reused_total
+        out_on, sched_on, s_on = run_stream(eng_on, prompts, sampling)
+        reused = pc.tokens_reused_total - reused_before
+        on_runs.append((ttft(sched_on), s_on, sched_on))
+    (off_p50, off_p95), s_off, sched_off = min(off_runs, key=lambda r: r[0][0])
+    (on_p50, on_p95), s_on, sched_on = min(on_runs, key=lambda r: r[0][0])
+    improvement = (off_p50 or 0.0) / max(on_p50 or 1e-9, 1e-9)
+    reuse_ratio = reused / max(1, prompt_tokens)
+    steady_retraces = {}
+    for eng, warm in ((eng_off, warm_off), (eng_on, warm_on)):
+        for k in eng.trace_counts:
+            d = eng.trace_counts[k] - warm.get(k, 0)
+            if d > 0:
+                steady_retraces[k] = steady_retraces.get(k, 0) + d
+    pcs = pc.snapshot()
+    report = {
+        "requests": args.requests,
+        "templates": args.templates,
+        "template_len": template_len,
+        "prompt_tokens": prompt_tokens,
+        "generated_tokens": sum(len(o) for o in out_on),
+        "exact": out_off == out_on,
+        "ttft_p50_uncached_s": off_p50,
+        "ttft_p95_uncached_s": off_p95,
+        "ttft_p50_cached_s": on_p50,
+        "ttft_p95_cached_s": on_p95,
+        "ttft_p50_improvement": round(improvement, 3),
+        "prefill_tokens_computed": prompt_tokens - reused,
+        "prefill_tokens_reused": reused,
+        "prefill_reuse_ratio": round(reuse_ratio, 3),
+        "hit_ratio": pcs["hit_ratio"],
+        "cow_copies": pcs["cow_copies_total"],
+        "swaps_in": pcs["swaps_in_total"],
+        "swaps_out": pcs["swaps_out_total"],
+        "host_bytes": pcs["host_bytes"],
+        "uncached_stream_s": round(s_off, 4),
+        "cached_stream_s": round(s_on, 4),
+        "steady_state_retraces": steady_retraces,
+        "capacity": capacity_block(sched_on),
+        "backend": jax.default_backend(),
+    }
+    ok = check_no_self_healing(
+        report, [sched_off, sched_on], [eng_off, eng_on]
+    )
+    print(json.dumps(report, indent=2))
+    if not report["exact"]:
+        print("FAIL: cached token streams differ from uncached", file=sys.stderr)
+        ok = False
+    if steady_retraces:
+        print(f"FAIL: steady-state stream retraced: {steady_retraces}", file=sys.stderr)
+        ok = False
+    if improvement < args.min_ttft_improvement:
+        print(
+            f"FAIL: TTFT p50 improvement {improvement:.2f}x < required "
+            f"{args.min_ttft_improvement}x",
+            file=sys.stderr,
+        )
+        ok = False
+    if reuse_ratio < args.min_reuse:
+        print(
+            f"FAIL: prefill reuse {reuse_ratio:.1%} < required "
+            f"{args.min_reuse:.0%}",
+            file=sys.stderr,
+        )
+        ok = False
+    return report, ok
+
+
 def trace_overhead_bench(args, cfg, params) -> tuple:
     """Tracing-overhead guard: the same steady-state stream with
     observability off vs on, interleaved best-of-N. Returns
@@ -333,7 +496,8 @@ def trace_overhead_bench(args, cfg, params) -> tuple:
     prompts = [rs.randint(0, args.vocab, n).tolist() for n in lengths]
     sampling = SamplingParams(max_new_tokens=args.max_new)
 
-    engine = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16)
+    engine = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16,
+                              prefix_cache=False)
     # warm every bucket + the decode program: the measured streams must
     # be pure steady state or compile time drowns the comparison
     engine.generate([prompts[0]], SamplingParams(max_new_tokens=2))
@@ -424,17 +588,34 @@ def main() -> int:
     ap.add_argument("--out", default="")
     ap.add_argument("--requests", type=int, default=12)
     ap.add_argument("--max-new", type=int, default=None,
-                    help="tokens per request (default 16; 48 with --speculate)")
-    ap.add_argument("--layers", type=int, default=2)
+                    help="tokens per request (default 16; 48 with "
+                         "--speculate; 2 with --shared-prefix)")
+    ap.add_argument("--layers", type=int, default=None,
+                    help="decoder layers (default 2; 4 with --shared-prefix)")
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--vocab", type=int, default=128)
-    ap.add_argument("--slots", type=int, default=4)
-    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--slots", type=int, default=None,
+                    help="batch slots (default 4; 2 with --shared-prefix)")
+    ap.add_argument("--seq-len", type=int, default=None,
+                    help="max sequence length (default 128; 256 with "
+                         "--shared-prefix)")
     ap.add_argument("--speculate", action="store_true",
                     help="benchmark n-gram speculative decoding vs baseline")
     ap.add_argument("--spec-k", type=int, default=4)
     ap.add_argument("--min-speedup", type=float, default=1.5)
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="benchmark cross-request prefix caching on a "
+                         "shared-template workload (cache off vs on)")
+    ap.add_argument("--templates", type=int, default=3,
+                    help="distinct shared templates in the workload")
+    ap.add_argument("--template-len", type=int, default=224,
+                    help="shared template length (tokens)")
+    ap.add_argument("--min-ttft-improvement", type=float, default=2.0)
+    ap.add_argument("--min-reuse", type=float, default=0.5)
+    ap.add_argument("--prefix-repeats", type=int, default=3,
+                    help="interleaved (off, on) stream pairs; best-of-N "
+                         "TTFT per arm")
     ap.add_argument("--trace-out", default="",
                     help="benchmark tracing overhead; write report + "
                          "chrome timeline + sample trace to this file")
@@ -450,7 +631,17 @@ def main() -> int:
     args = ap.parse_args()
     args.max_new_set = args.max_new is not None
     if args.max_new is None:
-        args.max_new = 16
+        args.max_new = 2 if args.shared_prefix else 16
+        args.max_new_set = args.shared_prefix
+    # shared-prefix mode defaults to a prefill-dominated geometry: the
+    # TTFT gate measures skipped prefill compute, which a dispatch-
+    # bound tiny config would drown in per-step host overhead
+    if args.layers is None:
+        args.layers = 4 if args.shared_prefix else 2
+    if args.slots is None:
+        args.slots = 2 if args.shared_prefix else 4
+    if args.seq_len is None:
+        args.seq_len = 256 if args.shared_prefix else 128
 
     cfg = TransformerConfig(
         num_layers=args.layers, hidden_size=args.hidden, num_heads=args.heads,
@@ -471,6 +662,23 @@ def main() -> int:
         )
         return 0
 
+    if args.shared_prefix:
+        report, ok = shared_prefix_bench(args, cfg, params)
+        write_bench_artifact(args.bench_out, "shared_prefix", report)
+        append_history(args.history_out, "shared_prefix", report, ok)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2)
+        if not ok:
+            return 1
+        print(
+            f"OK: byte-identical streams at {report['ttft_p50_improvement']}x "
+            f"TTFT p50 ({report['prefill_reuse_ratio']:.0%} prefill tokens "
+            f"reused, {report['cow_copies']} COW copies), zero steady-state "
+            "retraces"
+        )
+        return 0
+
     if args.speculate:
         report, ok = speculate_bench(args, cfg, params)
         write_bench_artifact(args.bench_out, "speculate", report)
@@ -487,7 +695,8 @@ def main() -> int:
         )
         return 0
 
-    engine = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16)
+    engine = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16,
+                              prefix_cache=False)
     sched = ContinuousBatchingScheduler(engine)
 
     rs = np.random.RandomState(0)

@@ -153,12 +153,26 @@ def show_cache(base: str) -> int:
         print(f"    admission waits: {c['admission_waits']} "
               f"({c['admission_wait_s'] * 1e3:.1f}ms total)"
               + (f"  last: {c['last_wait_blame']}" if c.get("last_wait_blame") else ""))
+        pc = rep.get("prefix_cache") or {}
+        if pc.get("enabled"):
+            print(f"    prefix cache: hits={pc['hits']}/{pc['lookups']} "
+                  f"(ratio {pc['hit_ratio']:.2f})  "
+                  f"reused={pc['tokens_reused_total']} tokens / "
+                  f"{pc['blocks_reused_total']} blocks  "
+                  f"cow={pc['cow_copies_total']}")
+            print(f"    tiers: device={pc['resident_blocks']} block(s) "
+                  f"({pc['shared_blocks']} shared)  "
+                  f"host={pc['offloaded_blocks']} block(s) "
+                  f"({pc['host_bytes']}B of {pc['host_budget_bytes']}B)  "
+                  f"swaps in/out={pc['swaps_in_total']}/{pc['swaps_out_total']}  "
+                  f"fallbacks={pc['recompute_fallbacks']}")
         rows = rep.get("residency", [])
         if rows:
             print("    residency:")
-            print("      req       slot  blocks  alloc_slots  live_tokens  frag")
+            print("      req       slot  blocks  shared  alloc_slots  live_tokens  frag")
             for r in rows:
                 print(f"      {r['request_id']:<9} {r['slot']:<5} {r['blocks']:<7} "
+                      f"{r.get('shared_blocks', 0):<7} "
                       f"{r['allocated_slots']:<12} {r['live_tokens']:<12} "
                       f"{r['frag_slots']}")
         else:
@@ -378,14 +392,42 @@ def selfcheck() -> int:
         check(blocks["allocated_total"] == blocks["freed_total"]
               + blocks["reset_reclaimed_total"] + blocks["used"],
               f"cache conservation broken: {blocks}")
-        check(sum(r["blocks"] for r in cache["residency"]) == blocks["used"],
-              f"residency does not sum to used: {cache['residency']} vs {blocks}")
+        # tier conservation under prefix caching: per-request PRIVATE
+        # blocks + the radix index's resident blocks == used (shared
+        # blocks count once however many streams reference them), and
+        # host-tier bytes match its block count
+        pc = cache["prefix_cache"]
+        private = sum(r["blocks"] - r["shared_blocks"]
+                      for r in cache["residency"])
+        check(private + pc["resident_blocks"] == blocks["used"],
+              f"residency+prefix does not sum to used: "
+              f"{cache['residency']} {pc} vs {blocks}")
+        check(pc["offloaded_blocks"] * cache["config"]["bytes_per_block"]
+              == pc["host_bytes"],
+              f"host-tier bytes disagree with offloaded blocks: {pc}")
         check(blocks["low_water"] < blocks["total"],
               "low-water mark never moved despite served requests")
         for series in ("cache_occupancy", "mfu", "goodput_ratio",
-                       "slo_breaching_total"):
+                       "slo_breaching_total", "prefix_cache_hit_ratio",
+                       "prefix_cache_host_bytes"):
             check(f"flexflow_serving_{series}{{" in metrics,
                   f"/metrics missing {series}")
+
+        # ---------------- prefix caching: reuse is real and byte-exact
+        # the same templated prompt twice: the second admission must hit
+        # the radix index and reuse its cached full block, with
+        # identical tokens
+        tpl = [5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16]  # > 1 block of 8
+        code, first = post("/v2/models/lm/generate",
+                           {"prompt": tpl, "max_new_tokens": 6})
+        check(code == 200, f"templated generate failed: {code}")
+        reused_before = eng.prefix_cache.tokens_reused_total
+        code, second = post("/v2/models/lm/generate",
+                            {"prompt": tpl, "max_new_tokens": 6})
+        check(code == 200 and second["tokens"] == first["tokens"],
+              "prefix-cached repeat stream differs from first run")
+        check(eng.prefix_cache.tokens_reused_total > reused_before,
+              "repeat admission did not reuse cached prefix blocks")
 
         # -------------------- program registry: non-empty, blame works
         progs = _get_json(f"{base}/v2/debug/programs")
