@@ -34,6 +34,11 @@ CLOCK_WHITELIST: Dict[str, Union[str, FrozenSet[str]]] = {
     "flexflow_tpu/generation/engine.py": frozenset({"perf_counter"}),
     "flexflow_tpu/generation/scheduler.py": frozenset({"perf_counter"}),
     "flexflow_tpu/runtime/executor.py": frozenset({"perf_counter"}),
+    # Step-anatomy profiler (ISSUE 12): perf_counter-only physical
+    # profiling per the PR 6 dual-clock decision — it aggregates the
+    # engine/scheduler perf_counter span stamps and must never mix in
+    # the scheduler's injectable (possibly virtual) clock.
+    "flexflow_tpu/obs/steptrace.py": frozenset({"perf_counter"}),
 }
 
 # ----------------------------------------------------------- fault sites

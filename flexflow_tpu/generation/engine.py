@@ -189,12 +189,27 @@ class GenerationEngine:
         # host-call counters: engine steps actually issued (genbench's
         # tokens-per-engine-step accounting)
         self.step_counts: Dict[str, int] = {"prefill": 0, "decode": 0, "verify": 0}
-        # cumulative wall seconds inside each step kind's host API call
-        # (dispatch + device + result sync) — the device_time_s gauge
-        self.device_time_s: Dict[str, float] = {"prefill": 0.0, "decode": 0.0, "verify": 0.0}
+        # per-kind step-phase seconds (the device_time_s split, ISSUE
+        # 12): dispatch = host arg prep + XLA dispatch (jit call entry
+        # to return), execute = dispatch-return to block_until_ready
+        # completion (actual device compute under async dispatch),
+        # readback = device->host result sync + numpy conversion. The
+        # old device_time_s total survives as a derived property so the
+        # flight/stats consumers keep their series; MFU divides by
+        # execute-only seconds (obs/capacity.py convention change,
+        # documented in README "Step anatomy").
+        self.phase_time_s: Dict[str, Dict[str, float]] = {
+            k: {"dispatch": 0.0, "execute": 0.0, "readback": 0.0}
+            for k in ("prefill", "decode", "verify")
+        }
+        # spans of the most recent engine step (obs/steptrace.py):
+        # (phase, t0, t1) perf_counter stamps, overwritten per call —
+        # read by the scheduler loop thread that made the call, never
+        # concurrently
+        self.last_step_spans: List[Tuple[str, float, float]] = []
         # serving FLOPs accounting (obs/capacity.py): model-shaped FLOPs
         # per step kind — true prompt lengths and live context only, so
-        # MFU = flops / device_time_s / chip peak is padding-honest.
+        # MFU = flops / execute seconds / chip peak is padding-honest.
         # Recovery replay / bisection probes accrue in BOTH terms (they
         # are real device work); goodput_ratio is the client-useful view.
         # The chip comes from the detected device kind (the calibration
@@ -437,6 +452,30 @@ class GenerationEngine:
         )
 
     # ----------------------------------------------------------- host API
+    def _record_step_phases(
+        self, kind: str, t0: float, t_disp: float, t_exec: float
+    ) -> Tuple[float, float]:
+        """Stamp one step's dispatch/execute/readback split (called
+        after the result readback; stamps t_read itself) and publish
+        the spans for the scheduler's step-anatomy profiler. "block"
+        (host parked in block_until_ready) and "execute" (device
+        computing) cover the same interval today; they separate once
+        the overlap refactor dispatches ahead of the bookkeeping.
+        Returns (total_elapsed_s, execute_s) — the old conflated total
+        and the device-only seconds the truth ledger now pairs."""
+        t_read = time.perf_counter()
+        ph = self.phase_time_s[kind]
+        ph["dispatch"] += t_disp - t0
+        ph["execute"] += t_exec - t_disp
+        ph["readback"] += t_read - t_exec
+        self.last_step_spans = [
+            ("dispatch", t0, t_disp),
+            ("block", t_disp, t_exec),
+            ("execute", t_disp, t_exec),
+            ("readback", t_exec, t_read),
+        ]
+        return t_read - t0, t_exec - t_disp
+
     def prefill_one(
         self,
         prompt: Sequence[int],
@@ -475,16 +514,18 @@ class GenerationEngine:
             jnp.int32(sampling.top_k),
             key,
         )
+        t_disp = time.perf_counter()
+        jax.block_until_ready((token, ok, ck, cv))  # device execution done
+        t_exec = time.perf_counter()
         self.cache.update(ck, cv)
         self.last_finite = np.asarray(ok).reshape(1)
-        out = int(token)  # forces the result sync before the clock stops
-        elapsed = time.perf_counter() - t0
+        out = int(token)  # result sync lands inside the readback span
+        elapsed, execute_s = self._record_step_phases("prefill", t0, t_disp, t_exec)
         # FLOPs accrue only on SUCCESS, next to the time they pair with:
         # a step that raises (and is retried by the supervisor) must not
         # count its FLOPs without its time, or MFU inflates under faults
         flops = self.flops_model.prefill_flops(n)
         self.flops_by_kind["prefill"] += flops
-        self.device_time_s["prefill"] += elapsed
         if self.trace_counts.get(f"prefill[{bucket}]", 0) > traces_before:
             # this call traced (first compile or a retrace): its wall
             # time is the program's compile cost, registry-stamped
@@ -500,7 +541,7 @@ class GenerationEngine:
                     self.flops_model.prefill_flops(bucket),
                     self.flops_model.prefill_bytes(bucket),
                 ),
-                elapsed,
+                execute_s,
                 label=f"prefill[{bucket}] ({self.flops_model.chip.name})",
                 provenance="serving roofline (ServingFlops x chip peak)",
                 alarm=self._roofline_alarm,
@@ -542,16 +583,18 @@ class GenerationEngine:
             jnp.int32(sampling.top_k),
             key,
         )
+        t_disp = time.perf_counter()
+        jax.block_until_ready((token, ok, ck, cv))  # device execution done
+        t_exec = time.perf_counter()
         self.cache.update(ck, cv)
         self.last_finite = np.asarray(ok).reshape(1)
-        out = int(token)  # forces the result sync before the clock stops
-        elapsed = time.perf_counter() - t0
+        out = int(token)  # result sync lands inside the readback span
+        elapsed, execute_s = self._record_step_phases("prefill", t0, t_disp, t_exec)
         # useful work = suffix tokens only, each attending its full live
         # context (causal): ctx = sum_{p=prefix_len}^{n-1} (p + 1)
         ctx = (n * (n + 1) - prefix_len * (prefix_len + 1)) // 2
         flops = self.flops_model.verify_flops(len(suffix), ctx)
         self.flops_by_kind["prefill"] += flops
-        self.device_time_s["prefill"] += elapsed
         if self.trace_counts.get(name, 0) > traces_before:
             self.programs.set_compile_time(name, elapsed)
         else:
@@ -563,7 +606,7 @@ class GenerationEngine:
                     self.flops_model.verify_flops(w, ctx),
                     self.flops_model.verify_bytes(w, ctx),
                 ),
-                elapsed,
+                execute_s,
                 label=f"{name} ({self.flops_model.chip.name})",
                 provenance="serving roofline (ServingFlops x chip peak)",
                 alarm=self._roofline_alarm,
@@ -882,15 +925,17 @@ class GenerationEngine:
             self._bias_arg(bias),
             keys,
         )
+        t_disp = time.perf_counter()
+        jax.block_until_ready((out, ok, ck, cv))  # device execution done
+        t_exec = time.perf_counter()
         self.cache.update(ck, cv)
         self.last_finite = np.asarray(ok)
-        result = np.asarray(out)  # result sync included in the timing
-        elapsed = time.perf_counter() - t0
+        result = np.asarray(out)  # result sync lands in the readback span
+        elapsed, execute_s = self._record_step_phases("decode", t0, t_disp, t_exec)
         # success-only, paired with the time below (see prefill())
         n_active, ctx_sum = int(active.sum()), int(context_lens.sum())
         flops = self.flops_model.decode_flops(n_active, ctx_sum)
         self.flops_by_kind["decode"] += flops
-        self.device_time_s["decode"] += elapsed
         if self.trace_counts.get("decode", 0) > traces_before:
             self.programs.set_compile_time("decode", elapsed)
         else:
@@ -904,7 +949,7 @@ class GenerationEngine:
                     self.flops_model.decode_flops(b, ctx_sum),
                     self.flops_model.decode_bytes(b, ctx_sum),
                 ),
-                elapsed,
+                execute_s,
                 label=f"decode ({self.flops_model.chip.name})",
                 provenance="serving roofline (ServingFlops x chip peak)",
                 alarm=self._roofline_alarm,
@@ -967,15 +1012,17 @@ class GenerationEngine:
             self._bias_arg(bias),
             keys,
         )
+        t_disp = time.perf_counter()
+        jax.block_until_ready((out, n_emitted, ok, ck, cv))  # execution done
+        t_exec = time.perf_counter()
         self.cache.update(ck, cv)
         self.last_finite = np.asarray(ok)
         result = (np.asarray(out), np.asarray(n_emitted))
-        elapsed = time.perf_counter() - t0
+        elapsed, execute_s = self._record_step_phases("verify", t0, t_disp, t_exec)
         # success-only, paired with the time below (see prefill())
         n_tok, ctx_sum = int(w_tok.sum()), int(ctx.sum())
         flops = self.flops_model.verify_flops(n_tok, ctx_sum)
         self.flops_by_kind["verify"] += flops
-        self.device_time_s["verify"] += elapsed
         if self.trace_counts.get("verify", 0) > traces_before:
             self.programs.set_compile_time("verify", elapsed)
         else:
@@ -988,7 +1035,7 @@ class GenerationEngine:
                     self.flops_model.verify_flops(bw, ctx_sum),
                     self.flops_model.verify_bytes(bw, ctx_sum),
                 ),
-                elapsed,
+                execute_s,
                 label=f"verify ({self.flops_model.chip.name})",
                 provenance="serving roofline (ServingFlops x chip peak)",
                 alarm=self._roofline_alarm,
@@ -1024,14 +1071,31 @@ class GenerationEngine:
         """Cumulative useful model FLOPs across all step kinds."""
         return sum(self.flops_by_kind.values())
 
+    @property
+    def device_time_s(self) -> Dict[str, float]:
+        """The pre-split total per kind, derived: dispatch + execute +
+        readback — the same wall seconds the old conflated timer
+        measured, kept for the flight/stats series' continuity."""
+        return {k: sum(v.values()) for k, v in self.phase_time_s.items()}
+
     def total_device_time_s(self) -> float:
         return sum(self.device_time_s.values())
 
+    def total_execute_time_s(self) -> float:
+        """Cumulative device-EXECUTE seconds (dispatch-return to
+        block_until_ready) — the MFU denominator after the ISSUE 12
+        split; host arg prep and dispatch overhead no longer count as
+        device time."""
+        return sum(v["execute"] for v in self.phase_time_s.values())
+
     def mfu(self) -> float:
         """Serving model-FLOPs utilization: useful FLOPs over device
-        seconds against the chip's peak for the cache dtype. 0 before
-        any step ran."""
-        t = self.total_device_time_s()
+        EXECUTE seconds against the chip's peak for the cache dtype
+        (definition changed by ISSUE 12 — previously the denominator
+        included host arg prep, XLA dispatch, and readback; see README
+        "Step anatomy" for the CPU-backend caveat). 0 before any step
+        ran."""
+        t = self.total_execute_time_s()
         if t <= 0:
             return 0.0
         return self.total_flops() / t / self.flops_model.peak_flops

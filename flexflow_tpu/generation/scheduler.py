@@ -82,6 +82,7 @@ from ..obs import (
     FlightRecorder,
     RequestTrace,
     SLOMonitor,
+    StepAnatomy,
     TraceRing,
     next_request_id,
 )
@@ -438,6 +439,16 @@ class ContinuousBatchingScheduler:
         self._step_phases: Dict[str, float] = {}
         self._step_info: Dict = {}
         self._step_recorded = False
+        # step-anatomy profiler (obs/steptrace.py): first-class host
+        # spans + the device execute span per iteration, feeding the
+        # flexflow_serving_step_phase_seconds histograms, the
+        # device-bubble/overlap-headroom gauges, and the on-demand
+        # two-lane capture on GET /v2/debug/anatomy. _step_spans holds
+        # THIS iteration's (phase, t0, t1) perf_counter stamps; loop
+        # thread only.
+        self.anatomy = StepAnatomy(enabled=observability)
+        self.anatomy.register_gauges(self.stats)
+        self._step_spans: List = []
         self.spec_stats = SpeculationStats()
         self.spec_stats.register_gauges(self.stats)
         # capacity & compute observability (obs/capacity.py, obs/slo.py):
@@ -1091,7 +1102,13 @@ class ContinuousBatchingScheduler:
         # via the heartbeat stamp — hide a wedged device from the
         # watchdog. The allocator and prefix index carry their own
         # locks; only the queue/slot mutation below needs _lock.
+        # Radix planning is a first-class anatomy phase (prefix_plan):
+        # PR 11 made it a real admission cost the waterfall must not
+        # hide inside "admit".
+        t_p0 = time.perf_counter()
         plan = self.engine.prefix_plan(req.prompt)
+        t_p1 = time.perf_counter()
+        self._span("prefix_plan", t_p0, t_p1)
         need = (
             self.engine.cache_config.blocks_for(len(req.prompt) + 1)
             - plan.n_resident
@@ -1133,11 +1150,15 @@ class ContinuousBatchingScheduler:
                 blocks_short=req.cache_wait_short, blame=blame,
             )
             req.cache_wait_start = None
+        self._span("admit", t_p1, time.perf_counter())
         # assemble the block table from the prefix plan: swap-ins + the
         # COW boundary copy are device work, so the watchdog's stall
         # heartbeat covers them like any other step
+        t_q0 = time.perf_counter()
         with self._stamped():
             prep = self.engine.prepare_prefix(req.prompt, plan, blocks)
+        t_q1 = time.perf_counter()
+        self._span("prefix_plan", t_q0, t_q1)
         if prep is None:
             # a mid-assembly swap-in fallback could not replace the
             # lost shared blocks: everything was handed back — requeue
@@ -1155,6 +1176,7 @@ class ContinuousBatchingScheduler:
         ]
         self._admitting = req
         t_dev = time.perf_counter()
+        self._span("admit", t_q1, t_dev)
         try:
             token = self._device(
                 lambda: self.engine.prefill_one(
@@ -1189,7 +1211,11 @@ class ContinuousBatchingScheduler:
             if req.handle._fail(e):
                 self.stats.incr("failed")
             return True  # did work (and must not spin on the same head)
-        dev_s = time.perf_counter() - t_dev
+        t_dev_end = time.perf_counter()
+        dev_s = t_dev_end - t_dev
+        # the prefill's dispatch/block/execute/readback spans join the
+        # iteration's anatomy timeline with their real offsets
+        execute_s = self._engine_spans()
         if not bool(self.engine.last_finite[0]):
             # poisoned prompt: the prefill's logits went non-finite, and
             # a single-sequence step needs no bisection to assign blame
@@ -1254,7 +1280,10 @@ class ContinuousBatchingScheduler:
             # a confusing two of three
             self.stats.observe("ttft", max(0.0, now - req.submitted_at))
         self.flight.record_step(
-            "prefill", phases={"device": dev_s}, request_id=req.id,
+            "prefill",
+            phases={"prefix_plan": (t_p1 - t_p0) + (t_q1 - t_q0),
+                    "device": dev_s},
+            execute_s=execute_s, request_id=req.id,
             prompt_len=len(req.prompt), occupancy=len(self._running),
             queue_depth=len(self._queue),
             blocks_free=self.engine.allocator.num_free,
@@ -1263,6 +1292,7 @@ class ContinuousBatchingScheduler:
         self.token_rate.record(1)
         if req.finished():
             self._finish(state)
+        self._span("admit", t_dev_end, time.perf_counter())
         return True
 
     def _emit_token(self, state: _Running, token: int) -> None:
@@ -1392,11 +1422,18 @@ class ContinuousBatchingScheduler:
         if not self._running:
             return False
         b = self.engine.max_batch_slots
+        t_c0 = time.perf_counter()
         order = sorted(self._running.values(), key=lambda s: s.slot)
         tokens, positions, tables, active, temps, top_ks = self._collect_slots(order)
+        t_c1 = time.perf_counter()
+        self._span("schedule", t_c0, t_c1)
+        # per-request sampling-key assembly is a first-class phase
+        # (sample): fold_in + stack are real host dispatches that used
+        # to hide in the untimed gap before the device call
         key_by_slot = {s.slot: s.req.sample_key() for s in order}
         dummy = jax.random.key(0)
         keys = jnp.stack([key_by_slot.get(i, dummy) for i in range(b)])
+        self._span("sample", t_c1, time.perf_counter())
 
         def step():
             return self.engine.decode(
@@ -1423,6 +1460,7 @@ class ContinuousBatchingScheduler:
         if out is None:
             info["handled_failure"] = True
             return True  # failure handled: quarantined or journal-replayed
+        info["execute_s"] = self._engine_spans()
         if self._quarantine_nan("decode", order):
             info["handled_failure"] = True
             return True
@@ -1439,7 +1477,7 @@ class ContinuousBatchingScheduler:
             n_live += 1
             if state.req.finished():
                 self._finish(state)
-        ph["bookkeep"] = time.perf_counter() - t_book
+        self._span("bookkeep", t_book, time.perf_counter())
         info["emitted"] = n_live
         self.token_rate.record(n_live)
         return True
@@ -1470,9 +1508,11 @@ class ContinuousBatchingScheduler:
         w = self.engine.spec_window
         ph, info = self._step_phases, self._step_info
         info["kind"] = "verify"
-        t_draft = time.perf_counter()
+        t_c0 = time.perf_counter()
         order = sorted(self._running.values(), key=lambda s: s.slot)
         last, start, tables, _active, temps, top_ks = self._collect_slots(order)
+        t_draft = time.perf_counter()
+        self._span("schedule", t_c0, t_draft)
         window = np.zeros((b, w), np.int32)
         window[:, 0] = last
         n_draft = np.full((b,), -1, np.int32)  # -1 = inactive slot
@@ -1496,11 +1536,14 @@ class ContinuousBatchingScheduler:
                     self.stats.incr("drafter_errors")
             window[i, 1 : 1 + len(draft)] = draft
             n_draft[i] = len(draft)
+        t_d1 = time.perf_counter()
+        self._span("draft", t_draft, t_d1)
+        # key assembly is the sample phase, no longer hidden in draft
         keys_by_slot = {s.slot: s.req.sample_keys(w) for s in order}
         if self._dummy_keys is None:
             self._dummy_keys = jnp.stack([jax.random.key(0)] * w)
         keys = jnp.stack([keys_by_slot.get(i, self._dummy_keys) for i in range(b)])
-        ph["draft"] = time.perf_counter() - t_draft
+        self._span("sample", t_d1, time.perf_counter())
         info["drafted"] = int(np.maximum(n_draft, 0).sum())
 
         def step():
@@ -1524,6 +1567,7 @@ class ContinuousBatchingScheduler:
         if result is None:
             info["handled_failure"] = True
             return True  # failure handled: quarantined or journal-replayed
+        info["execute_s"] = self._engine_spans()
         out, n_emitted = result
         if self._quarantine_nan("verify", order):
             info["handled_failure"] = True
@@ -1561,11 +1605,30 @@ class ContinuousBatchingScheduler:
             n_live_tokens += len(toks)
             if req.finished():
                 self._finish(state)
-        ph["bookkeep"] = time.perf_counter() - t_book
+        self._span("bookkeep", t_book, time.perf_counter())
         info["accepted"] = n_accepted
         info["emitted"] = n_live_tokens
         self.token_rate.record(n_live_tokens)
         return True
+
+    def _span(self, name: str, t0: float, t1: float) -> None:
+        """Record one host span of THIS iteration: real perf_counter
+        stamps for the anatomy profiler, duration accumulated into the
+        flight record's phase dict. Loop thread only."""
+        self._step_spans.append((name, t0, t1))
+        ph = self._step_phases
+        ph[name] = ph.get(name, 0.0) + (t1 - t0)
+
+    def _engine_spans(self) -> float:
+        """Adopt the engine's last step's dispatch/block/execute/
+        readback spans into this iteration's anatomy span list (NOT
+        into the flight phases — those keep the conflated "device"
+        total so the ring's series stays continuous). Returns the
+        device-execute seconds for the flight record's new
+        ``execute_s`` field."""
+        spans = self.engine.last_step_spans
+        self._step_spans.extend(spans)
+        return sum(s1 - s0 for name, s0, s1 in spans if name == "execute")
 
     def _flight_step(self) -> None:
         """Write THIS iteration's step record (idempotent per step):
@@ -1602,29 +1665,45 @@ class ContinuousBatchingScheduler:
             return self._step_impl()
 
     def _step_impl(self) -> bool:
-        ph = self._step_phases = {}
+        self._step_phases = {}
         info = self._step_info = {}
+        self._step_spans = []
         self._step_recorded = False
         t0 = time.perf_counter()
         self._expire()
         t1 = time.perf_counter()
+        self._span("schedule", t0, t1)
         admitted = 0
-        # admit as many as fit THIS iteration — they decode together below
+        # admit as many as fit THIS iteration — they decode together
+        # below. Admission spans (admit / prefix_plan / the prefill's
+        # dispatch-execute-readback) are recorded inside _admit.
         while self._admit():
             admitted += 1
         t2 = time.perf_counter()
         self._plan_speculation()
         self._grow()
         t3 = time.perf_counter()
-        ph["schedule"] = (t1 - t0) + (t3 - t2)
+        self._span("schedule", t2, t3)
         if admitted:
-            ph["admit"] = t2 - t1
             info["admitted"] = admitted
         speculating = any(s.step_k > 0 for s in self._running.values())
         stepped = self._verify_once() if speculating else self._decode_once()
         did = stepped or admitted > 0
         if did:
             self._flight_step()
+            # one anatomy observation per working iteration: host spans
+            # + the device execute lane, under the iteration's step kind
+            # (admission work inside a decode iteration charges the
+            # decode critical path — which is exactly where it sits).
+            # Handled-failure iterations stay out of the hot window:
+            # they have no execute span and a retry-inflated wall that
+            # would skew the bubble/headroom math for a whole window.
+            self.anatomy.observe_step(
+                info.get("kind", "admit"), self._step_spans, t0,
+                time.perf_counter(),
+                tokens=int(info.get("emitted", 0)) + admitted,
+                hot=not info.get("handled_failure", False),
+            )
         # integrate time-at-pressure AFTER the step's allocations, so
         # the pressure flag reflects the state the next interval runs in
         # (injectable clock: virtual-clock tests integrate exactly)

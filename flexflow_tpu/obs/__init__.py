@@ -35,8 +35,22 @@ PR 7 adds the *truth* dimension:
   (``GET /v2/debug/predictions``, ``flexflow_sim_*`` on ``/metrics``,
   recalibration suggestions back into search/calibration.py).
 
+PR 12 adds the *step-anatomy* dimension:
+
+* :mod:`steptrace` — the :class:`StepAnatomy` profiler: first-class
+  host spans (schedule / admit / prefix_plan / draft / sample /
+  dispatch / block / readback / bookkeep) plus an independently
+  measured device ``execute`` span per scheduler iteration, feeding
+  per-``{kind, phase}`` histograms
+  (``flexflow_serving_step_phase_seconds``), a rolling
+  ``device_bubble_ratio`` with host-bound/device-bound classification,
+  an on-demand K-step capture rendered as a two-lane real-offset
+  chrome://tracing timeline, and the Amdahl-style overlap-headroom
+  projection gating ROADMAP item 4
+  (``GET /v2/debug/anatomy?capture=K``).
+
 See tools/obsreport.py for the CLI (summaries, trace waterfalls,
-timeline dumps, cache/SLO views, and the CI ``--selfcheck``).
+timeline dumps, cache/SLO/anatomy views, and the CI ``--selfcheck``).
 """
 from .capacity import (
     GLOBAL_PROGRAMS,
@@ -53,6 +67,7 @@ from .prom import (
     validate_exposition,
 )
 from .slo import DEFAULT_OBJECTIVES, SLObjective, SLOMonitor
+from .steptrace import StepAnatomy
 from .trace import NULL_TRACE, RequestTrace, TraceRing, next_request_id
 from .truth import GLOBAL_LEDGER, PredictionLedger
 
@@ -66,6 +81,7 @@ __all__ = [
     "ProgramRegistry",
     "SLOMonitor",
     "SLObjective",
+    "StepAnatomy",
     "ServingFlops",
     "NULL_TRACE",
     "RequestTrace",

@@ -8,7 +8,16 @@ a crash-safe, lock-cheap ring the scheduler writes once per step:
   step records  step kind (prefill/decode/verify), batch occupancy,
                 queue depth, free cache blocks, drafted/accepted/emitted
                 token counts, and wall-clock phase timings
-                (schedule / admit / draft / device / bookkeep)
+                (schedule / admit / prefix_plan / draft / sample /
+                device / bookkeep). Since ISSUE 12, decode/verify/
+                prefill records also carry ``execute_s`` — the
+                device-EXECUTE seconds inside the conflated "device"
+                phase (dispatch-return to block_until_ready), so a
+                postmortem shows how much of a slow step was device
+                compute vs host overhead. The ring's phases stay
+                DURATIONS rendered back-to-back; the real-offset
+                two-lane view is obs/steptrace.py's capture
+                (GET /v2/debug/anatomy).
   events        instantaneous markers from the self-healing layer:
                 step_failed, step_retry, watchdog_trip, quarantine,
                 restart, recovery, engine_failed

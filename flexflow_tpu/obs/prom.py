@@ -18,6 +18,11 @@ rename breaks CI instead of dashboards):
   flexflow_serving_<gauge>{model}                     gauge — one
       family per registered gauge (queue_depth, running, tokens_per_s,
       cache_occupancy, spec_*, recoveries, watchdog_trips, ...)
+  flexflow_serving_step_phase_seconds{model,kind,phase} histogram —
+      the step-anatomy profiler's per-(step kind, phase) duration
+      distribution (obs/steptrace.py): host phases schedule / admit /
+      prefix_plan / draft / sample / dispatch / block / readback /
+      bookkeep plus the device execute lane
   flexflow_fault_site_calls_total{site}               counter — times
       each fault-injection site was reached (active plan only)
   flexflow_fault_site_fires_total{site}               counter — times
@@ -61,7 +66,7 @@ _HELP = {
     "cache_pressure_time_s": "Cumulative seconds spent below the free-block pressure threshold.",
     "cache_admission_waits": "Admissions that waited on cache blocks (episodes).",
     "cache_admission_wait_s": "Cumulative seconds requests sat blocked on cache blocks.",
-    "mfu": "Serving model-FLOPs utilization: useful FLOPs / device seconds / chip peak.",
+    "mfu": "Serving model-FLOPs utilization: useful FLOPs / device execute seconds / chip peak.",
     "achieved_tflops": "Achieved useful TFLOP/s over cumulative device step time.",
     "model_tflops_total": "Cumulative useful model TFLOPs executed by generation steps.",
     "goodput_tokens_total": "Tokens generated across all requests (goodput denominator).",
@@ -94,6 +99,12 @@ _HELP = {
     "flexflow_sim_prediction_pairs_total": "Measured samples joined with a registered prediction, per key.",
     "flexflow_sim_prediction_unpredicted_total": "Measured samples that had no registered prediction (counted, not dropped).",
     "flexflow_sim_drift_alarms_total": "Calibration-drift alarms raised by the process-wide prediction ledger.",
+    "step_phase_seconds": "Step-anatomy phase durations per step kind (host spans + the device execute lane).",
+    "step_device_bubble_ratio": "Fraction of hot-path step wall time the device sat idle while the host worked (rolling window).",
+    "step_host_bound": "Rolling-window classification: 1 host-bound, 0 device-bound (absent before enough steps).",
+    "step_overlap_projected_tokens_per_s": "Amdahl projection: tokens/s if host phases were hidden behind device execution.",
+    "step_overlap_projected_speedup": "Projected step-wall speedup from fully overlapping host work with device execution.",
+    "step_anatomy_steps_observed": "Scheduler iterations folded into the step-anatomy aggregator.",
     "fleet_replicas": "Current fleet replicas per lifecycle state.",
     "fleet_failovers_total": "Replica deaths whose live streams were handed over for cross-replica journal-replay.",
     "fleet_migrated_streams_total": "Streams journal-replayed onto a surviving or replacement replica.",
@@ -158,14 +169,18 @@ def render_prometheus(
     fault_sites: Optional[Dict[str, Dict[str, int]]] = None,
     ledger=None,
     fleets: Optional[Dict[str, Dict]] = None,
+    anatomy: Optional[Mapping[str, list]] = None,
 ) -> str:
     """Render ``{model_name: ServingStats}`` (keys may be
     ``(model, replica)`` tuples for fleet replicas — every family then
     carries a ``replica`` label), plus optional fault-site counters
     from runtime.faults.site_counters(), the process-wide prediction
-    ledger's ``flexflow_sim_*`` families, and per-fleet lifecycle
+    ledger's ``flexflow_sim_*`` families, per-fleet lifecycle
     families (``fleets={model: Fleet.prom_fleet()}``: replica states,
-    failover/migration counters, router decisions) as exposition
+    failover/migration counters, router decisions), and the
+    step-anatomy phase histograms
+    (``anatomy={model: StepAnatomy.prom_snapshot()}`` ->
+    ``flexflow_serving_step_phase_seconds{kind,phase}``) as exposition
     text."""
     lines: list = []
     names = sorted(models, key=_sort_key)
@@ -240,6 +255,31 @@ def render_prometheus(
                 '%s{%s} %s'
                 % (family, _model_labels(m), format_value(v))
             )
+
+    # --------------------------------------------------- step anatomy
+    if anatomy:
+        family = "flexflow_serving_step_phase_seconds"
+        _help_type(lines, family, "histogram")
+        for m in sorted(anatomy, key=_sort_key):
+            ml = _model_labels(m)
+            for entry in anatomy[m]:
+                labels = '%s,kind="%s",phase="%s"' % (
+                    ml, escape_label_value(entry["kind"]),
+                    escape_label_value(entry["phase"]),
+                )
+                for le, cum in entry["buckets"]:
+                    lines.append(
+                        '%s_bucket{%s,le="%s"} %s'
+                        % (family, labels,
+                           "+Inf" if math.isinf(le) else format_value(le),
+                           format_value(cum))
+                    )
+                lines.append(
+                    '%s_sum{%s} %s' % (family, labels, format_value(entry["sum"]))
+                )
+                lines.append(
+                    '%s_count{%s} %s' % (family, labels, format_value(entry["count"]))
+                )
 
     # ---------------------------------------------------------------- fleet
     if fleets:

@@ -17,9 +17,11 @@ behavior) would silently mis-rank strategies and nobody would know.
   programs, and the generation engine registers a roofline prediction
   per prefill/decode/verify step.
 * **measure side** — ``measure_lowered_op`` (calibration), the
-  executor's traced train windows, and the engine's per-step
-  ``device_time_s`` feed measured wall seconds back under the same
-  keys (program names from PR 6's ProgramRegistry; device-qualified op
+  executor's traced train windows, and the engine's per-step device
+  EXECUTE seconds (the ISSUE 12 dispatch/execute/readback split — the
+  roofline predicts chip time, so host prep and dispatch no longer
+  pollute the pair) feed measured seconds back under the same keys
+  (program names from PR 6's ProgramRegistry; device-qualified op
   signatures from ``calibration.op_ledger_key``).
 * **join** — every measured sample with a registered prediction becomes
   exactly one (predicted, measured) pair; measurements with no

@@ -83,6 +83,13 @@ class GenerationModel:
         return self.scheduler.capacity
 
     @property
+    def anatomy(self):
+        """The step-anatomy profiler: phase histograms, device-bubble
+        accounting, overlap headroom, and the on-demand two-lane
+        capture (GET /v2/debug/anatomy)."""
+        return self.scheduler.anatomy
+
+    @property
     def programs(self):
         """The engine's jit program registry (GET /v2/debug/programs)."""
         return self.engine.programs
@@ -200,6 +207,7 @@ class GenerationModel:
                 "trace_ring": self.scheduler.trace_ring.capacity,
                 "flight_capacity": self.scheduler.flight.capacity,
                 "progress_every": self.scheduler.trace_progress_every,
+                "anatomy": self.scheduler.anatomy.enabled,
             },
             "compute": {
                 "chip": self.engine.flops_model.chip.name,

@@ -40,6 +40,20 @@ API. This server implements the same surface directly (stdlib only):
                                               distributions, and
                                               calibration-drift alarms
                                               with blame
+  GET  /v2/debug/anatomy[?model=M&capture=K] -> step-anatomy profiler:
+                                              per-kind phase breakdown,
+                                              device-bubble ratio,
+                                              host/device-bound
+                                              classification, the
+                                              overlap-headroom
+                                              projection, and (with
+                                              capture=K) arming a
+                                              K-step two-lane capture
+                                              whose chrome://tracing
+                                              timeline rides the next
+                                              scrape — per replica on
+                                              fleets, like the other
+                                              debug endpoints
   GET  /v2/slo                             -> per-model SLO objectives
                                               with fast/slow burn rates
   GET  /v2/fleet                           -> fleet serving tier state:
@@ -263,12 +277,33 @@ class InferenceServer:
             or label.split("/", 1)[0] == model
         )
 
+    def _all_anatomy(self) -> Dict:
+        """model/(model, replica) -> StepAnatomy.prom_snapshot() across
+        the generation path — the ``anatomy=`` input to
+        render_prometheus, keyed like _all_stats so the
+        ``step_phase_seconds`` family carries the same model/replica
+        labels as every other serving family."""
+        out: Dict = {}
+        for n, g in list(self.generators.items()):
+            reps = getattr(g, "replicas", None)
+            if reps is None:
+                an = getattr(g, "anatomy", None)
+                if an is not None and an.enabled:
+                    out[n] = an.prom_snapshot()
+            else:
+                for r in list(reps):
+                    an = getattr(r.model, "anatomy", None)
+                    if an is not None and an.enabled:
+                        out[(n, r.id)] = an.prom_snapshot()
+        return out
+
     def metrics_text(self) -> str:
         return render_prometheus(
             self._all_stats(),
             fault_sites=faults.site_counters(),
             ledger=GLOBAL_LEDGER,
             fleets=self._fleets(),
+            anatomy=self._all_anatomy(),
         )
 
     def debug_traces(
@@ -369,6 +404,27 @@ class InferenceServer:
         }
         if model is None:
             out["global"] = GLOBAL_LEDGER.report()
+        return out
+
+    def debug_anatomy(
+        self, model: Optional[str] = None, capture: Optional[int] = None
+    ) -> Dict:
+        """Step-anatomy report per generation unit (one entry per fleet
+        replica): phase breakdown, device-bubble ratio, classification,
+        overlap-headroom projection, capture state, and the two-lane
+        chrome://tracing timeline of any captured steps. ``capture=K``
+        arms a K-step capture on every matching unit first (the
+        timeline fills as the engines step; scrape again to read it)."""
+        out: Dict = {"models": {}}
+        for label, unit in self._generation_units():
+            if not self._unit_matches(label, model):
+                continue
+            an = unit.anatomy
+            armed = an.arm_capture(capture) if capture else None
+            payload = {"report": an.report(), "trace": an.to_chrome_trace(name=label)}
+            if armed is not None:
+                payload["armed"] = armed
+            out["models"][label] = payload
         return out
 
     def slo_report(self) -> Dict:
@@ -487,6 +543,11 @@ class InferenceServer:
                 if path == "/v2/debug/predictions":
                     return self._json(200, server.debug_predictions(
                         model=(query.get("model") or [None])[0]
+                    ))
+                if path == "/v2/debug/anatomy":
+                    return self._json(200, server.debug_anatomy(
+                        model=(query.get("model") or [None])[0],
+                        capture=qint("capture"),
                     ))
                 if path == "/v2/slo":
                     return self._json(200, server.slo_report())

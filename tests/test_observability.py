@@ -34,6 +34,7 @@ from flexflow_tpu.obs import (
     FlightRecorder,
     PredictionLedger,
     RequestTrace,
+    StepAnatomy,
     TraceRing,
     render_prometheus,
     validate_exposition,
@@ -243,7 +244,29 @@ def _golden_stats():
     s.add_gauge("prefix_cache_host_bytes", lambda: 4096)
     s.add_gauge("prefix_cache_resident_blocks", lambda: 5)
     s.add_gauge("prefix_cache_offloaded_blocks", lambda: 2)
+    # ISSUE 12 step-anatomy families (binary-exact values)
+    s.add_gauge("step_device_bubble_ratio", lambda: 0.75)
+    s.add_gauge("step_host_bound", lambda: 1)
+    s.add_gauge("step_overlap_projected_tokens_per_s", lambda: 256)
+    s.add_gauge("step_overlap_projected_speedup", lambda: 2)
+    s.add_gauge("step_anatomy_steps_observed", lambda: 7)
     return s
+
+
+def _golden_anatomy():
+    """Deterministic step-anatomy snapshot for the
+    flexflow_serving_step_phase_seconds family: one decode step with
+    binary-exact span durations landing in distinct buckets (the
+    observe path itself is pinned, not a hand-built dict)."""
+    an = StepAnatomy(enabled=True)
+    an.observe_step(
+        "decode",
+        [("dispatch", 0.0, 0.0005), ("block", 0.0005, 0.0025),
+         ("execute", 0.0005, 0.0025), ("readback", 0.0025, 0.003),
+         ("bookkeep", 0.003, 0.0035)],
+        0.0, 0.004, tokens=2,
+    )
+    return an.prom_snapshot()
 
 
 def _golden_ledger():
@@ -294,6 +317,7 @@ def test_prometheus_golden_exposition():
         fault_sites={"generation.decode_step": {"calls": 5, "fires": 1}},
         ledger=_golden_ledger(),
         fleets={"gen": _GOLDEN_FLEET},
+        anatomy={"lm": _golden_anatomy()},
     )
     assert not validate_exposition(text)
     golden_path = os.path.join(os.path.dirname(__file__), "data", "prometheus_golden.txt")

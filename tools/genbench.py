@@ -44,10 +44,16 @@ boundary.
 ``--trace-out FILE`` benchmarks the OBSERVABILITY layer instead: the
 same steady-state request stream runs with tracing disabled and enabled
 (interleaved, best-of-``--trace-repeats``), asserting that per-request
-traces + the flight recorder cost < ``--max-trace-overhead`` (default
-3%) of decode throughput and add ZERO retraces; the file receives the
-overhead report, the flight-recorder chrome://tracing dump, and a
-sample request trace.
+traces + the flight recorder + the step-anatomy aggregator (ISSUE 12 —
+anatomy rides observability, so the enabled arm measures it) cost <
+``--max-trace-overhead`` (default 3%) of decode throughput and add
+ZERO retraces; the file receives the overhead report, the
+flight-recorder chrome://tracing dump, and a sample request trace.
+``--anatomy-out FILE`` additionally runs one armed-capture stream after
+the measurement and writes the step-anatomy report (phase breakdown,
+device_bubble_ratio, overlap-headroom projection) plus the captured
+two-lane timeline — the artifact tpu-ci uploads; the run FAILS if the
+anatomy report is empty or the bubble ratio is not finite.
 
 Every mode also merges its report into a machine-readable
 ``--bench-out`` artifact (default ``BENCH_GEN.json``) keyed by mode —
@@ -165,7 +171,14 @@ def _history_metrics(mode: str, report: dict) -> dict:
             "acceptance_rate": report.get("acceptance_rate"),
         }
     if mode == "trace_overhead":
-        return {"tracing_overhead": report.get("tracing_overhead")}
+        an = report.get("anatomy") or {}
+        return {
+            "tracing_overhead": report.get("tracing_overhead"),
+            # bubble ratio for humans; the gated metric is the unclamped
+            # hidden-host seconds per hot step (see perfwatch.METRICS)
+            "device_bubble_ratio": an.get("device_bubble_ratio"),
+            "host_s_per_hot_step": an.get("host_s_per_hot_step"),
+        }
     if mode == "shared_prefix":
         return {
             "ttft_p50_improvement": report.get("ttft_p50_improvement"),
@@ -537,6 +550,33 @@ def trace_overhead_bench(args, cfg, params) -> tuple:
     overhead = measure(args.trace_repeats)
     if overhead > args.max_trace_overhead:
         overhead = measure(args.trace_repeats * 2)
+    anatomy_trace = None
+    if args.anatomy_out:
+        # one extra (untimed) stream on a fresh traced scheduler with a
+        # capture armed: the artifact carries real two-lane spans, the
+        # measured arms above stay pure wall-clock comparison
+        cap_sched = ContinuousBatchingScheduler(engine, observability=True)
+        cap_sched.anatomy.arm_capture(32)
+        handles = [cap_sched.submit(p, sampling) for p in prompts]
+        while any(not h.done() for h in handles):
+            if not cap_sched.step():
+                break
+        for h in handles:
+            h.result(timeout=0)
+        an = cap_sched.anatomy
+        anatomy_trace = an.to_chrome_trace()
+    else:
+        an = traced_sched.anatomy
+    hr = an.overlap_headroom()
+    anatomy_report = {
+        "steps_observed": an.steps_observed(),
+        "device_bubble_ratio": an.device_bubble_ratio(),
+        "classification": an.classification(),
+        "measured_tokens_per_s": hr["measured_tokens_per_s"],
+        "projected_tokens_per_s": hr["projected_tokens_per_s"],
+        "projected_speedup": hr["projected_speedup"],
+        "host_s_per_hot_step": hr["host_s_per_hot_step"],
+    }
     steady_retraces = {
         k: engine.trace_counts[k] - traces_after_warmup.get(k, 0)
         for k in engine.trace_counts
@@ -555,6 +595,7 @@ def trace_overhead_bench(args, cfg, params) -> tuple:
         "max_trace_overhead": args.max_trace_overhead,
         "steady_state_retraces": steady_retraces,
         "flight_records": len(traced_sched.flight.snapshot()),
+        "anatomy": anatomy_report,
         "capacity": capacity_block(traced_sched),
         "backend": jax.default_backend(),
     }
@@ -563,12 +604,26 @@ def trace_overhead_bench(args, cfg, params) -> tuple:
         print("FAIL: tracing changed the generated streams", file=sys.stderr)
         ok = False
     if steady_retraces:
+        # the guard covers the anatomy-on arms AND the armed-capture
+        # stream (trace counts are read after both): anatomy must add
+        # zero retraces like the rest of the observability layer
         print(f"FAIL: tracing run retraced: {steady_retraces}", file=sys.stderr)
         ok = False
     if overhead > args.max_trace_overhead:
         print(
             f"FAIL: tracing overhead {overhead * 100:.2f}% > "
-            f"{args.max_trace_overhead * 100:.1f}% budget",
+            f"{args.max_trace_overhead * 100:.1f}% budget "
+            f"(anatomy-on)",
+            file=sys.stderr,
+        )
+        ok = False
+    bubble = anatomy_report["device_bubble_ratio"]
+    if anatomy_report["steps_observed"] == 0 or bubble is None or not (
+        0.0 <= bubble <= 1.0
+    ):
+        print(
+            f"FAIL: step-anatomy report empty or bubble ratio not finite: "
+            f"{anatomy_report}",
             file=sys.stderr,
         )
         ok = False
@@ -579,6 +634,10 @@ def trace_overhead_bench(args, cfg, params) -> tuple:
     }
     with open(args.trace_out, "w") as f:
         json.dump(payload, f, indent=2)
+    if args.anatomy_out:
+        with open(args.anatomy_out, "w") as f:
+            json.dump({"report": anatomy_report, "timeline": anatomy_trace}, f,
+                      indent=2)
     print(json.dumps(report, indent=2))
     return report, ok
 
@@ -621,6 +680,10 @@ def main() -> int:
                          "chrome timeline + sample trace to this file")
     ap.add_argument("--max-trace-overhead", type=float, default=0.03)
     ap.add_argument("--trace-repeats", type=int, default=3)
+    ap.add_argument("--anatomy-out", default="",
+                    help="with --trace-out: write the step-anatomy "
+                         "report + captured two-lane timeline to this "
+                         "file (runs one extra armed-capture stream)")
     ap.add_argument("--bench-out", default="BENCH_GEN.json",
                     help="cumulative machine-readable bench artifact "
                          "(merged per mode; '' disables)")
@@ -629,6 +692,9 @@ def main() -> int:
                          "timestamped + git-sha-stamped; gated by "
                          "tools/perfwatch.py; '' disables)")
     args = ap.parse_args()
+    if args.anatomy_out and not args.trace_out:
+        ap.error("--anatomy-out requires --trace-out (the anatomy capture "
+                 "rides the tracing-overhead mode)")
     args.max_new_set = args.max_new is not None
     if args.max_new is None:
         args.max_new = 2 if args.shared_prefix else 16

@@ -32,6 +32,18 @@ Against a live server (serving/server.py):
       and the calibration-drift alarms with blame — the "is the
       simulator lying?" answer.
 
+  python tools/obsreport.py --url ... anatomy [--capture K]
+      [--anatomy-out anatomy.json]
+      Step-anatomy view (GET /v2/debug/anatomy): per-kind phase
+      breakdown (p50/mean per schedule/admit/prefix_plan/draft/sample/
+      dispatch/block/readback/bookkeep span), the device-bubble ratio
+      with host/device-bound classification, and the overlap-headroom
+      projection (tokens/s if host phases were hidden behind device
+      work) — the "is decode host-bound, and what would overlap buy?"
+      answer. --capture K arms a K-step two-lane capture (scrape again
+      once the engine has stepped); --anatomy-out dumps the captured
+      chrome://tracing timeline.
+
 CI self-check (no server needed; used by .github/workflows/tpu-ci.yml):
 
   python tools/obsreport.py --selfcheck
@@ -236,6 +248,50 @@ def show_predictions(base: str) -> int:
         print(f"global ledger (cost model / calibration / executor): "
               f"{c['pairs_total']} pairs, {c['drift_alarms_total']} drift alarm(s)")
         _predict_rows(g)
+    return 0
+
+
+def show_anatomy(base: str, capture=None, out: str = "") -> int:
+    """Phase breakdown + bubble/headroom per generation unit."""
+    url = f"{base}/v2/debug/anatomy"
+    if capture:
+        url += f"?capture={int(capture)}"
+    payload = _get_json(url)
+    for name, unit in sorted(payload.get("models", {}).items()):
+        rep = unit["report"]
+        if not rep.get("enabled", False):
+            print(f"model {name!r}: anatomy disabled (observability off)")
+            continue
+        print(f"model {name!r}: {rep['steps_observed']} step(s) observed, "
+              f"classification={rep['classification']}")
+        if unit.get("armed") is not None:
+            print(f"    armed a {unit['armed']}-step capture "
+                  f"(scrape again after the engine steps)")
+        bubble = rep.get("device_bubble_ratio")
+        if bubble is not None:
+            print(f"    device_bubble_ratio={bubble:.1%} "
+                  f"(device idle while the host works, rolling window)")
+        for kind, phases in sorted(rep.get("phases", {}).items()):
+            print(f"    {kind}:")
+            print("        phase         count     mean        p50")
+            for phase, p in sorted(phases.items()):
+                print(f"        {phase:<12} {p['count']:<7} "
+                      f"{p['mean_s'] * 1e3:8.3f}ms {p['p50_s'] * 1e3:8.3f}ms")
+        hr = rep.get("headroom", {})
+        if hr.get("measured_tokens_per_s") is not None:
+            print(f"    overlap headroom ({hr['steps']} hot step(s)): "
+                  f"{hr['measured_tokens_per_s']:.1f} -> "
+                  f"{hr['projected_tokens_per_s']:.1f} tok/s "
+                  f"({hr['projected_speedup']:.2f}x) if host phases were "
+                  f"hidden behind device work")
+        cap = rep.get("capture", {})
+        print(f"    capture: {cap.get('captured', 0)} step(s) retained, "
+              f"{cap.get('remaining', 0)} armed")
+    if out:
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=2)
+        print(f"wrote anatomy report + two-lane timeline(s) to {out} "
+              f"— open a 'trace' block in chrome://tracing")
     return 0
 
 
@@ -457,6 +513,44 @@ def selfcheck() -> int:
               and f"int32[{eng.max_batch_slots}] -> int32[{b}]" in blame,
               f"retrace blame string wrong: {blame!r}")
 
+        # -------------------- step anatomy: report + forced capture
+        # (ISSUE 12) the profiler must have folded the healthy steps
+        # above into a non-empty report with a finite bubble ratio, and
+        # an armed capture must retain real two-lane spans
+        import math as _math
+
+        anat = _get_json(f"{base}/v2/debug/anatomy?capture=6")
+        check(anat["models"]["lm"].get("armed") == 6,
+              f"anatomy capture did not arm: {anat['models']['lm'].get('armed')}")
+        code, resp = post("/v2/models/lm/generate",
+                          {"prompt": [2, 4, 6, 8], "max_new_tokens": 6})
+        check(code == 200, f"anatomy-capture generate failed: {code}")
+        anat = _get_json(f"{base}/v2/debug/anatomy")["models"]["lm"]
+        rep = anat["report"]
+        check(rep["steps_observed"] >= 3,
+              f"anatomy observed too few steps: {rep['steps_observed']}")
+        bubble = rep.get("device_bubble_ratio")
+        check(bubble is not None and _math.isfinite(bubble) and 0.0 <= bubble <= 1.0,
+              f"device_bubble_ratio not finite in [0,1]: {bubble}")
+        hr = rep.get("headroom", {})
+        check(hr.get("projected_tokens_per_s") is not None
+              and hr.get("projected_speedup") is not None
+              and _math.isfinite(hr["projected_speedup"]),
+              f"overlap-headroom projection missing: {hr}")
+        decode_phases = rep.get("phases", {}).get("decode", {})
+        for phase in ("dispatch", "execute", "readback", "bookkeep", "sample"):
+            check(decode_phases.get(phase, {}).get("count", 0) >= 1,
+                  f"decode anatomy missing the {phase} phase: "
+                  f"{sorted(decode_phases)}")
+        check(rep["capture"]["captured"] >= 1,
+              f"forced capture retained no steps: {rep['capture']}")
+        lanes = {e.get("tid") for e in anat["trace"]["traceEvents"]
+                 if e.get("ph") == "X"}
+        check({1, 2} <= lanes,
+              f"capture timeline is not two-lane (host+device): {lanes}")
+        check("flexflow_serving_step_phase_seconds_bucket" in _get(f"{base}/metrics"),
+              "/metrics missing the step_phase_seconds histogram")
+
         # ------------------------------- SLO + readiness rationale sane
         slo = _get_json(f"{base}/v2/slo")["models"]["lm"]
         check(slo["observed"] >= 3 and slo["objectives"],
@@ -566,8 +660,10 @@ def selfcheck() -> int:
           "conserves blocks, program registry populated and a forced "
           "retrace produced a correct blame string, SLO + readiness "
           "rationale live, truth ledger joined prefill/decode/verify + an "
-          "executor program, and a scaled calibration entry tripped the "
-          "drift alarm with correct blame")
+          "executor program, a scaled calibration entry tripped the "
+          "drift alarm with correct blame, and the step-anatomy profiler "
+          "reported a finite bubble ratio + overlap headroom with a "
+          "successful forced two-lane capture")
     return 0
 
 
@@ -575,15 +671,22 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("command", nargs="?", default="summary",
-                    choices=("summary", "cache", "slo", "predict"),
+                    choices=("summary", "cache", "slo", "predict", "anatomy"),
                     help="view: summary (default), cache (block "
                          "residency), slo (burn rates), predict "
-                         "(cost-model truth: error table + drift alarms)")
+                         "(cost-model truth: error table + drift alarms), "
+                         "anatomy (step phases, device bubble, overlap "
+                         "headroom)")
     ap.add_argument("--url", default="", help="base URL of a running server")
     ap.add_argument("--request", type=int, default=None,
                     help="print one request's trace waterfall")
     ap.add_argument("--timeline-out", default="",
                     help="dump the flight recorder as chrome://tracing JSON")
+    ap.add_argument("--capture", type=int, default=None,
+                    help="with `anatomy`: arm a K-step detailed capture")
+    ap.add_argument("--anatomy-out", default="",
+                    help="with `anatomy`: dump the report + two-lane "
+                         "capture timeline JSON to this file")
     ap.add_argument("--selfcheck", action="store_true",
                     help="in-process end-to-end observability check (CI)")
     args = ap.parse_args()
@@ -603,6 +706,8 @@ def main() -> int:
         return show_slo(base)
     if args.command == "predict":
         return show_predictions(base)
+    if args.command == "anatomy":
+        return show_anatomy(base, capture=args.capture, out=args.anatomy_out)
     return summarize(base)
 
 

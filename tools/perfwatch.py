@@ -54,6 +54,15 @@ METRICS: Dict[str, Tuple[str, str, float]] = {
     "ttft_p50_s": ("lower", "rel", 0.25),
     "mfu": ("higher", "rel", 0.25),
     "tracing_overhead": ("lower", "abs", 0.02),
+    # step anatomy (ISSUE 12): the tracing_overhead series now measures
+    # the anatomy-on observability arm. The gated trajectory is the
+    # UNCLAMPED hidden-host seconds per hot step — a RISE past the
+    # floor means the decode hot path got more host-bound. (The bubble
+    # ratio rides the history for humans but is NOT gated: it clamps to
+    # [0, 1] and CPU CI hosts sit near 1.0, so a ratio gate could never
+    # fire on the backend CI runs.) Wall-clock-derived -> the wide
+    # relative floor wall clocks get.
+    "host_s_per_hot_step": ("lower", "rel", 0.25),
     # shared-prefix mode (prefix caching): the improvement ratio and
     # reuse fraction are ratios of interleaved best-of-N runs, so they
     # are steadier than raw wall clocks; cached TTFT is a wall clock
