@@ -19,7 +19,18 @@ instead of trusting it.
 
 Sampling (greedy / temperature / top-k) runs inside the jitted steps —
 per-slot parameters are arrays, so mixed sampling configs share one
-program.
+program — and since ISSUE 13 the per-slot PRNG keys ALSO derive in-jit
+from (seed, generated-token count), bit-identical to the old host
+fold_in, so the hot loop assembles no keys at all.
+
+ISSUE 13's overlap support: :meth:`GenerationEngine.decode_async` /
+:meth:`GenerationEngine.consume_decode` split one decode step into a
+non-blocking dispatch (token array carried device-resident from the
+previous step, async host copy of the results started at
+dispatch-return) and a later consume — the scheduler's two-deep
+pipeline. Slot-constant args stage device-resident (``_stage``), and
+``donate_cache`` aliases the decode/verify jits' KV-cache inputs to
+their outputs (in-place update; auto on accelerators).
 """
 from __future__ import annotations
 
@@ -67,6 +78,9 @@ class SamplingParams:
     ``temperature <= 0`` means greedy (argmax); ``top_k <= 0`` disables
     the top-k filter. ``seed`` makes the request's sampling stream
     deterministic — preemption-by-recompute replays the same stream.
+    Seeds are folded as 32-bit values everywhere (the decode/verify
+    jits derive keys in-jit from a uint32 seed): values outside
+    [0, 2**32) truncate, consistently across prefill/decode/replay.
     """
 
     max_new_tokens: int = 16
@@ -118,6 +132,70 @@ def _sample(logits, temps, top_ks, keys):
     return jnp.where(greedy, jnp.argmax(logits, axis=-1), sampled).astype(jnp.int32)
 
 
+def derive_keys(seeds, counts):
+    """Per-slot sampling keys derived IN-JIT from (seed, generated-token
+    count): ``fold_in(key(seed), count)`` — bit-identical to the host
+    derivation the scheduler used before ISSUE 13 (``Request.base_key``
+    + ``fold_in`` by count), so seeded streams are unchanged while the
+    host ``sample`` phase (per-request fold_in + stack, a real host
+    dispatch per step) disappears from the critical path. Seeds are
+    folded as 32-bit values; seeds >= 2**32 truncate."""
+    return jax.vmap(lambda s, c: jax.random.fold_in(jax.random.key(s), c))(
+        seeds, counts
+    )
+
+
+def derive_window_keys(seeds, counts, window: int):
+    """[B, window] keys for a speculative window: key j of slot b is
+    ``fold_in(key(seeds[b]), counts[b] + j)`` — the same per-emitted-
+    count indexing the host-side ``Request.sample_keys`` used."""
+    offs = jnp.arange(window, dtype=jnp.int32)
+    return jax.vmap(
+        lambda s, c: jax.vmap(
+            lambda j: jax.random.fold_in(jax.random.key(s), c + j)
+        )(offs)
+    )(seeds, counts)
+
+
+class InFlightDecode:
+    """One dispatched-but-unconsumed decode step (the overlap pipeline's
+    frontier unit). Holds the device result refs, the async host copies
+    started at dispatch-return (double-buffered readback), the pre-step
+    cache refs for rollback on failure (None when the jit donates its
+    cache buffers — a failed donated step is only recoverable by
+    ``engine.reset()`` + journal replay), and the dispatch timestamps
+    the step-anatomy profiler renders. Created by
+    :meth:`GenerationEngine.decode_async`, consumed exactly once by
+    :meth:`GenerationEngine.consume_decode`. Loop-thread only."""
+
+    __slots__ = (
+        "out", "ok", "prev_k", "prev_v", "ck", "cv", "t0", "t_disp",
+        "t_started", "traced", "n_active", "ctx_sum", "consumed",
+    )
+
+    def __init__(self, out, ok, prev_k, prev_v, ck, cv, t0, t_disp, traced, n_active, ctx_sum):
+        self.out = out
+        self.ok = ok
+        self.prev_k = prev_k
+        self.prev_v = prev_v
+        # this step's cache outputs: rollback applies only while these
+        # are still the engine's current refs (a failed chain is rolled
+        # back once, to the OLDEST intact refs, never forward again)
+        self.ck = ck
+        self.cv = cv
+        self.t0 = t0
+        self.t_disp = t_disp
+        # restamped by the scheduler when the PREVIOUS in-flight step
+        # completes: with a one-deep pipeline this step only starts
+        # executing then, so the execute span (and the watchdog's view
+        # of its age) is measured from here, not from dispatch
+        self.t_started = t_disp
+        self.traced = traced
+        self.n_active = n_active
+        self.ctx_sum = ctx_sum
+        self.consumed = False
+
+
 class GenerationEngine:
     """Owns the cache, the allocator, and the jitted step family. The
     continuous-batching scheduler drives it; ``generate`` is a
@@ -137,6 +215,7 @@ class GenerationEngine:
         max_spec_tokens: int = 4,
         prefix_cache: bool = True,
         host_cache_bytes: Optional[int] = None,
+        donate_cache: Optional[bool] = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -252,9 +331,30 @@ class GenerationEngine:
         # one identity check instead of a fresh alloc + device transfer
         self._zero_bias = np.zeros((max_batch_slots,), np.float32)
         self._zero_bias_dev = jnp.zeros((max_batch_slots,), jnp.float32)
+        # KV-cache buffer donation on the hot fixed-shape programs: the
+        # decode/verify jits alias their cache inputs to their cache
+        # outputs, so XLA updates the (large) cache in place instead of
+        # copying it every step. Auto: on for accelerator backends, off
+        # on CPU — donation consumes the input buffers, which makes a
+        # FAILED step unrecoverable by retry/bisection (the supervisor
+        # then goes straight to reset + journal replay, which is
+        # byte-exact); the CPU chaos suites exercise the retry/bisect
+        # paths and keep them.
+        self.donate = bool(
+            donate_cache if donate_cache is not None
+            else jax.default_backend() != "cpu"
+        )
+        # device-resident staging for slot-constant decode/verify args
+        # (block tables, sampling params): re-uploaded only when the
+        # host-side contents change, not rebuilt via jnp.asarray every
+        # step. Keyed by arg name; each entry is (host snapshot, device
+        # array). Loop-thread only (like the cache refs).
+        self._staged: Dict[str, Tuple[np.ndarray, jax.Array]] = {}
         self._prefill_jit = jax.jit(self._prefill_impl)
-        self._decode_jit = jax.jit(self._decode_impl)
-        self._verify_jit = jax.jit(self._verify_impl)
+        dec_donate = (3, 4) if self.donate else ()  # cache_k, cache_v
+        ver_donate = (4, 5) if self.donate else ()
+        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=dec_donate)
+        self._verify_jit = jax.jit(self._verify_impl, donate_argnums=ver_donate)
         # cross-request prefix caching (generation/prefix.py): radix
         # index + refcounted COW blocks + host-RAM offload tier. The
         # block-level device programs below are admission-time only
@@ -322,14 +422,14 @@ class GenerationEngine:
         return token, ok, cache_k, cache_v
 
     def _decode_impl(
-        self, params, tokens, positions, cache_k, cache_v, block_tables, context_lens, temps, top_ks, bias, keys
+        self, params, tokens, positions, cache_k, cache_v, block_tables, context_lens, temps, top_ks, bias, seeds, counts
     ):
         self.trace_counts["decode"] = self.trace_counts.get("decode", 0) + 1
         self.programs.note_trace("decode", {
             "params": params, "tokens": tokens, "positions": positions,
             "cache_k": cache_k, "block_tables": block_tables,
             "context_lens": context_lens, "temps": temps, "top_ks": top_ks,
-            "bias": bias, "keys": keys,
+            "bias": bias, "seeds": seeds, "counts": counts,
         })
         logits, cache_k, cache_v = decode_step(
             params, tokens, positions, cache_k, cache_v, block_tables,
@@ -340,10 +440,13 @@ class GenerationEngine:
         # injected poison indistinguishable from model-produced NaN/inf
         logits = logits + bias[:, None]
         ok = jnp.all(jnp.isfinite(logits), axis=-1)
+        # sampling keys derive in-jit from (seed, token count): no host
+        # fold_in/stack on the critical path, same key bits as before
+        keys = derive_keys(seeds, counts)
         return _sample(logits, temps, top_ks, keys), ok, cache_k, cache_v
 
     def _verify_impl(
-        self, params, tokens, start, n_draft, cache_k, cache_v, block_tables, temps, top_ks, bias, keys
+        self, params, tokens, start, n_draft, cache_k, cache_v, block_tables, temps, top_ks, bias, seeds, counts
     ):
         """Speculative verification: score a [B, W] window (committed
         token + drafts) in one forward and accept/emit in-jit.
@@ -356,9 +459,10 @@ class GenerationEngine:
             "params": params, "tokens": tokens, "start": start,
             "n_draft": n_draft, "cache_k": cache_k,
             "block_tables": block_tables, "temps": temps, "top_ks": top_ks,
-            "bias": bias, "keys": keys,
+            "bias": bias, "seeds": seeds, "counts": counts,
         })
         w = tokens.shape[1]
+        keys = derive_window_keys(seeds, counts, w)  # in-jit, see decode
         offs = jnp.arange(w, dtype=jnp.int32)[None, :]
         # window token j sits at cache position start + j; slots past the
         # drafts (and whole inactive rows) are padding -> position -1
@@ -885,6 +989,48 @@ class GenerationEngine:
 
         return self.prefix_cache.reclaim(max(1, n_blocks), read)
 
+    def _stage(self, name: str, host: np.ndarray) -> jax.Array:
+        """Device-resident staging: upload ``host`` once and reuse the
+        device array until the contents change. Slot-constant decode/
+        verify args (block tables, sampling params, seeds) change only
+        on batch-composition events, so steady state stops paying a
+        fresh ``jnp.asarray`` per arg per step. The host snapshot is
+        copied — callers may mutate their arrays in place afterwards."""
+        cached = self._staged.get(name)
+        if (
+            cached is not None
+            and cached[0].shape == host.shape
+            and cached[0].dtype == host.dtype
+            and np.array_equal(cached[0], host)
+        ):
+            return cached[1]
+        dev = jnp.asarray(host)
+        self._staged[name] = (host.copy(), dev)
+        return dev
+
+    def _decode_args(self, positions, block_tables, active, temps, top_ks, seeds, counts, bias):
+        """Assemble the decode jit's argument tuple (minus the token
+        array, which the pipelined path carries device-resident)."""
+        context_lens = np.where(active, positions + 1, 0).astype(np.int32)
+        safe_pos = np.where(active, positions, 0).astype(np.int32)
+        # scratch-mask inactive slots' tables too: an inactive slot with
+        # a REAL table (a bisection probe deactivating a live slot)
+        # would otherwise write its position-0 K/V into that slot's
+        # first real block and silently corrupt the surviving stream
+        tables = np.where(active[:, None], block_tables, 0).astype(np.int32)
+        return (
+            jnp.asarray(safe_pos),
+            self.cache.k,
+            self.cache.v,
+            self._stage("decode.tables", tables),
+            jnp.asarray(context_lens),
+            self._stage("decode.temps", temps.astype(np.float32)),
+            self._stage("decode.top_ks", top_ks.astype(np.int32)),
+            self._bias_arg(bias),
+            self._stage("decode.seeds", seeds.astype(np.uint32)),
+            jnp.asarray(counts.astype(np.int32)),
+        ), context_lens
+
     def decode(
         self,
         tokens: np.ndarray,
@@ -893,38 +1039,26 @@ class GenerationEngine:
         active: np.ndarray,
         temps: np.ndarray,
         top_ks: np.ndarray,
-        keys: jax.Array,
+        seeds: np.ndarray,
+        counts: np.ndarray,
     ) -> np.ndarray:
         """One decode step across all ``max_batch_slots`` slots. Arrays
         are slot-indexed; inactive slots (active[i] False) write to
         scratch and return garbage tokens the scheduler ignores. After
         the call ``last_finite[i]`` says whether slot i's logits were
-        finite — the supervisor's per-slot NaN blame vector."""
+        finite — the supervisor's per-slot NaN blame vector.
+        ``seeds``/``counts`` replace the old host-built key array: the
+        per-slot sampling key derives in-jit (see :func:`derive_keys`)."""
         masked = np.where(active, tokens, 0).astype(np.int32)
         masked, bias = faults.inject(faults.GENERATION_DECODE_STEP, (masked, self._zero_bias))
         self.step_counts["decode"] += 1
         t0 = time.perf_counter()
         traces_before = self.trace_counts.get("decode", 0)
-        context_lens = np.where(active, positions + 1, 0).astype(np.int32)
-        safe_pos = np.where(active, positions, 0).astype(np.int32)
-        # scratch-mask inactive slots' tables too: an inactive slot with
-        # a REAL table (a bisection probe deactivating a live slot)
-        # would otherwise write its position-0 K/V into that slot's
-        # first real block and silently corrupt the surviving stream
-        tables = np.where(active[:, None], block_tables, 0).astype(np.int32)
-        out, ok, ck, cv = self._decode_jit(
-            self.params,
-            jnp.asarray(masked),
-            jnp.asarray(safe_pos),
-            self.cache.k,
-            self.cache.v,
-            jnp.asarray(tables),
-            jnp.asarray(context_lens),
-            jnp.asarray(temps.astype(np.float32)),
-            jnp.asarray(top_ks.astype(np.int32)),
-            self._bias_arg(bias),
-            keys,
+        args, context_lens = self._decode_args(
+            positions, block_tables, active, temps, top_ks, seeds,
+            counts, bias,
         )
+        out, ok, ck, cv = self._decode_jit(self.params, jnp.asarray(masked), *args)
         t_disp = time.perf_counter()
         jax.block_until_ready((out, ok, ck, cv))  # device execution done
         t_exec = time.perf_counter()
@@ -934,9 +1068,21 @@ class GenerationEngine:
         elapsed, execute_s = self._record_step_phases("decode", t0, t_disp, t_exec)
         # success-only, paired with the time below (see prefill())
         n_active, ctx_sum = int(active.sum()), int(context_lens.sum())
+        self._account_decode(
+            n_active, ctx_sum,
+            self.trace_counts.get("decode", 0) > traces_before,
+            elapsed, execute_s,
+        )
+        return result
+
+    def _account_decode(self, n_active, ctx_sum, traced, elapsed, execute_s):
+        """Post-success decode accounting, shared by the blocking and
+        pipelined paths: FLOPs accrue next to the time they pair with;
+        a compile call registry-stamps its wall time instead of feeding
+        the truth ledger."""
         flops = self.flops_model.decode_flops(n_active, ctx_sum)
         self.flops_by_kind["decode"] += flops
-        if self.trace_counts.get("decode", 0) > traces_before:
+        if traced:
             self.programs.set_compile_time("decode", elapsed)
         else:
             # EXECUTED work: the fixed-shape program runs every batch
@@ -954,6 +1100,109 @@ class GenerationEngine:
                 provenance="serving roofline (ServingFlops x chip peak)",
                 alarm=self._roofline_alarm,
             )
+
+    def decode_async(
+        self,
+        tokens: Optional[np.ndarray],
+        positions: np.ndarray,
+        block_tables: np.ndarray,
+        active: np.ndarray,
+        temps: np.ndarray,
+        top_ks: np.ndarray,
+        seeds: np.ndarray,
+        counts: np.ndarray,
+        tokens_dev: Optional[jax.Array] = None,
+    ) -> InFlightDecode:
+        """Dispatch one decode step WITHOUT blocking on it: the overlap
+        pipeline's front half. Returns an :class:`InFlightDecode` whose
+        result :meth:`consume_decode` collects one scheduler iteration
+        later — the async host copy of the sampled tokens starts here,
+        at dispatch-return, so the eventual readback is a wait on an
+        already-moving transfer (double-buffered readback), not a fresh
+        synchronous device round trip.
+
+        ``tokens_dev`` carries the PREVIOUS step's sampled-token device
+        array straight back in (device-resident staging: steady-state
+        decode uploads no token array at all and XLA chains the steps
+        on-device); ``tokens`` is the host token array for the
+        pipeline's first step (or None in carry mode — the fault site
+        still fires with the same (tokens, bias) value shape). Inactive
+        slots in carry mode embed whatever garbage token the dead slot
+        sampled; their writes land in scratch and their outputs are
+        dropped, exactly like the host-masked path."""
+        if tokens_dev is None:
+            masked = np.where(active, tokens, 0).astype(np.int32)
+        else:
+            masked = None
+        masked, bias = faults.inject(
+            faults.GENERATION_DECODE_STEP, (masked, self._zero_bias)
+        )
+        self.step_counts["decode"] += 1
+        t0 = time.perf_counter()
+        traces_before = self.trace_counts.get("decode", 0)
+        args, context_lens = self._decode_args(
+            positions, block_tables, active, temps, top_ks, seeds,
+            counts, bias,
+        )
+        tok_arg = tokens_dev if tokens_dev is not None else jnp.asarray(masked)
+        prev_k, prev_v = (None, None) if self.donate else (self.cache.k, self.cache.v)
+        out, ok, ck, cv = self._decode_jit(self.params, tok_arg, *args)
+        t_disp = time.perf_counter()
+        # start the device->host copies NOW; consume_decode's numpy
+        # conversion then finds the bytes already resident
+        out.copy_to_host_async()
+        ok.copy_to_host_async()
+        self.cache.update(ck, cv)
+        self.phase_time_s["decode"]["dispatch"] += t_disp - t0
+        return InFlightDecode(
+            out, ok, prev_k, prev_v, ck, cv, t0, t_disp,
+            traced=self.trace_counts.get("decode", 0) > traces_before,
+            n_active=int(active.sum()), ctx_sum=int(context_lens.sum()),
+        )
+
+    def consume_decode(self, step: InFlightDecode) -> np.ndarray:
+        """Block on an in-flight decode step and finish its accounting:
+        the overlap pipeline's back half. On failure the pre-step cache
+        refs are restored (non-donating engines only) so the scheduler
+        can re-run the step sequentially under the supervisor's normal
+        retry/bisect machinery; a donating engine's failed step is
+        handled by reset + journal replay instead."""
+        if step.consumed:
+            raise RuntimeError("InFlightDecode consumed twice")
+        step.consumed = True
+        t_block = time.perf_counter()
+        try:
+            jax.block_until_ready((step.out, step.ok))
+        except Exception:
+            if step.prev_k is not None:
+                # roll the cache back to the pre-step refs: the failed
+                # program's outputs (and any successor chained on them)
+                # are poisoned, while the inputs are still intact. A
+                # successor's own discard must NOT restore forward over
+                # this (it checks its outputs are still current).
+                self.cache.update(step.prev_k, step.prev_v)
+            raise
+        t_exec = time.perf_counter()
+        self.last_finite = np.asarray(step.ok)
+        result = np.asarray(step.out)  # async copy already landed
+        t_read = time.perf_counter()
+        ph = self.phase_time_s["decode"]
+        ph["execute"] += t_exec - step.t_started
+        ph["readback"] += t_read - t_exec
+        # two-lane spans: "execute" starts at t_started (when the device
+        # actually began this step — restamped by the scheduler at the
+        # previous step's completion), "block" is only the host's park
+        # inside THIS call. The lanes genuinely diverge under overlap.
+        self.last_step_spans = [
+            ("block", t_block, t_exec),
+            ("execute", step.t_started, t_exec),
+            ("readback", t_exec, t_read),
+        ]
+        self._account_decode(
+            step.n_active, step.ctx_sum, step.traced,
+            elapsed=t_read - step.t0,
+            execute_s=t_exec - step.t_started,
+        )
         return result
 
     def _bias_arg(self, bias) -> jax.Array:
@@ -971,7 +1220,8 @@ class GenerationEngine:
         block_tables: np.ndarray,
         temps: np.ndarray,
         top_ks: np.ndarray,
-        keys: jax.Array,
+        seeds: np.ndarray,
+        counts: np.ndarray,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """One speculative verification step across all slots.
 
@@ -979,11 +1229,14 @@ class GenerationEngine:
         token followed by its drafts (then padding); ``start`` [B]: the
         committed token's cache position (the slot's ``cached_len``);
         ``n_draft`` [B]: real drafts per slot, -1 for inactive slots;
-        ``keys`` [B, spec_window]: per-emitted-count sampling keys.
-        Returns (out_tokens [B, spec_window], n_emitted [B]) — the
-        scheduler keeps ``out_tokens[i, :n_emitted[i]]`` (further
-        truncated by EOS / budget). ONE fixed-shape jit: per-request
-        adaptive k only changes ``n_draft`` values, never the shape.
+        ``seeds``/``counts`` [B]: per-slot sampling seed and generated-
+        token count — the [B, spec_window] per-emitted-count key matrix
+        derives in-jit (:func:`derive_window_keys`), deleting the host
+        key-assembly phase. Returns (out_tokens [B, spec_window],
+        n_emitted [B]) — the scheduler keeps
+        ``out_tokens[i, :n_emitted[i]]`` (further truncated by EOS /
+        budget). ONE fixed-shape jit: per-request adaptive k only
+        changes ``n_draft`` values, never the shape.
         """
         window = window_tokens.astype(np.int32)
         window, bias = faults.inject(faults.GENERATION_VERIFY, (window, self._zero_bias))
@@ -1006,11 +1259,12 @@ class GenerationEngine:
             jnp.asarray(n_draft.astype(np.int32)),
             self.cache.k,
             self.cache.v,
-            jnp.asarray(block_tables.astype(np.int32)),
-            jnp.asarray(temps.astype(np.float32)),
-            jnp.asarray(top_ks.astype(np.int32)),
+            self._stage("verify.tables", block_tables.astype(np.int32)),
+            self._stage("verify.temps", temps.astype(np.float32)),
+            self._stage("verify.top_ks", top_ks.astype(np.int32)),
             self._bias_arg(bias),
-            keys,
+            self._stage("verify.seeds", seeds.astype(np.uint32)),
+            jnp.asarray(counts.astype(np.int32)),
         )
         t_disp = time.perf_counter()
         jax.block_until_ready((out, n_emitted, ok, ck, cv))  # execution done

@@ -203,7 +203,11 @@ class EngineSupervisor:
         self._restart_times: List[float] = []
         self._consecutive = 0  # restarts since the last healthy step
         self._stall_lock = threading.Lock()
-        self._stalled_seq: Optional[int] = None  # heartbeat seq the watchdog tripped on
+        # heartbeat seq the watchdog tripped on; the overlap pipeline's
+        # consume arbitration (scheduler._consume_and_finish) and the
+        # sequential run_step/resume_step ladders both pop it via
+        # _consume_stall  # guarded-by: _stall_lock
+        self._stalled_seq: Optional[int] = None  # guarded-by: _stall_lock
         self.failed = False  # restart budget exhausted; engine declared dead
 
     def note_engine_recovered(self) -> None:
@@ -256,6 +260,14 @@ class EngineSupervisor:
             if self._consume_stall(seq0):
                 self._restart_and_replay(e, kind)
                 return None
+            if getattr(sched.engine, "donate", False):
+                # a donating engine's failed jit call consumed its cache
+                # input buffers: retrying the same closure (and every
+                # bisection probe) would re-pass deleted arrays and blame
+                # innocent requests — reset + journal replay is the only
+                # sound recovery (byte-exact; documented with donate_cache)
+                self._restart_and_replay(e, kind)
+                return None
             if not self.policy.retry_step_once:
                 self._handle_double_failure(e, kind, states, probe)
                 return None
@@ -280,6 +292,54 @@ class EngineSupervisor:
             )
             return None
         self._consecutive = 0  # healthy step: backoff curve restarts
+        return out
+
+    def resume_step(self, kind, first_error, step_fn, states, probe, since_seq):
+        """A PIPELINED (async-dispatched) step failed. Resume the
+        sequential supervision ladder from the point just after a
+        synchronous step's first failure, so the outcome AND the
+        accounting match ``run_step`` exactly: a retryable error is
+        re-run invisibly (the treatment RetryPolicy.run would have
+        given it inside the same ``_device`` call), a hard error pays
+        one breaker failure, then the supervised retry -> bisect ->
+        restart ladder. ``since_seq`` scopes stall flags to the failed
+        chain's own device calls (the overlap frontier's ``seq0``)."""
+        sched = self.scheduler
+        if sched.retry.would_retry(first_error):
+            return self.run_step(kind, step_fn, states, probe)
+        sched.flight.record_event(
+            "step_failed", step=kind, error=repr(first_error)[:200]
+        )
+        sched.breaker.record_failure()
+        if self._consume_stall(since_seq):
+            self._restart_and_replay(first_error, kind)
+            return None
+        if getattr(sched.engine, "donate", False):
+            # unreachable from _pipeline_failure (it checks donate first)
+            # but kept for any future caller: see run_step
+            self._restart_and_replay(first_error, kind)
+            return None
+        if not self.policy.retry_step_once:
+            self._handle_double_failure(first_error, kind, states, probe)
+            return None
+        self.stats.incr("step_retries")
+        sched.flight.record_event("step_retry", step=kind)
+        try:
+            out = sched._device(step_fn)
+        except Exception as e2:
+            sched.flight.record_event("step_failed", step=kind, error=repr(e2)[:200])
+            if self._consume_stall(since_seq):
+                self._restart_and_replay(e2, kind)
+                return None
+            self._handle_double_failure(e2, kind, states, probe)
+            return None
+        if self._consume_stall(since_seq):
+            self._restart_and_replay(
+                StalledStepError(f"{kind} step exceeded the watchdog stall timeout"),
+                kind,
+            )
+            return None
+        self._consecutive = 0
         return out
 
     def _handle_double_failure(self, err, kind, states, probe) -> None:
@@ -337,6 +397,10 @@ class EngineSupervisor:
         budget unit and backs off further."""
         sched = self.scheduler
         pol = self.policy
+        # the overlap frontier's in-flight step (if any) is chained on
+        # state this reset is about to tear down: discard it before
+        # touching the engine (idempotent; pipeline callers already did)
+        sched._discard_frontier()
         # postmortem FIRST: the snapshot must show the engine's last
         # steps (including the step_failed marker) before reset rebuilds
         # the world; attached to the cause so a later give-up's
@@ -420,7 +484,18 @@ class StepWatchdog:
     it opens the circuit breaker, marks the supervisor so the step's
     late result is discarded in favor of a journal-replay restart, and
     fails deadline-expired requests' *handles* (slots/blocks stay with
-    the loop thread — the only thread allowed to touch them)."""
+    the loop thread — the only thread allowed to touch them).
+
+    Overlap pipeline stamping (ISSUE 13): an async-dispatched step
+    stamps its heartbeat at DISPATCH and is re-stamped when its
+    predecessor COMPLETES — the moment it actually starts executing on
+    the serial device queue — so each step's heartbeat age measures its
+    OWN device time. Without the completion re-stamp, a one-step-deep
+    pipeline at long execute times would accumulate dispatch-to-consume
+    windows spanning two steps and be misread as a wedged loop
+    (regression-tested on a virtual clock in tests/test_overlap.py).
+    A consume that never returns leaves the stamp aging until the trip
+    fires, exactly like a wedged synchronous call."""
 
     def __init__(
         self,

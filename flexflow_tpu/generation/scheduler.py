@@ -56,6 +56,22 @@ so chaos tests run on virtual time. Fault sites: ``generation.prefill``,
   requests are held behind the breaker, never failed with the engine's
   internal error.
 
+* **overlapped decode** (ISSUE 13, on by default; ``overlap=False``
+  restores the sequential loop bit-for-bit): steady-state decode runs
+  as a two-deep software pipeline — step N+1's fixed-shape jit is
+  dispatched (sampled tokens carried device-resident from step N's
+  output) while step N's device work completes, token readback is
+  double-buffered, and host bookkeeping runs inside N+1's execute
+  window. An in-flight *frontier* of at most one outstanding step
+  drains deterministically on every non-steady event (admission,
+  EOS/finish, preemption pressure, cancel/deadline, speculation,
+  crash, watchdog trip, shutdown), so supervisor bisection, NaN blame,
+  journal replay, and fleet failover observe exactly the sequential
+  semantics — token streams are byte-identical with overlap on/off
+  (tests/test_overlap.py). The speculative verify path stays
+  sequential by design: drafting needs step N's committed tokens on
+  the host, so there is no overlap window.
+
 The scheduler is synchronous-by-design: ``step()`` does one iteration
 and returns, so property tests drive it deterministically; ``start()``
 wraps it in a background thread for serving.
@@ -109,6 +125,7 @@ from .recovery import (
     GenerationJournal,
     PoisonedRequestError,
     RecoveryPolicy,
+    StalledStepError,
     StepWatchdog,
     WatchdogPolicy,
 )
@@ -248,8 +265,12 @@ class Request:
         # seed-only (no request-id mixing): the same seed + prompt +
         # params must reproduce the same tokens, run to run (with
         # temperature speculation: under the same window layout — see
-        # speculative/sampling.py on realization-invariance)
-        self.base_key = jax.random.key(sampling.seed)
+        # speculative/sampling.py on realization-invariance). Folded as
+        # 32 bits to match the decode/verify jits' in-jit derivation
+        # (engine.derive_keys): prefill and decode MUST agree or a
+        # preemption-recompute would fork the stream for seeds outside
+        # [0, 2**32); in-range seeds are unchanged.
+        self.base_key = jax.random.key(sampling.seed & 0xFFFFFFFF)
         # speculation state: live k adapts inside [1, config.k]; the
         # drafter is a pure function of the prefix, so preemption needs
         # no drafter checkpointing
@@ -286,17 +307,11 @@ class Request:
 
     def sample_key(self) -> jax.Array:
         """Key for the NEXT token: indexed by generated count, so a
-        recomputed request continues its exact sampling stream."""
+        recomputed request continues its exact sampling stream. Used by
+        the (admission-time) prefill only — the hot decode/verify steps
+        derive the same keys IN-JIT from (seed, count) via
+        engine.derive_keys, deleting the host key-assembly phase."""
         return jax.random.fold_in(self.base_key, self.n_generated)
-
-    def sample_keys(self, window: int) -> jax.Array:
-        """Keys for the next ``window`` token counts (a speculative
-        window's per-emitted-token streams): key j belongs to the token
-        emitted at count ``n_generated + j``, the same per-count
-        indexing as :meth:`sample_key`. One vmapped fold_in, not
-        ``window`` host dispatches."""
-        counts = self.n_generated + jnp.arange(window, dtype=jnp.int32)
-        return jax.vmap(lambda n: jax.random.fold_in(self.base_key, n))(counts)
 
     def update_speculation(self, proposed: int, accepted: int) -> None:
         """Fold one verification window into the adaptive-k state."""
@@ -348,6 +363,37 @@ class _Running:
         self.shared_entries = shared_entries if shared_entries is not None else []
 
 
+class _Frontier:
+    """The overlap pipeline's in-flight frontier: AT MOST ONE
+    outstanding decode step. Captures the dispatch-time slot states and
+    the host-side argument arrays (reused — bumped by one — for the
+    next dispatch, so steady state rebuilds nothing), plus the
+    heartbeat seq the watchdog/stall bookkeeping is keyed on. ``seq0``
+    is the scheduler's heartbeat seq just BEFORE this dispatch: a stall
+    flagged on any later seq belongs to this frontier chain and voids
+    its (late) result. Loop-thread only."""
+
+    __slots__ = (
+        "handle", "states", "positions", "active", "temps", "top_ks",
+        "seeds", "counts", "tables", "sig", "hb_seq", "seq0",
+    )
+
+    def __init__(self, handle, states, positions, active, temps, top_ks,
+                 seeds, counts, tables, sig, hb_seq, seq0):
+        self.handle = handle
+        self.states = states
+        self.positions = positions
+        self.active = active
+        self.temps = temps
+        self.top_ks = top_ks
+        self.seeds = seeds
+        self.counts = counts
+        self.tables = tables
+        self.sig = sig
+        self.hb_seq = hb_seq
+        self.seq0 = seq0
+
+
 class ContinuousBatchingScheduler:
     def __init__(
         self,
@@ -369,6 +415,7 @@ class ContinuousBatchingScheduler:
         slo_objectives=None,
         pressure_threshold: float = 0.10,
         fault_scope: Optional[str] = None,
+        overlap: Optional[bool] = None,
     ):
         self.engine = engine
         # fleet integration (serving/fleet.py): fault_scope tags every
@@ -522,7 +569,27 @@ class ContinuousBatchingScheduler:
             "perf_drift_alarms", lambda: self.engine.ledger.alarms_total
         )
         self.engine.ledger.on_alarm = self._note_drift
-        self._dummy_keys = None  # inactive-slot key rows, built once
+        # overlapped decode (ISSUE 13): steady-state decode runs as a
+        # two-deep software pipeline — step N+1 dispatched (tokens
+        # carried device-resident from step N's output) while step N's
+        # device work completes, its readback double-buffered, host
+        # bookkeeping hidden inside N+1's execute window. Any non-steady
+        # event (admission, finish/EOS, preempt, expiry, speculation,
+        # crash, watchdog trip, shutdown) first DRAINS the in-flight
+        # frontier deterministically, so recovery/replay/failover all
+        # observe exactly the sequential semantics. _pipe (the at-most-
+        # one-deep frontier) and its companions are loop-thread-only,
+        # like _running; the heartbeat hand-off to the watchdog thread
+        # stays the documented GIL-atomic tuple swap.
+        self.overlap = True if overlap is None else bool(overlap)
+        self._pipe: Optional[_Frontier] = None
+        # plain counters (read by tests/genbench, not /metrics gauges):
+        # dispatches that went through the pipeline, frontier drains by
+        # reason, and in-flight steps discarded (recomputed exactly by
+        # the next sequential step)
+        self.pipe_dispatches = 0
+        self.pipe_drains: Dict[str, int] = {}
+        self.pipe_discards = 0
         # self-healing (recovery.py): journal + supervisor + watchdog.
         # _heartbeat is (seq, started_at) while a device call is in
         # flight — the watchdog's stall signal
@@ -706,6 +773,7 @@ class ContinuousBatchingScheduler:
         the supervisor journal-replays running streams and HOLDS queued
         requests, so a queued-but-never-admitted request can no longer
         be failed with some other request's engine-internal error."""
+        self._discard_frontier()  # shutdown: in-flight results are moot
         with self._lock:
             queued, self._queue = list(self._queue), deque()
         for req in queued:
@@ -739,6 +807,17 @@ class ContinuousBatchingScheduler:
             state.blocks = []
             state.shared_idx = set()
             state.shared_entries = []
+        # streams that already completed their budget/EOS at a pipeline
+        # consume but were still awaiting release when the engine died:
+        # they hold every token — complete them, never fail or migrate
+        done_states = [
+            s for s in states
+            if not s.req.handle.done() and s.req.finished()
+        ]
+        for s in done_states:
+            s.req.handle._finish(list(s.req.generated))
+            self.stats.incr("completed")
+        states = [s for s in states if s not in done_states]
         sink = self.failover_sink
         if sink is not None:
             with self._lock:
@@ -791,6 +870,14 @@ class ContinuousBatchingScheduler:
         for entry in entries:
             req = entry.req
             if req.handle.done():  # reaped (deadline) while the engine was down
+                continue
+            if req.finished():
+                # completed its budget/EOS before the teardown (a
+                # pipeline consume can finish a stream whose release
+                # was still pending when the restart hit): it already
+                # holds every token — complete it, never replay it
+                req.handle._finish(list(req.generated))
+                self.stats.incr("completed")
                 continue
             req.prompt = req.original_prompt + list(req.generated)
             req.replays += 1
@@ -1367,7 +1454,9 @@ class ContinuousBatchingScheduler:
         seed token (last emitted, not yet cached), its cache position,
         block tables, the live mask, and per-slot sampling params —
         shared by the decode and verify assemblies so the two paths
-        cannot drift."""
+        cannot drift. ``seeds``/``counts`` feed the engine's in-jit
+        sampling-key derivation (ISSUE 13): byte-identical keys to the
+        old host fold_in, with zero host key assembly on the hot path."""
         b = self.engine.max_batch_slots
         last = np.zeros((b,), np.int32)
         start = np.zeros((b,), np.int32)
@@ -1375,6 +1464,8 @@ class ContinuousBatchingScheduler:
         active = np.zeros((b,), bool)
         temps = np.zeros((b,), np.float32)
         top_ks = np.zeros((b,), np.int32)
+        seeds = np.zeros((b,), np.uint32)
+        counts = np.zeros((b,), np.int32)
         for state in order:
             i = state.slot
             req = state.req
@@ -1384,7 +1475,9 @@ class ContinuousBatchingScheduler:
             active[i] = True
             temps[i] = req.sampling.temperature
             top_ks[i] = req.sampling.top_k
-        return last, start, tables, active, temps, top_ks
+            seeds[i] = req.sampling.seed & 0xFFFFFFFF
+            counts[i] = req.n_generated
+        return last, start, tables, active, temps, top_ks, seeds, counts
 
     def _quarantine_nan(self, kind: str, order) -> bool:
         """Act on the engine's per-slot NaN blame vector after a step
@@ -1418,26 +1511,21 @@ class ContinuousBatchingScheduler:
             )
         return False
 
-    def _decode_once(self) -> bool:
-        if not self._running:
-            return False
+    def _decode_step_fns(self, order):
+        """The sequential decode step and its bisection probe over
+        ``order``, built from ONE slot collection — shared by the
+        sequential iteration and the pipeline-failure re-run so the two
+        can never drift. (The old host "sample" phase — per-request
+        fold_in + stack — is gone: sampling keys derive in-jit from
+        (seed, count).)"""
         b = self.engine.max_batch_slots
-        t_c0 = time.perf_counter()
-        order = sorted(self._running.values(), key=lambda s: s.slot)
-        tokens, positions, tables, active, temps, top_ks = self._collect_slots(order)
-        t_c1 = time.perf_counter()
-        self._span("schedule", t_c0, t_c1)
-        # per-request sampling-key assembly is a first-class phase
-        # (sample): fold_in + stack are real host dispatches that used
-        # to hide in the untimed gap before the device call
-        key_by_slot = {s.slot: s.req.sample_key() for s in order}
-        dummy = jax.random.key(0)
-        keys = jnp.stack([key_by_slot.get(i, dummy) for i in range(b)])
-        self._span("sample", t_c1, time.perf_counter())
+        (tokens, positions, tables, active, temps, top_ks, seeds,
+         counts) = self._collect_slots(order)
 
         def step():
             return self.engine.decode(
-                tokens, positions, tables, active, temps, top_ks, keys
+                tokens, positions, tables, active, temps, top_ks, seeds,
+                counts,
             )
 
         def probe(subset):
@@ -1448,10 +1536,21 @@ class ContinuousBatchingScheduler:
                 act[s.slot] = True
             self._probe_call(
                 lambda: self.engine.decode(
-                    tokens, positions, tables, act, temps, top_ks, keys
+                    tokens, positions, tables, act, temps, top_ks, seeds,
+                    counts,
                 )
             )
 
+        return step, probe
+
+    def _decode_once(self) -> bool:
+        if not self._running:
+            return False
+        t_c0 = time.perf_counter()
+        order = sorted(self._running.values(), key=lambda s: s.slot)
+        step, probe = self._decode_step_fns(order)
+        t_c1 = time.perf_counter()
+        self._span("schedule", t_c0, t_c1)
         ph, info = self._step_phases, self._step_info
         info["kind"] = "decode"
         t_dev = time.perf_counter()
@@ -1465,21 +1564,385 @@ class ContinuousBatchingScheduler:
             info["handled_failure"] = True
             return True
         t_book = time.perf_counter()
+        n_live, _ = self._scatter_decode(order, out)
+        self._span("bookkeep", t_book, time.perf_counter())
+        info["emitted"] = n_live
+        self.token_rate.record(n_live)
+        return True
+
+    def _scatter_decode(self, order, out, defer_finish: bool = False):
+        """Scatter one decode step's sampled tokens back onto the slot
+        states (shared by the sequential step, the pipeline consume,
+        and the pipeline-failure sequential re-run). Returns
+        (n_emitted, finished_states). ``defer_finish`` is the pipeline
+        case: a finished slot's blocks must not be released while a
+        successor step is still in flight over them — the caller drains
+        the frontier first, then finishes. A slot that ALREADY finished
+        at a previous consume is skipped outright (its token in a
+        drained in-flight step is one a sequential scheduler would
+        never have decoded)."""
         n_live = 0
+        finish = []
         for state in order:
             if self._running.get(state.slot) is not state:
                 continue  # preempted/expired between collect and scatter
             if state.req.handle.done():
                 continue  # watchdog-reaped mid-step; _expire releases it
+            if state.req.finished():
+                continue  # finished at a previous pipeline consume
             state.cached_len += 1
             self._emit_token(state, int(out[state.slot]))
             state.req.trace.note_tokens(1, "decode")
             n_live += 1
             if state.req.finished():
+                finish.append(state)
+        if not defer_finish:
+            for state in finish:
                 self._finish(state)
-        self._span("bookkeep", t_book, time.perf_counter())
-        info["emitted"] = n_live
+        return n_live, finish
+
+    # ----------------------------------------------------- overlap pipeline
+    def _nonsteady(self, now: float) -> bool:
+        """True when THIS iteration must run the sequential path (after
+        a deterministic frontier drain): any event whose handling
+        mutates slot/block state the in-flight step depends on, or
+        whose semantics are defined sequentially — admission, finish,
+        cancel/deadline, speculation, shutdown, a declared-dead
+        engine."""
+        if self._draining or self._hard_stop or self.supervisor.failed:
+            return True
+        if self._queue:
+            with self._lock:
+                queued = list(self._queue)
+            for req in queued:
+                if req.handle.done() or req.cancelled or (
+                    req.deadline is not None and now >= req.deadline
+                ):
+                    return True  # queue expiry needs the sequential sweep
+            if self._free_slots and self.breaker.ready():
+                return True  # an admission could actually place
+        for s in self._running.values():
+            req = s.req
+            if (
+                req.handle.done()
+                or req.cancelled
+                or req.finished()
+                or (req.deadline is not None and now >= req.deadline)
+                or req.drafter is not None
+            ):
+                return True
+        return False
+
+    def _discard_frontier(self) -> None:
+        """Drop the in-flight step WITHOUT bookkeeping: its sampled
+        tokens are never emitted, so the next sequential step recomputes
+        them byte-identically (the step's K/V writes are idempotent
+        rewrites of the same positions from the same inputs). Used when
+        the in-flight result is tainted (NaN blame, stall, failure) or
+        moot (shutdown, engine reset). Swallows the step's own error —
+        the caller decides how the failure is handled."""
+        f, self._pipe = self._pipe, None
+        if f is None:
+            return
+        try:
+            jax.block_until_ready((f.handle.out, f.handle.ok))
+        except Exception:
+            # restore the pre-step cache refs so a sequential re-run
+            # reads intact inputs — but only while this step's outputs
+            # are still current: a predecessor's consume failure may
+            # already have rolled the whole chain back to OLDER intact
+            # refs, and restoring forward would resurrect errored
+            # arrays. (Non-donating engines; a donating engine only
+            # reaches here on the reset + replay path.)
+            h = f.handle
+            if h.prev_k is not None and self.engine.cache.k is h.ck:
+                self.engine.cache.update(h.prev_k, h.prev_v)
+        self.pipe_discards += 1
+        self._heartbeat = None
+
+    def _drain_frontier(self, reason: str) -> None:
+        """Deterministically empty the pipeline before a non-steady
+        event: consume the in-flight step with FULL bookkeeping (tokens
+        emitted, finishes resolved), so the scheduler state afterwards
+        is exactly what a sequential scheduler would hold at the same
+        point in every stream. Never raises — device failures take the
+        pipeline-failure path (sequential supervisor semantics)."""
+        f, self._pipe = self._pipe, None
+        if f is None:
+            return
+        self.pipe_drains[reason] = self.pipe_drains.get(reason, 0) + 1
+        try:
+            self._consume_and_finish(f)
+        except Exception as e:
+            self._pipeline_failure(e, f.seq0)
+
+    def _consume_and_finish(self, f: "_Frontier"):
+        """Consume one in-flight decode step: blocked (double-buffered)
+        readback, watchdog/stall arbitration, NaN blame, token scatter
+        — then, if any stream finished, drain the successor frontier
+        before releasing its blocks. Returns tokens emitted, or None
+        when a failure was fully handled here (restart or whole-batch
+        blame). Device errors propagate to the caller's
+        pipeline-failure handling."""
+        faults.inject(faults.GENERATION_ASYNC_READBACK, ("decode", len(f.states)))
+        t_b0 = time.perf_counter()
+        out = self.engine.consume_decode(f.handle)
+        # completion stamp (satellite: dispatch AND completion): the
+        # successor — if any — only starts device work now, so its
+        # heartbeat age and execute span are measured from here; a
+        # one-deep pipeline at long execute times is therefore never
+        # misread as a wedged loop, while a consume that never returns
+        # ages its own dispatch stamp until the watchdog trips
+        nf = self._pipe
+        if nf is not None:
+            self._heartbeat = (nf.hb_seq, self.clock())
+            nf.handle.t_started = time.perf_counter()
+        else:
+            self._heartbeat = None
+        ph = self._step_phases
+        ph["device"] = ph.get("device", 0.0) + (time.perf_counter() - t_b0)
+        self._step_info["execute_s"] = (
+            self._step_info.get("execute_s", 0.0) + self._engine_spans()
+        )
+        if self.supervisor._consume_stall(f.seq0):
+            # the watchdog tripped while this chain was in flight: the
+            # late result is stale — discard everything and replay
+            # (exactly run_step's post-success stall arbitration). The
+            # restart-inflated iteration stays out of the hot anatomy
+            # window, like every handled failure (the PR 12 rule).
+            self._step_info["handled_failure"] = True
+            self._discard_frontier()
+            self.supervisor._restart_and_replay(
+                StalledStepError("decode step exceeded the watchdog stall timeout"),
+                "decode",
+            )
+            return None
+        ok = self.engine.last_finite
+        live = [s for s in f.states if self._running.get(s.slot) is s]
+        if any(not bool(ok[s.slot]) for s in live):
+            # the successor was dispatched from this step's (poisoned)
+            # token carry: discard it wholesale, then apply the standard
+            # blame rules — partial blame quarantines and keeps the
+            # survivors' tokens from THIS step, whole-batch restarts
+            self._discard_frontier()
+            if self._quarantine_nan("decode", f.states):
+                self._step_info["handled_failure"] = True
+                return None
+        t_book = time.perf_counter()
+        n_live, finish = self._scatter_decode(f.states, out, defer_finish=True)
         self.token_rate.record(n_live)
+        if finish:
+            # finish/EOS is a non-steady event: the successor step may
+            # still be writing into the finishing streams' blocks —
+            # drain it (bookkept; its tokens for finished slots are
+            # skipped by the scatter) before any release
+            if self._pipe is not None:
+                self._drain_frontier("finish")
+            for st in finish:
+                if self._running.get(st.slot) is st and not st.req.handle.done():
+                    self._finish(st)
+        self._span("bookkeep", t_book, time.perf_counter())
+        return n_live
+
+    def _dispatch_pipeline(self, live, prev: Optional["_Frontier"]) -> "_Frontier":
+        """Dispatch the next decode step without blocking. With an
+        unconsumed predecessor, the token array is its device-resident
+        output (no host round trip at all) and the argument arrays are
+        the predecessor's, bumped in place — steady state rebuilds
+        nothing and re-uploads nothing but three [B] scalars-per-slot
+        vectors."""
+        b = self.engine.max_batch_slots
+        sig = tuple((s.slot, s.req.id, len(s.blocks)) for s in live)
+        covered = {s.slot for s in prev.states} if prev is not None else set()
+        if prev is not None and prev.sig == sig:
+            positions, active = prev.positions, prev.active
+            temps, top_ks = prev.temps, prev.top_ks
+            seeds, counts, tables = prev.seeds, prev.counts, prev.tables
+            for s in live:  # same composition: everyone advances by one
+                positions[s.slot] += 1
+                counts[s.slot] += 1
+        else:
+            positions = np.zeros((b,), np.int32)
+            active = np.zeros((b,), bool)
+            temps = np.zeros((b,), np.float32)
+            top_ks = np.zeros((b,), np.int32)
+            seeds = np.zeros((b,), np.uint32)
+            counts = np.zeros((b,), np.int32)
+            tables = np.zeros((b, self.engine.max_blocks_per_seq), np.int32)
+            for s in live:
+                i = s.slot
+                pend = 1 if i in covered else 0
+                positions[i] = s.cached_len + pend
+                counts[i] = s.req.n_generated + pend
+                active[i] = True
+                temps[i] = s.req.sampling.temperature
+                top_ks[i] = s.req.sampling.top_k
+                seeds[i] = s.req.sampling.seed & 0xFFFFFFFF
+                tables[i, : len(s.blocks)] = s.blocks
+        tokens_host = None
+        tokens_dev = prev.handle.out if prev is not None else None
+        if prev is None:
+            tokens_host = np.zeros((b,), np.int32)
+            for s in live:
+                req = s.req
+                tokens_host[s.slot] = (
+                    req.generated[-1] if req.generated else req.prompt[-1]
+                )
+        hb_prev = self._heartbeat
+        seq0 = prev.seq0 if prev is not None else self._hb_seq
+        self._hb_seq += 1
+        seq = self._hb_seq
+        self._heartbeat = (seq, self.clock())  # dispatch stamp
+        try:
+            handle = self.engine.decode_async(
+                tokens_host, positions, tables, active, temps, top_ks,
+                seeds, counts, tokens_dev=tokens_dev,
+            )
+        except Exception:
+            self._heartbeat = hb_prev  # the step never went in flight
+            self._hb_seq = seq  # seq stays burned; stall flags on it are void
+            raise
+        self._step_spans.append(("dispatch", handle.t0, handle.t_disp))
+        ph = self._step_phases
+        ph["dispatch"] = ph.get("dispatch", 0.0) + (handle.t_disp - handle.t0)
+        return _Frontier(
+            handle, list(live), positions, active, temps, top_ks, seeds,
+            counts, tables, sig, seq, seq0,
+        )
+
+    def _pipeline_failure(self, e: BaseException, since_seq: int) -> None:
+        """A pipelined dispatch or consume failed. Discard what is in
+        flight (restoring pre-step cache refs when possible), then give
+        the failed step the EXACT sequential treatment from the point
+        after its first failure (supervisor.resume_step): retryable
+        errors re-run invisibly, hard errors pay the breaker-accounted
+        retry -> bisect -> restart ladder. A donating engine skips
+        straight to reset + journal replay — its failed step consumed
+        its own input buffers."""
+        self.flight.record_event("pipeline_failure", error=repr(e)[:200])
+        self._discard_frontier()
+        self._step_info["handled_failure"] = True
+        if self.engine.donate:
+            self.supervisor._restart_and_replay(e, "decode")
+            return
+        order = [
+            s for s in sorted(self._running.values(), key=lambda s: s.slot)
+            if not s.req.handle.done() and not s.req.finished()
+        ]
+        if not order:
+            return
+        step, probe = self._decode_step_fns(order)
+        out = self.supervisor.resume_step("decode", e, step, order, probe, since_seq)
+        if out is None:
+            return
+        self._step_info["execute_s"] = self._engine_spans()
+        if self._quarantine_nan("decode", order):
+            return
+        n_live, _ = self._scatter_decode(order, out)
+        self.token_rate.record(n_live)
+        self._step_info["handled_failure"] = False
+        self._step_info["emitted"] = n_live
+
+    def _try_pipeline(self) -> Optional[bool]:
+        """One overlapped-decode iteration. Returns None when the
+        iteration must run sequentially instead (the frontier is
+        guaranteed drained by then); True when pipelined work happened.
+        Steady state: dispatch step N+1 (token carry from step N's
+        device output), then consume step N — its bookkeeping runs
+        inside N+1's execute window instead of on the critical path."""
+        now = self.clock()
+        if self._nonsteady(now):
+            # drain, then fall through to the sequential body in the
+            # SAME iteration: the non-steady event (an admission, an
+            # expiry, a verify step) must not wait an extra step —
+            # join-mid-flight latency and TTFT keep their sequential
+            # semantics. The drained consume's tokens/spans ride this
+            # iteration's record.
+            self._drain_frontier("nonsteady")
+            return None
+        order = sorted(self._running.values(), key=lambda s: s.slot)
+        if not order:
+            if self._pipe is not None:  # defensive: should be unreachable
+                self._drain_frontier("idle")
+            return None
+        info = self._step_info
+        t_s0 = time.perf_counter()
+        f = self._pipe
+        covered = {s.slot for s in f.states} if f is not None else set()
+        # slots live at the NEXT dispatch: budget-predicted finishes are
+        # excluded (sequential would have freed them before this step);
+        # EOS cannot be predicted and is handled at consume
+        live = []
+        for s in order:
+            pend = 1 if s.slot in covered else 0
+            if s.req.n_generated + pend >= s.req.max_new:
+                continue
+            live.append(s)
+        if not live:
+            if f is None:
+                return None
+            # stream tail: nothing left to dispatch — consume only
+            info["kind"] = "decode"
+            self._pipe = None
+            self._span("schedule", t_s0, time.perf_counter())
+            try:
+                n = self._consume_and_finish(f)
+            except Exception as e:
+                self._pipeline_failure(e, f.seq0)
+                return True
+            if n is not None:
+                info["emitted"] = n
+            return True
+        # grow block tables for the dispatch positions (plain allocation
+        # only: reclaim/preempt pressure is handled sequentially)
+        for s in live:
+            pend = 1 if s.slot in covered else 0
+            need = self.engine.cache_config.blocks_for(s.cached_len + pend + 1)
+            short = False
+            while len(s.blocks) < need:
+                got = self.engine.allocator.allocate(1)
+                if got is None:
+                    short = True
+                    break
+                s.blocks.extend(got)
+            if short:
+                self._span("schedule", t_s0, time.perf_counter())
+                if f is not None:
+                    info["kind"] = "decode"
+                    self._drain_frontier("pressure")
+                    return True
+                return None
+        self._span("schedule", t_s0, time.perf_counter())
+        info["kind"] = "decode"
+        try:
+            new_f = self._dispatch_pipeline(live, f)
+        except Exception as e:
+            # dispatch failed host-side; the in-flight predecessor is
+            # healthy — consume it first, then give the failed step the
+            # sequential recovery treatment
+            if f is not None:
+                self._pipe = None
+                try:
+                    self._consume_and_finish(f)
+                except Exception as e2:
+                    self._pipeline_failure(e2, f.seq0)
+                    return True
+            # the predecessor (if any) consumed cleanly and cleared its
+            # own stall flags; only trips from here on concern the re-run
+            self._pipeline_failure(e, self._hb_seq)
+            return True
+        self._pipe = new_f
+        self.pipe_dispatches += 1
+        if f is None:
+            info["emitted"] = 0  # warm-up: tokens arrive next iteration
+            return True
+        try:
+            n = self._consume_and_finish(f)
+        except Exception as e:
+            self._pipeline_failure(e, f.seq0)
+            return True
+        if n is not None:
+            info["emitted"] = n
         return True
 
     def _trim_blocks(self, state: _Running) -> None:
@@ -1510,7 +1973,8 @@ class ContinuousBatchingScheduler:
         info["kind"] = "verify"
         t_c0 = time.perf_counter()
         order = sorted(self._running.values(), key=lambda s: s.slot)
-        last, start, tables, _active, temps, top_ks = self._collect_slots(order)
+        (last, start, tables, _active, temps, top_ks, seeds,
+         counts) = self._collect_slots(order)
         t_draft = time.perf_counter()
         self._span("schedule", t_c0, t_draft)
         window = np.zeros((b, w), np.int32)
@@ -1538,17 +2002,14 @@ class ContinuousBatchingScheduler:
             n_draft[i] = len(draft)
         t_d1 = time.perf_counter()
         self._span("draft", t_draft, t_d1)
-        # key assembly is the sample phase, no longer hidden in draft
-        keys_by_slot = {s.slot: s.req.sample_keys(w) for s in order}
-        if self._dummy_keys is None:
-            self._dummy_keys = jnp.stack([jax.random.key(0)] * w)
-        keys = jnp.stack([keys_by_slot.get(i, self._dummy_keys) for i in range(b)])
-        self._span("sample", t_d1, time.perf_counter())
+        # the per-window key matrix derives in-jit from (seed, count) —
+        # the old host "sample" phase (vmapped fold_in + stack per
+        # request) no longer exists
         info["drafted"] = int(np.maximum(n_draft, 0).sum())
 
         def step():
             return self.engine.verify(
-                window, start, n_draft, tables, temps, top_ks, keys
+                window, start, n_draft, tables, temps, top_ks, seeds, counts
             )
 
         def probe(subset):
@@ -1557,7 +2018,7 @@ class ContinuousBatchingScheduler:
                 nd[s.slot] = n_draft[s.slot]
             self._probe_call(
                 lambda: self.engine.verify(
-                    window, start, nd, tables, temps, top_ks, keys
+                    window, start, nd, tables, temps, top_ks, seeds, counts
                 )
             )
 
@@ -1670,6 +2131,22 @@ class ContinuousBatchingScheduler:
         self._step_spans = []
         self._step_recorded = False
         t0 = time.perf_counter()
+        if self.overlap:
+            # overlapped decode: steady-state iterations pipeline
+            # dispatch/consume; any non-steady event drains the
+            # frontier and falls through to the sequential body below
+            r = self._try_pipeline()
+            if r is not None:
+                if r:
+                    self._flight_step()
+                    self.anatomy.observe_step(
+                        info.get("kind", "decode"), self._step_spans, t0,
+                        time.perf_counter(),
+                        tokens=int(info.get("emitted", 0)),
+                        hot=not info.get("handled_failure", False),
+                    )
+                self.capacity.tick()
+                return r
         self._expire()
         t1 = time.perf_counter()
         self._span("schedule", t0, t1)

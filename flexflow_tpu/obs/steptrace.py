@@ -72,10 +72,11 @@ from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 # span names on the DEVICE lane of the two-lane timeline; everything
-# else is host work. "block" (host parked in block_until_ready) and
-# "execute" (device computing) currently cover the same interval —
-# they diverge once the overlap refactor dispatches step N+1 while
-# step N's bookkeeping runs.
+# else is host work. With overlap OFF, "block" (host parked in
+# block_until_ready) and "execute" (device computing) cover the same
+# interval; under the ISSUE 13 pipeline they genuinely diverge — an
+# iteration's execute span started during the previous iteration's
+# dispatch, and host bookkeeping sits under it on the other lane.
 DEVICE_PHASES = frozenset({"execute"})
 
 # step kinds whose iterations emit tokens — the decode hot path the

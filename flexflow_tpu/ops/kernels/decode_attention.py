@@ -172,6 +172,101 @@ def _append_kernel(
         o_ref[:] = jnp.swapaxes(out, 0, 1).astype(o_ref.dtype)
 
 
+def _append_kernel_split(
+    bt_ref,  # scalar-prefetch: [B, max_blocks] block tables
+    qpos_ref,  # scalar-prefetch: [B, W] per-query cache positions (-1 = pad)
+    q_ref,  # [W, H, D] this sequence's query window
+    k_ref,  # [block_size, H, D] the grid step's cache block
+    v_ref,  # [block_size, H, D]
+    acc_out_ref,  # [W, H, D] this split's UNNORMALIZED numerator
+    m_out_ref,  # [H, W] this split's running max
+    l_out_ref,  # [H, W] this split's denominator
+    m_ref,  # scratch [H, W]
+    l_ref,  # scratch [H, W]
+    acc_ref,  # scratch [H, W, D]
+    *,
+    scale,
+    block_size,
+    blocks_per_split,
+    max_blocks,
+):
+    """Split-KV (flash-decoding) variant of :func:`_append_kernel`: the
+    grid gains a KV-split axis, each split accumulates online-softmax
+    state over its contiguous slice of cache blocks INDEPENDENTLY (the
+    splits can run in parallel — the sequential-grid data dependence is
+    broken), and emits unnormalized partials (acc, m, l) that
+    :func:`_combine_splits` recombines exactly. Long-context
+    single-stream decode stops serializing over the whole block table."""
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    j = pl.program_id(2)
+    nblocks = pl.num_programs(2)  # blocks per split
+    jj = s * blocks_per_split + j  # global block-table column
+    qp = qpos_ref[b, :]  # [W] each query's own cache position
+    max_qp = jnp.max(qp)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # skip padding grid steps (the split axis may overshoot the table;
+    # their DMA re-read a clamped block — the data is ignored) and
+    # whole blocks past every query's position
+    @pl.when(jnp.logical_and(jj < max_blocks, jj * block_size <= max_qp))
+    def _accum():
+        q = jnp.swapaxes(q_ref[:].astype(jnp.float32), 0, 1) * scale  # [H, W, D]
+        k = k_ref[:].astype(jnp.float32)  # [bs, H, D]
+        v = v_ref[:].astype(jnp.float32)
+        s_ = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (1,))), preferred_element_type=jnp.float32
+        )  # [H, W, bs]
+        pos = jj * block_size + jax.lax.broadcasted_iota(jnp.int32, s_.shape, 2)
+        valid = pos <= qp[None, :, None]
+        s_ = jnp.where(valid, s_, NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s_, axis=-1))
+        p = jnp.where(valid, jnp.exp(s_ - m_new[:, :, None]), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p, v, (((2,), (0,)), ((0,), (1,))), preferred_element_type=jnp.float32
+        )
+        acc_ref[:] = acc_ref[:] * corr[:, :, None] + pv
+
+    @pl.when(j == nblocks - 1)
+    def _finish():
+        # UNNORMALIZED partials out: the cheap [B, S, W, H(, D)] combine
+        # in plain XLA finishes the softmax exactly
+        m_out_ref[:] = m_ref[:]
+        l_out_ref[:] = l_ref[:]
+        acc_out_ref[:] = jnp.swapaxes(acc_ref[:], 0, 1).astype(acc_out_ref.dtype)
+
+
+def _combine_splits(acc, m, l, q_positions, out_dtype):
+    """Exact partial-softmax recombination across the KV-split axis.
+
+    acc: [B, S, W, H, D] unnormalized numerators; m/l: [B, S, H, W]
+    per-split running max / denominator. An empty split carries
+    (m=NEG_INF, l=0, acc=0) and contributes nothing; a padding query
+    (q_position < 0) has EVERY split empty and emits zeros, matching
+    the single-pass kernel."""
+    m = jnp.swapaxes(m, 2, 3)  # [B, S, W, H]
+    l = jnp.swapaxes(l, 2, 3)
+    m_max = jnp.max(m, axis=1, keepdims=True)  # [B, 1, W, H]
+    # all-empty guard: exp(NEG_INF - NEG_INF) is NaN; rescale against 0
+    # instead (every alpha then underflows to exp(NEG_INF) = 0)
+    safe_max = jnp.where(m_max > NEG_INF / 2, m_max, 0.0)
+    alpha = jnp.exp(m - safe_max)  # [B, S, W, H]
+    denom = jnp.sum(l * alpha, axis=1)  # [B, W, H]
+    numer = jnp.sum(acc * alpha[..., None], axis=1)  # [B, W, H, D]
+    out = numer / jnp.maximum(denom, 1e-30)[..., None]
+    out = jnp.where(q_positions[:, :, None, None] >= 0, out, 0.0)
+    return out.astype(out_dtype)
+
+
 def paged_append_attention(
     q: jax.Array,
     k_cache: jax.Array,
@@ -180,11 +275,17 @@ def paged_append_attention(
     q_positions: jax.Array,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    kv_splits: int = 1,
 ) -> jax.Array:
     """Pallas paged chunked-append attention (shapes as in
     :func:`reference_paged_append_attention`). ``interpret=None``
     auto-selects interpret mode off-TPU so the kernel path is testable
-    on CPU."""
+    on CPU. ``kv_splits > 1`` selects the flash-decoding split-KV
+    kernel: the cache-block grid axis splits into ``kv_splits``
+    independent slices whose partial softmaxes recombine exactly —
+    parallelism across the KV length for long-context, small-batch
+    decode, where the sequential block grid otherwise serializes the
+    whole chip on one sequence's history."""
     if pl is None or pltpu is None:
         return reference_paged_append_attention(
             q, k_cache, v_cache, block_tables, q_positions, scale
@@ -196,6 +297,58 @@ def paged_append_attention(
     b, w, h, d = q.shape
     _, block_size, _, _ = k_cache.shape
     max_blocks = block_tables.shape[1]
+    kv_splits = max(1, min(int(kv_splits), max_blocks))
+    if kv_splits > 1:
+        bps = -(-max_blocks // kv_splits)  # blocks per split (ceil)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, kv_splits, bps),
+            in_specs=[
+                pl.BlockSpec((None, w, h, d), lambda i, s, j, bt, qp: (i, 0, 0, 0)),
+                pl.BlockSpec(
+                    (None, block_size, h, d),
+                    lambda i, s, j, bt, qp: (
+                        bt[i, jnp.minimum(s * bps + j, max_blocks - 1)], 0, 0, 0
+                    ),
+                ),
+                pl.BlockSpec(
+                    (None, block_size, h, d),
+                    lambda i, s, j, bt, qp: (
+                        bt[i, jnp.minimum(s * bps + j, max_blocks - 1)], 0, 0, 0
+                    ),
+                ),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (None, None, w, h, d), lambda i, s, j, bt, qp: (i, s, 0, 0, 0)
+                ),
+                pl.BlockSpec((None, None, h, w), lambda i, s, j, bt, qp: (i, s, 0, 0)),
+                pl.BlockSpec((None, None, h, w), lambda i, s, j, bt, qp: (i, s, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((h, w), jnp.float32),
+                pltpu.VMEM((h, w), jnp.float32),
+                pltpu.VMEM((h, w, d), jnp.float32),
+            ],
+        )
+        kernel = functools.partial(
+            _append_kernel_split, scale=float(scale), block_size=block_size,
+            blocks_per_split=bps, max_blocks=max_blocks,
+        )
+        acc, m, l = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((b, kv_splits, w, h, d), jnp.float32),
+                jax.ShapeDtypeStruct((b, kv_splits, h, w), jnp.float32),
+                jax.ShapeDtypeStruct((b, kv_splits, h, w), jnp.float32),
+            ],
+            interpret=interpret,
+        )(
+            block_tables.astype(jnp.int32), q_positions.astype(jnp.int32),
+            q, k_cache, v_cache,
+        )
+        return _combine_splits(acc, m, l, q_positions.astype(jnp.int32), q.dtype)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, max_blocks),
@@ -220,6 +373,25 @@ def paged_append_attention(
     )(block_tables.astype(jnp.int32), q_positions.astype(jnp.int32), q, k_cache, v_cache)
 
 
+def default_kv_splits(batch: int, max_blocks: int) -> int:
+    """Flash-decoding split heuristic: split the KV axis only where the
+    sequential block grid is the bottleneck — small batch (little
+    batch-axis parallelism) over a long table. Capped so each split
+    still covers >= 4 blocks (partials below that are overhead-bound).
+
+    STATIC shapes only: the grid must be fixed at trace time, so
+    ``batch`` is the engine's padded slot count and ``max_blocks`` its
+    table width — NOT live occupancy or live context. The auto path
+    therefore engages for <=2-slot engine configurations (the dedicated
+    long-context single-stream deployment shape flash-decoding exists
+    for); wider-batch engines can opt in explicitly via ``kv_splits``,
+    accepting the recombination overhead when their batches run
+    under-occupied."""
+    if batch > 2 or max_blocks < 16:
+        return 1
+    return max(1, min(8, max_blocks // 4))
+
+
 def paged_decode_attention(
     q: jax.Array,
     k_cache: jax.Array,
@@ -228,9 +400,14 @@ def paged_decode_attention(
     context_lens: jax.Array,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    kv_splits: Optional[int] = None,
 ) -> jax.Array:
     """One-token (decode) form of :func:`paged_append_attention`
-    (shapes as in :func:`reference_paged_attention`)."""
+    (shapes as in :func:`reference_paged_attention`). ``kv_splits``
+    None auto-selects via :func:`default_kv_splits` — the
+    flash-decoding path for long-context single/dual-stream decode."""
+    if kv_splits is None:
+        kv_splits = default_kv_splits(q.shape[0], block_tables.shape[1])
     out = paged_append_attention(
         q[:, None],
         k_cache,
@@ -239,6 +416,7 @@ def paged_decode_attention(
         context_lens[:, None] - 1,
         scale=scale,
         interpret=interpret,
+        kv_splits=kv_splits,
     )
     return out[:, 0]
 

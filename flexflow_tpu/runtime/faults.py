@@ -71,6 +71,7 @@ GENERATION_PREFILL = "generation.prefill"
 GENERATION_DECODE_STEP = "generation.decode_step"
 GENERATION_VERIFY = "generation.verify"
 GENERATION_JOURNAL_REPLAY = "generation.journal_replay"
+GENERATION_ASYNC_READBACK = "generation.async_readback"
 GENERATION_PREFIX_LOOKUP = "generation.prefix_lookup"
 GENERATION_KV_OFFLOAD = "generation.kv_offload"
 FLEET_ROUTE = "fleet.route"
@@ -98,6 +99,11 @@ SITES = MappingProxyType({
     GENERATION_JOURNAL_REPLAY: (
         "top of each supervisor journal-replay restart (an error here is a "
         "double fault)"
+    ),
+    GENERATION_ASYNC_READBACK: (
+        "before the overlap pipeline consumes an in-flight decode step "
+        "(value: ('decode', n_states)); an error discards the frontier and "
+        "re-runs the step sequentially under the supervisor — byte-exact"
     ),
     GENERATION_PREFIX_LOOKUP: (
         "before each radix prefix-index lookup at admission (value: prompt "

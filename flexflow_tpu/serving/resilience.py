@@ -103,6 +103,14 @@ class RetryPolicy:
         self._rng = random.Random(f"retry|{seed}")
         self.last_attempts = 0  # observability: attempts used by last run()
 
+    def would_retry(self, err: BaseException) -> bool:
+        """True when :meth:`run` would retry this error — the overlap
+        scheduler's pipelined-failure arbitration: a retryable error
+        surfacing from an async-dispatched step gets the same
+        invisible-retry treatment a synchronous step would have
+        received inside ``run()``."""
+        return isinstance(err, self.retryable) and self.max_attempts > 1
+
     def delay_for(self, attempt: int) -> float:
         """Backoff before retry number ``attempt`` (1-based)."""
         return backoff_delay(

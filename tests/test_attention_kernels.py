@@ -194,3 +194,92 @@ def test_flash_adaptive_block_policy(monkeypatch):
     assert effective_blocks(512, 512) == (256, 256)
     for seq in (128, 256, 384, 512, 1024):
         assert supports_shapes((2, seq, 4, 64), (2, seq, 4, 64)), seq
+
+
+# ---------------------------------------------------------------------------
+# split-KV (flash-decoding) paged kernel parity — ISSUE 13
+# ---------------------------------------------------------------------------
+
+
+def _paged_fixtures(seed, b, w, max_blocks, nb=33, bs=8, h=4, d=64):
+    rs = np.random.RandomState(seed)
+    k_cache = jnp.asarray(rs.randn(nb, bs, h, d).astype(np.float32))
+    v_cache = jnp.asarray(rs.randn(nb, bs, h, d).astype(np.float32))
+    q = jnp.asarray(rs.randn(b, w, h, d).astype(np.float32))
+    tables = jnp.asarray(rs.randint(1, nb, (b, max_blocks)).astype(np.int32))
+    qpos = []
+    for _ in range(b):
+        base = int(rs.randint(0, max_blocks * bs - w))
+        qpos.append([base + j if rs.rand() > 0.2 else -1 for j in range(w)])
+    qpos = jnp.asarray(np.asarray(qpos, np.int32))
+    return q, k_cache, v_cache, tables, qpos
+
+
+@pytest.mark.parametrize(
+    "b,w,max_blocks,splits",
+    [
+        (1, 1, 32, 8),  # decode shape, even split
+        (1, 1, 32, 2),
+        (2, 4, 16, 3),  # append window, non-dividing split (padding steps)
+        (3, 5, 7, 4),   # odd table, split > blocks-per-split coverage
+        (1, 3, 9, 2),
+    ],
+)
+def test_split_kv_append_matches_reference(b, w, max_blocks, splits):
+    """Flash-decoding split-KV kernel (interpret mode): every split
+    count — including ones that do not divide the table, exercising the
+    clamped-index padding grid steps — recombines partial softmaxes to
+    the reference result, padding queries emit zeros."""
+    from flexflow_tpu.ops.kernels.decode_attention import (
+        paged_append_attention,
+        reference_paged_append_attention,
+    )
+
+    q, k_cache, v_cache, tables, qpos = _paged_fixtures(
+        100 + b + w + splits, b, w, max_blocks
+    )
+    ref = reference_paged_append_attention(q, k_cache, v_cache, tables, qpos)
+    out = paged_append_attention(
+        q, k_cache, v_cache, tables, qpos, interpret=True, kv_splits=splits
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+    # padding queries emit exact zeros, like the single-pass kernel
+    pad = np.asarray(qpos) < 0
+    if pad.any():
+        assert np.all(np.asarray(out)[pad] == 0.0)
+
+
+def test_split_kv_decode_wrapper_and_heuristic():
+    """The decode (W=1) wrapper auto-splits only where flash-decoding
+    pays: small batch over a long table; parity holds either way."""
+    from flexflow_tpu.ops.kernels.decode_attention import (
+        default_kv_splits,
+        paged_decode_attention,
+        reference_paged_attention,
+    )
+
+    assert default_kv_splits(1, 32) > 1        # long context, single stream
+    assert default_kv_splits(8, 32) == 1       # batch already fills the chip
+    assert default_kv_splits(1, 8) == 1        # short table: not worth it
+    q, k_cache, v_cache, tables, _ = _paged_fixtures(7, 2, 1, 24)
+    ctx = jnp.asarray(np.asarray([150, 40], np.int32))
+    ref = reference_paged_attention(q[:, 0], k_cache, v_cache, tables, ctx)
+    out = paged_decode_attention(
+        q[:, 0], k_cache, v_cache, tables, ctx, interpret=True, kv_splits=4
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_split_kv_single_split_is_the_sequential_kernel():
+    """kv_splits=1 (and out-of-range values clamp there) takes the
+    original sequential-grid path bit-for-bit."""
+    from flexflow_tpu.ops.kernels.decode_attention import paged_append_attention
+
+    q, k_cache, v_cache, tables, qpos = _paged_fixtures(3, 2, 3, 9)
+    base = paged_append_attention(
+        q, k_cache, v_cache, tables, qpos, interpret=True, kv_splits=1
+    )
+    clamped = paged_append_attention(
+        q, k_cache, v_cache, tables, qpos, interpret=True, kv_splits=0
+    )
+    assert np.array_equal(np.asarray(base), np.asarray(clamped))

@@ -311,7 +311,8 @@ def test_failed_step_accrues_no_flops(decoder_params):
         active=np.array([True] + [False] * (slots - 1)),
         temps=np.zeros((slots,), np.float32),
         top_ks=np.zeros((slots,), np.int32),
-        keys=jnp.stack([jax.random.key(0)] * slots),
+        seeds=np.zeros((slots,), np.uint32),
+        counts=np.zeros((slots,), np.int32),
     )
     plan = FaultPlan(seed=0)
     plan.on("generation.decode_step", mode="error", error=FaultInjected, nth=(0,))
@@ -377,13 +378,13 @@ def test_program_registry_records_and_blames_retrace(decoder_params):
     # forced batch-widening retrace: the registry must say exactly what
     # changed, and the blame must land on the flight ring
     b = eng.max_batch_slots + 1
-    keys = jnp.stack([jax.random.key(0)] * b)
     eng._decode_jit(
         eng.params, jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
         eng.cache.k, eng.cache.v,
         jnp.zeros((b, eng.max_blocks_per_seq), jnp.int32),
         jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32),
-        jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32), keys,
+        jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32),
+        jnp.zeros((b,), jnp.uint32), jnp.zeros((b,), jnp.int32),
     )
     assert eng.programs.total_retraces() == 1
     (retrace,) = eng.programs.recent_retraces()

@@ -1,0 +1,492 @@
+"""Overlapped decode (ISSUE 13): the two-deep host/device software
+pipeline with double-buffered readback, in-jit sampling keys, and
+deterministic frontier drain.
+
+Coverage (the ISSUE acceptance matrix):
+  * exactness matrix — greedy / seeded temperature / speculative token
+    streams are byte-identical with the pipeline on vs off, across
+    block and bucket boundaries
+  * pipeline drain — EOS/finish, preemption pressure, quarantine, and
+    expiry all drain the frontier deterministically; final state is
+    sequential-identical
+  * crash mid-flight — an injected failure on a pipelined step (at
+    dispatch or at the async readback) recovers through the supervisor
+    with byte-identical streams; whole-batch NaN journal-replays exactly
+  * watchdog heartbeat semantics — dispatch AND completion stamps: a
+    one-step-deep pipeline at long execute times never trips the
+    watchdog, while a genuinely wedged in-flight step still does
+  * device-resident staging — zero added retraces with the pipeline on
+    (decode compiles exactly once; ProgramRegistry-blamed retraces
+    stay zero), and the cache-donating engine configuration stays exact
+  * steptrace lanes — pipelined captures genuinely diverge: an execute
+    span may begin before its iteration (it started during the previous
+    one), the sequential block==execute mirror is broken
+"""
+import contextlib
+
+import jax
+import numpy as np
+import pytest
+
+from flexflow_tpu.generation import (
+    ContinuousBatchingScheduler,
+    GenerationEngine,
+    SamplingParams,
+    SpeculationConfig,
+    init_decoder_params,
+)
+from flexflow_tpu.generation.cache import CacheConfig
+from flexflow_tpu.generation.recovery import (
+    PoisonedRequestError,
+    RecoveryPolicy,
+    WatchdogPolicy,
+)
+from flexflow_tpu.models.transformer import TransformerConfig
+from flexflow_tpu.runtime.faults import FaultInjected, FaultPlan, TransientDeviceError
+from flexflow_tpu.serving.resilience import RetryPolicy
+
+pytestmark = pytest.mark.generation
+
+CFG = TransformerConfig(
+    num_layers=1, hidden_size=32, num_heads=2, ff_size=128,
+    seq_length=64, vocab_size=64, causal=True,
+)
+BLOCK = 8
+BUCKETS = (8, 16, 32, 64)
+
+
+@pytest.fixture(scope="module")
+def decoder_params():
+    return init_decoder_params(jax.random.key(0), CFG)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def make_engine(decoder_params, num_blocks=40, slots=3, **kw):
+    cache = CacheConfig(
+        num_layers=CFG.num_layers, num_heads=CFG.num_heads,
+        head_dim=CFG.hidden_size // CFG.num_heads,
+        block_size=BLOCK, num_blocks=num_blocks,
+    )
+    kw.setdefault("prefix_cache", False)
+    return GenerationEngine(
+        decoder_params, CFG, cache_config=cache, max_batch_slots=slots,
+        prompt_buckets=BUCKETS, **kw,
+    )
+
+
+def run_stream(decoder_params, prompts, sampling, *, overlap, spec=None,
+               num_blocks=40, slots=3, plan=None, engine_kw=None,
+               sched_kw=None):
+    eng = make_engine(decoder_params, num_blocks=num_blocks, slots=slots,
+                      **(engine_kw or {}))
+    sched = ContinuousBatchingScheduler(eng, overlap=overlap, **(sched_kw or {}))
+    ctx = plan.active() if plan is not None else contextlib.nullcontext()
+    with ctx:
+        handles = [sched.submit(p, sampling, speculation=spec) for p in prompts]
+        steps = 0
+        while any(not h.done() for h in handles):
+            if not sched.step():
+                break
+            steps += 1
+            assert steps < 5000, "scheduler failed to converge"
+    return [h.result(timeout=0) for h in handles], eng, sched
+
+
+# ------------------------------------------------------ exactness matrix
+# prompts straddle bucket boundaries (7/8, 15/16/17) and max_new crosses
+# block boundaries (cached_len passes multiples of BLOCK mid-stream)
+MATRIX_PROMPTS = [
+    [1, 2, 3, 4, 5, 6, 7],            # bucket edge (8)
+    [9, 8, 7, 6, 5, 4, 3, 2],         # exactly one bucket
+    list(range(11, 26)),              # 15: just under bucket 16
+    list(range(30, 47)),              # 17: just over bucket 16
+]
+
+
+@pytest.mark.parametrize(
+    "sampling",
+    [
+        SamplingParams(max_new_tokens=14),                                # greedy
+        SamplingParams(max_new_tokens=14, temperature=0.8, top_k=8, seed=7),
+        SamplingParams(max_new_tokens=11, temperature=0.5, seed=123),
+    ],
+    ids=["greedy", "temp_topk", "temp"],
+)
+def test_overlap_exactness_matrix(decoder_params, sampling):
+    off, eng_off, _ = run_stream(
+        decoder_params, MATRIX_PROMPTS, sampling, overlap=False
+    )
+    on, eng_on, sched_on = run_stream(
+        decoder_params, MATRIX_PROMPTS, sampling, overlap=True
+    )
+    assert on == off
+    assert sched_on.pipe_dispatches > 0, "pipeline never engaged"
+    # staging + carry added zero retraces: ONE decode compile, and the
+    # registry blamed nothing
+    assert eng_on.trace_counts["decode"] == 1
+    assert eng_on.recompiles() == {}
+    assert eng_on.programs.total_retraces() == 0
+
+
+def test_overlap_exactness_speculative(decoder_params):
+    """Speculative streams are byte-identical with overlap on/off (the
+    verify path is sequential by design — drafting is host-data-
+    dependent — so the pipeline must drain before any verify step)."""
+    spec = SpeculationConfig(k=3, method="ngram")
+    prompts = [[1, 2, 3] * 6, [4, 5] * 8, [7, 8, 9, 7, 8, 9, 7, 8, 9]]
+    sampling = SamplingParams(max_new_tokens=18)
+    off, _, _ = run_stream(decoder_params, prompts, sampling, overlap=False,
+                           spec=spec)
+    on, eng_on, sched_on = run_stream(decoder_params, prompts, sampling,
+                                      overlap=True, spec=spec)
+    assert on == off
+    assert eng_on.trace_counts["verify"] == 1
+
+
+@pytest.mark.slow
+def test_overlap_mixed_plain_and_speculative(decoder_params):
+    """A batch mixing plain and speculating requests stays exact: the
+    speculating request forces the sequential verify path for everyone
+    (nonsteady drain), plain-only phases pipeline again after it
+    finishes."""
+    spec = SpeculationConfig(k=3, method="ngram")
+    sampling = SamplingParams(max_new_tokens=16)
+
+    def run(overlap):
+        eng = make_engine(decoder_params)
+        sched = ContinuousBatchingScheduler(eng, overlap=overlap)
+        h1 = sched.submit([1, 2, 3] * 5, SamplingParams(max_new_tokens=6),
+                          speculation=spec)
+        h2 = sched.submit([11, 12, 13, 14], sampling)
+        steps = 0
+        while not (h1.done() and h2.done()):
+            if not sched.step():
+                break
+            steps += 1
+            assert steps < 2000
+        return [h1.result(0), h2.result(0)], sched
+
+    off, _ = run(False)
+    on, sched_on = run(True)
+    assert on == off
+    # after the speculating stream finished, the plain one pipelined
+    assert sched_on.pipe_dispatches > 0
+
+
+# ---------------------------------------------------------------- drains
+def test_pipeline_drains_on_eos(decoder_params):
+    sampling = SamplingParams(max_new_tokens=24)
+    base, _, _ = run_stream(decoder_params, MATRIX_PROMPTS, sampling,
+                            overlap=False)
+    # pick an EOS token that occurs mid-stream (index >= 3) but never
+    # in any stream's first tokens: it must fire while the pipeline is
+    # live, not at an admission prefill (the streams depend on jax PRNG
+    # config, so the choice is made in-environment, not hardcoded)
+    early = {t for o in base for t in o[:3]}
+    cands = [t for o in base for t in o[3:] if t not in early]
+    assert cands, "no usable mid-stream EOS token; widen the stream"
+    eos = int(cands[0])
+    samp = SamplingParams(max_new_tokens=24, eos_id=eos)
+    off, _, _ = run_stream(decoder_params, MATRIX_PROMPTS, samp, overlap=False)
+    on, _, sched_on = run_stream(decoder_params, MATRIX_PROMPTS, samp,
+                                 overlap=True)
+    assert on == off
+    assert any(len(o) < 24 for o in on), "EOS never fired; test is vacuous"
+    assert sched_on.pipe_drains.get("finish", 0) + sched_on.pipe_drains.get(
+        "nonsteady", 0
+    ) >= 1
+
+
+def test_pipeline_drains_on_preempt(decoder_params):
+    """Tight cache: growth fails mid-stream, the frontier drains on
+    pressure, preempt-by-recompute resumes streams exactly."""
+    sampling = SamplingParams(max_new_tokens=30)
+    prompts = [[1, 2, 3, 4, 5, 6, 7, 8], [9, 10, 11, 12, 13, 14], [20, 21, 22, 23]]
+    off, _, sched_off = run_stream(decoder_params, prompts, sampling,
+                                   overlap=False, num_blocks=14)
+    on, _, sched_on = run_stream(decoder_params, prompts, sampling,
+                                 overlap=True, num_blocks=14)
+    assert on == off
+    assert sched_on.preemptions >= 1, "preemption never exercised"
+    assert sched_on.pipe_drains.get("pressure", 0) >= 1
+
+
+def test_pipeline_drains_on_quarantine(decoder_params):
+    """Per-slot NaN poison with the pipeline on: the blamed request is
+    quarantined alone, survivors keep byte-identical streams, and the
+    tainted frontier is discarded."""
+    sampling = SamplingParams(max_new_tokens=10)
+    prompts = [[1, 2, 3, 4], [7, 8, 9], [11, 12, 13, 14, 15]]
+
+    def run_collect(overlap):
+        plan = FaultPlan(seed=0)
+        plan.on(
+            "generation.decode_step", mode="nan", nth=(3,),
+            select=lambda v: np.asarray([True, False, False]),
+        )
+        eng = make_engine(decoder_params)
+        sched = ContinuousBatchingScheduler(eng, overlap=overlap)
+        with plan.active():
+            handles = [sched.submit(p, sampling) for p in prompts]
+            steps = 0
+            while any(not h.done() for h in handles):
+                if not sched.step():
+                    break
+                steps += 1
+                assert steps < 2000
+        outs = []
+        for h in handles:
+            try:
+                outs.append(h.result(timeout=0))
+            except PoisonedRequestError:
+                outs.append("quarantined")
+        return outs, sched
+
+    off, _ = run_collect(False)
+    on, sched_on = run_collect(True)
+    assert on == off
+    assert "quarantined" in on  # the poison really landed on one stream
+    assert sched_on.recovery_stats.quarantined >= 1
+
+
+@pytest.mark.slow
+def test_pipeline_drain_on_cancel_and_deadline(decoder_params):
+    """Cancel mid-stream with the pipeline live: the frontier drains on
+    the nonsteady sweep and the remaining streams finish exactly."""
+    sampling = SamplingParams(max_new_tokens=20)
+    eng = make_engine(decoder_params)
+    sched = ContinuousBatchingScheduler(eng, overlap=True)
+    h1 = sched.submit([1, 2, 3, 4, 5], sampling)
+    h2 = sched.submit([9, 8, 7], sampling)
+    for _ in range(6):
+        sched.step()
+    h1.cancel()
+    steps = 0
+    while not (h1.done() and h2.done()):
+        if not sched.step():
+            break
+        steps += 1
+        assert steps < 2000
+    with pytest.raises(Exception):
+        h1.result(timeout=0)
+    ref, _, _ = run_stream(decoder_params, [[9, 8, 7]], sampling, overlap=False)
+    assert h2.result(timeout=0) == ref[0]
+    assert eng.allocator.num_free == eng.allocator.num_total
+
+
+# ----------------------------------------------------- crash mid-flight
+def test_pipelined_transient_fault_is_invisible(decoder_params):
+    sampling = SamplingParams(max_new_tokens=12)
+    off, _, _ = run_stream(decoder_params, MATRIX_PROMPTS, sampling,
+                           overlap=False)
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=TransientDeviceError, nth=(5,))
+    on, eng, sched = run_stream(
+        decoder_params, MATRIX_PROMPTS, sampling, overlap=True, plan=plan,
+        sched_kw={"retry": RetryPolicy(max_attempts=3, sleep=lambda _s: None)},
+    )
+    assert plan.fired("generation.decode_step") == 1
+    assert on == off
+    assert eng.resets == 0  # absorbed without an engine restart
+
+
+def test_pipelined_hard_crash_journal_replays_exactly(decoder_params):
+    sampling = SamplingParams(max_new_tokens=12)
+    off, _, _ = run_stream(decoder_params, MATRIX_PROMPTS, sampling,
+                           overlap=False)
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=RuntimeError("device crash"), nth=(4, 5))
+    on, eng, sched = run_stream(
+        decoder_params, MATRIX_PROMPTS, sampling, overlap=True, plan=plan,
+        sched_kw={"recovery": RecoveryPolicy(sleep=lambda _s: None)},
+    )
+    assert on == off
+    assert eng.resets >= 1
+    assert sched.recovery_stats.recoveries >= 1
+
+
+def test_async_readback_fault_recovers_exactly(decoder_params):
+    """The new generation.async_readback site: an error at the pipeline
+    consume discards the frontier and re-runs the step sequentially
+    under the supervisor — byte-exact, quarantining nothing."""
+    sampling = SamplingParams(max_new_tokens=12)
+    off, _, _ = run_stream(decoder_params, MATRIX_PROMPTS, sampling,
+                           overlap=False)
+    plan = FaultPlan(seed=0)
+    plan.on("generation.async_readback", mode="error",
+            error=FaultInjected("readback lost"), nth=(2,))
+    on, eng, sched = run_stream(
+        decoder_params, MATRIX_PROMPTS, sampling, overlap=True, plan=plan,
+        sched_kw={"recovery": RecoveryPolicy(sleep=lambda _s: None)},
+    )
+    assert plan.fired("generation.async_readback") == 1
+    assert on == off
+    assert sched.recovery_stats.quarantined == 0
+
+
+def test_pipelined_whole_batch_nan_restarts_and_replays(decoder_params):
+    sampling = SamplingParams(max_new_tokens=10)
+    off, _, _ = run_stream(decoder_params, MATRIX_PROMPTS, sampling,
+                           overlap=False)
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="nan", nth=(3,))
+    on, eng, sched = run_stream(
+        decoder_params, MATRIX_PROMPTS, sampling, overlap=True, plan=plan,
+        sched_kw={"recovery": RecoveryPolicy(sleep=lambda _s: None)},
+    )
+    assert on == off
+    assert eng.resets >= 1
+
+
+# ------------------------------------------- watchdog heartbeat semantics
+def test_watchdog_not_tripped_by_long_pipelined_steps(decoder_params):
+    """Satellite 2 regression: the heartbeat is stamped at dispatch AND
+    at completion, so an in-flight step's age is its OWN device time —
+    a pipeline whose per-step execute approaches the stall timeout, run
+    for many steps, must never trip (under the old stamp-once scheme
+    the cumulative in-flight window would)."""
+    clock = FakeClock()
+    eng = make_engine(decoder_params)
+    sched = ContinuousBatchingScheduler(
+        eng, overlap=True, clock=clock,
+        watchdog=WatchdogPolicy(enabled=True, stall_timeout_s=10.0),
+    )
+    sampling = SamplingParams(max_new_tokens=16)
+    h = sched.submit([1, 2, 3, 4, 5], sampling)
+    steps = 0
+    while not h.done():
+        if not sched.step():
+            break
+        # each step's device window stays under the timeout, but the
+        # cumulative in-flight time across the stream far exceeds it
+        clock.advance(6.0)
+        sched.watchdog.check()
+        steps += 1
+        assert steps < 2000
+    assert sched.recovery_stats.watchdog_trips == 0
+    ref, _, _ = run_stream(decoder_params, [[1, 2, 3, 4, 5]], sampling,
+                           overlap=False)
+    assert h.result(timeout=0) == ref[0]
+
+
+def test_watchdog_still_trips_on_wedged_inflight_step(decoder_params):
+    """A genuinely outstanding in-flight step older than the stall
+    timeout trips the watchdog; the late result is discarded and the
+    stream journal-replays byte-exactly."""
+    clock = FakeClock()
+    eng = make_engine(decoder_params)
+    sched = ContinuousBatchingScheduler(
+        eng, overlap=True, clock=clock,
+        watchdog=WatchdogPolicy(enabled=True, stall_timeout_s=10.0),
+        recovery=RecoveryPolicy(sleep=lambda _s: None),
+    )
+    sampling = SamplingParams(max_new_tokens=12)
+    h = sched.submit([1, 2, 3, 4, 5], sampling)
+    # admit + warm the pipeline so a frontier is genuinely in flight
+    for _ in range(3):
+        sched.step()
+    assert sched._pipe is not None, "pipeline did not engage"
+    # the device never completes (from the watchdog's point of view):
+    # the in-flight dispatch stamp ages past the stall timeout
+    clock.advance(11.0)
+    assert sched.watchdog.check() is True
+    assert sched.recovery_stats.watchdog_trips == 1
+    # the loop's next consume sees the stall flag, discards the late
+    # result, and restarts + journal-replays
+    steps = 0
+    while not h.done():
+        if not sched.step():
+            break
+        steps += 1
+        assert steps < 2000
+    assert eng.resets >= 1
+    ref, _, _ = run_stream(decoder_params, [[1, 2, 3, 4, 5]], sampling,
+                           overlap=False)
+    assert h.result(timeout=0) == ref[0]
+
+
+# ------------------------------------------------- staging and donation
+def test_zero_added_retraces_and_staging_reuse(decoder_params):
+    """Device-resident staging: a long pipelined stream compiles decode
+    exactly once (ProgramRegistry retraces zero), and slot-constant
+    args (tables/sampling) are re-uploaded only on composition change."""
+    sampling = SamplingParams(max_new_tokens=24)
+    on, eng, sched = run_stream(decoder_params, MATRIX_PROMPTS, sampling,
+                                overlap=True)
+    assert eng.trace_counts["decode"] == 1
+    assert eng.programs.total_retraces() == 0
+    assert eng.recompiles() == {}
+    # staged entries exist for the slot-constant decode args
+    assert {"decode.tables", "decode.temps", "decode.top_ks", "decode.seeds"} <= set(
+        eng._staged
+    )
+
+
+def test_donating_engine_is_exact_and_stage_safe(decoder_params):
+    """donate_cache=True (the accelerator default; opt-in on CPU): the
+    decode/verify jits consume their cache inputs in place. Fault-free
+    streams must be byte-identical to the non-donating engine, with
+    zero added retraces."""
+    sampling = SamplingParams(max_new_tokens=16)
+    off, _, _ = run_stream(decoder_params, MATRIX_PROMPTS, sampling,
+                           overlap=False)
+    on, eng, _ = run_stream(
+        decoder_params, MATRIX_PROMPTS, sampling, overlap=True,
+        engine_kw={"donate_cache": True},
+    )
+    assert eng.donate is True
+    assert on == off
+    assert eng.trace_counts["decode"] == 1
+    # speculative + donation (verify jit donates too)
+    spec = SpeculationConfig(k=3, method="ngram")
+    prompts = [[1, 2, 3] * 6, [4, 5] * 8]
+    s_off, _, _ = run_stream(decoder_params, prompts, sampling, overlap=False,
+                             spec=spec)
+    s_on, eng2, _ = run_stream(
+        decoder_params, prompts, sampling, overlap=True, spec=spec,
+        engine_kw={"donate_cache": True},
+    )
+    assert s_on == s_off
+
+
+# --------------------------------------------------- steptrace divergence
+def test_pipelined_lanes_genuinely_diverge(decoder_params):
+    """Under overlap the captured two-lane timeline stops mirroring:
+    some decode capture holds an execute span that BEGAN before the
+    iteration's own window (it was dispatched in the previous
+    iteration), which the sequential shape (block == execute, both
+    inside the step) never produces."""
+    eng = make_engine(decoder_params)
+    sched = ContinuousBatchingScheduler(eng, overlap=True)
+    sched.anatomy.arm_capture(64)
+    sampling = SamplingParams(max_new_tokens=16)
+    handles = [sched.submit(p, sampling) for p in MATRIX_PROMPTS[:2]]
+    steps = 0
+    while any(not h.done() for h in handles):
+        if not sched.step():
+            break
+        steps += 1
+        assert steps < 2000
+    caps = [c for c in sched.anatomy.captured_steps() if c["kind"] == "decode"]
+    assert caps
+    diverged = False
+    for cap in caps:
+        block = sorted(s[1:] for s in cap["spans"] if s[0] == "block")
+        execute = sorted(s[1:] for s in cap["spans"] if s[0] == "execute")
+        if execute and (execute != block or any(
+            s0 < cap["t_start"] - 1e-9 for s0, _ in execute
+        )):
+            diverged = True
+    assert diverged, "pipelined captures still mirror block==execute"

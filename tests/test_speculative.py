@@ -191,21 +191,33 @@ def _restore(engine, snap):
     engine.cache.k, engine.cache.v = snap
 
 
-def _decode_one(engine, token, position, blocks, sampling, key):
+def _decode_one(engine, token, position, blocks, sampling, count):
+    """One decode step for slot 0. ``count`` is the generated-token
+    count the in-jit key derivation folds (ISSUE 13: the engine derives
+    fold_in(key(seed), count) itself — bit-identical to the host keys
+    these tests used to build)."""
     tokens = np.zeros((engine.max_batch_slots,), np.int32)
     positions = np.zeros((engine.max_batch_slots,), np.int32)
     tables = np.zeros((engine.max_batch_slots, engine.max_blocks_per_seq), np.int32)
     active = np.zeros((engine.max_batch_slots,), bool)
     temps = np.zeros((engine.max_batch_slots,), np.float32)
     top_ks = np.zeros((engine.max_batch_slots,), np.int32)
+    seeds = np.zeros((engine.max_batch_slots,), np.uint32)
+    counts = np.zeros((engine.max_batch_slots,), np.int32)
     tokens[0], positions[0], active[0] = token, position, True
     tables[0, : len(blocks)] = blocks
     temps[0], top_ks[0] = sampling.temperature, sampling.top_k
-    keys = jnp.stack([key] * engine.max_batch_slots)
-    return int(engine.decode(tokens, positions, tables, active, temps, top_ks, keys)[0])
+    seeds[0], counts[0] = sampling.seed, count
+    return int(
+        engine.decode(
+            tokens, positions, tables, active, temps, top_ks, seeds, counts
+        )[0]
+    )
 
 
-def _verify_one(engine, window, start, n_draft, blocks, sampling, keys_row):
+def _verify_one(engine, window, start, n_draft, blocks, sampling, count):
+    """One verify step for slot 0; window key j folds count + j in-jit
+    (the same per-emitted-count indexing the host key rows carried)."""
     b, w = engine.max_batch_slots, engine.spec_window
     wt = np.zeros((b, w), np.int32)
     st = np.zeros((b,), np.int32)
@@ -213,12 +225,14 @@ def _verify_one(engine, window, start, n_draft, blocks, sampling, keys_row):
     tables = np.zeros((b, engine.max_blocks_per_seq), np.int32)
     temps = np.zeros((b,), np.float32)
     top_ks = np.zeros((b,), np.int32)
+    seeds = np.zeros((b,), np.uint32)
+    counts = np.zeros((b,), np.int32)
     wt[0, : len(window)] = window
     st[0], nd[0] = start, n_draft
     tables[0, : len(blocks)] = blocks
     temps[0], top_ks[0] = sampling.temperature, sampling.top_k
-    keys = jnp.stack([keys_row] * b)
-    out, n_em = engine.verify(wt, st, nd, tables, temps, top_ks, keys)
+    seeds[0], counts[0] = sampling.seed, count
+    out, n_em = engine.verify(wt, st, nd, tables, temps, top_ks, seeds, counts)
     return [int(t) for t in out[0, : int(n_em[0])]]
 
 
@@ -246,16 +260,13 @@ def test_verify_window_matches_sequential_decode(whitebox_engine):
     seq = []
     tok, pos = t0, len(prompt)
     for n in (1, 2, 3):
-        tok = _decode_one(engine, tok, pos, blocks, sampling, jax.random.fold_in(base, n))
+        tok = _decode_one(engine, tok, pos, blocks, sampling, n)
         seq.append(tok)
         pos += 1
     _restore(engine, snap)
     # speculative: drafts ARE the sequential continuation -> all accepted
-    keys_row = jnp.stack(
-        [jax.random.fold_in(base, n) for n in range(1, engine.spec_window + 1)]
-    )
     out = _verify_one(
-        engine, [t0, seq[0], seq[1]], len(prompt), 2, blocks, sampling, keys_row
+        engine, [t0, seq[0], seq[1]], len(prompt), 2, blocks, sampling, 1
     )
     assert out == seq, f"verify {out} != sequential {seq}"
 
@@ -272,11 +283,9 @@ def test_zero_draft_verify_samples_like_decode(whitebox_engine, temperature):
     blocks = engine.allocator.allocate(engine.cache_config.blocks_for(len(prompt) + 2))
     t0 = engine.prefill_one(prompt, blocks, sampling, jax.random.fold_in(base, 0))
     snap = _snapshot(engine)
-    key1 = jax.random.fold_in(base, 1)
-    via_decode = _decode_one(engine, t0, len(prompt), blocks, sampling, key1)
+    via_decode = _decode_one(engine, t0, len(prompt), blocks, sampling, 1)
     _restore(engine, snap)
-    keys_row = jnp.stack([key1] * engine.spec_window)
-    via_verify = _verify_one(engine, [t0], len(prompt), 0, blocks, sampling, keys_row)
+    via_verify = _verify_one(engine, [t0], len(prompt), 0, blocks, sampling, 1)
     assert via_verify == [via_decode]
 
 

@@ -186,11 +186,13 @@ def test_chrome_trace_two_lane_schema():
 
 # ------------------------------------------------- real-engine invariants
 def test_decode_span_conservation_on_real_steps(engine):
-    """Steady-state decode: host spans are disjoint and host-sum + gap
-    == step wall; the device execute span mirrors the host block span;
-    the flight record still carries the conflated device phase next to
-    the new execute_s field."""
-    sched = ContinuousBatchingScheduler(engine)
+    """SEQUENTIAL steady-state decode (overlap off): host spans are
+    disjoint and host-sum + gap == step wall; the device execute span
+    mirrors the host block span; the flight record still carries the
+    conflated device phase next to the new execute_s field. (The
+    overlapped pipeline's diverging-lanes shape is asserted in
+    tests/test_overlap.py.)"""
+    sched = ContinuousBatchingScheduler(engine, overlap=False)
     assert sched.anatomy.arm_capture(64) == 64
     _drive(sched, [[1, 2, 3, 4], [9, 8, 7]], max_new=8)
     caps = [c for c in sched.anatomy.captured_steps() if c["kind"] == "decode"]
@@ -217,11 +219,13 @@ def test_decode_span_conservation_on_real_steps(engine):
         block = sorted(s[1:] for s in cap["spans"] if s[0] == "block")
         execute = sorted(s[1:] for s in cap["spans"] if s[0] == "execute")
         assert len(block) >= 1 and block == execute
-    # steady-state decode kinds own every first-class phase
+    # steady-state decode kinds own every first-class phase (the old
+    # host "sample" phase no longer exists: keys derive in-jit)
     phases = sched.anatomy.phases_summary()["decode"]
-    for p in ("schedule", "sample", "dispatch", "block", "execute",
+    for p in ("schedule", "dispatch", "block", "execute",
               "readback", "bookkeep"):
         assert phases[p]["count"] >= 1, f"missing phase {p}"
+    assert "sample" not in phases
     # flight compatibility: decode records keep the conflated device
     # phase and gain execute_s
     rec = next(r for r in sched.flight.snapshot() if r["kind"] == "decode")
@@ -248,7 +252,10 @@ def test_engine_device_time_split(engine):
     """device_time_s is the derived dispatch+execute+readback sum per
     kind, and MFU divides by execute-only seconds."""
     before = {k: dict(v) for k, v in engine.phase_time_s.items()}
-    engine.generate([[1, 2, 3]], SamplingParams(max_new_tokens=3))
+    # overlap off: this test pins the engine's SEQUENTIAL span shape
+    # (last_step_spans with block == execute); the pipelined shape is
+    # covered by tests/test_overlap.py
+    engine.generate([[1, 2, 3]], SamplingParams(max_new_tokens=3), overlap=False)
     after = engine.phase_time_s
     for kind in ("prefill", "decode"):
         for phase in ("dispatch", "execute", "readback"):

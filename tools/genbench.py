@@ -185,6 +185,12 @@ def _history_metrics(mode: str, report: dict) -> dict:
             "prefill_reuse_ratio": report.get("prefill_reuse_ratio"),
             "ttft_p50_cached_s": report.get("ttft_p50_cached_s"),
         }
+    if mode == "overlap":
+        return {
+            "overlap_tokens_per_s_ratio": report.get("tokens_per_s_ratio"),
+            "overlap_decode_tokens_per_s": report.get("decode_tokens_per_s_on"),
+            "overlap_host_s_per_hot_step": report.get("host_s_per_hot_step_on"),
+        }
     return {}
 
 
@@ -500,6 +506,167 @@ def shared_prefix_bench(args, cfg, params) -> tuple:
     return report, ok
 
 
+def overlap_bench(args, cfg, params) -> tuple:
+    """Overlapped decode A/B (ISSUE 13): the SAME warmed engine drives
+    the same request stream through an overlap-off and an overlap-on
+    scheduler, interleaved best-of-N. Gates: byte-identical streams,
+    zero steady-state retraces (device-resident staging + token carry
+    must not add compiles), no self-healing misfires (the pipeline's
+    drain/recovery machinery must be invisible under plain load),
+    ``host_s_per_hot_step`` strictly DOWN with overlap on (the CPU CI
+    signal: hidden host seconds leave the critical path), the
+    device-bubble ratio not up, and the decode tokens/s ratio at least
+    ``--min-overlap-win``. Returns (report dict, ok bool)."""
+    rs = np.random.RandomState(3)
+    max_new = args.max_new if args.max_new_set else 32
+    lengths = [int(rs.randint(4, args.seq_len - max_new)) for _ in range(args.requests)]
+    prompts = [rs.randint(0, args.vocab, n).tolist() for n in lengths]
+    sampling = SamplingParams(max_new_tokens=max_new)
+
+    engine = GenerationEngine(params, cfg, max_batch_slots=args.slots, block_size=16,
+                              prefix_cache=False)
+    # steady state: warm every bucket + the decode program
+    engine.generate([prompts[0]], SamplingParams(max_new_tokens=2))
+    for b in sorted({engine.bucket_for(n) for n in lengths}):
+        engine.generate([[1] * min(b, args.seq_len - 2)], SamplingParams(max_new_tokens=1))
+    traces_after_warmup = dict(engine.trace_counts)
+
+    def one_run(overlap: bool):
+        sched = ContinuousBatchingScheduler(engine, overlap=overlap)
+        t0 = time.perf_counter()
+        handles = [sched.submit(p, sampling) for p in prompts]
+        while any(not h.done() for h in handles):
+            if not sched.step():
+                break
+        elapsed = time.perf_counter() - t0
+        outs = [h.result(timeout=0) for h in handles]
+        return elapsed, outs, sched
+
+    # interleaved best-of-N: host jitter on a shared CI box exceeds the
+    # per-arm gap of one pass; interleaving hits both arms with the
+    # same drift, best-of-N is the standard noise-robust estimator
+    off_runs, on_runs = [], []
+    outs_off = outs_on = None
+    for _ in range(args.overlap_repeats):
+        e, outs_off, s_off = one_run(False)
+        off_runs.append((e, s_off))
+        e, outs_on, s_on = one_run(True)
+        on_runs.append((e, s_on))
+    best_off_s, best_off = min(off_runs, key=lambda r: r[0])
+    best_on_s, best_on = min(on_runs, key=lambda r: r[0])
+
+    def anatomy_block(sched):
+        hr = sched.anatomy.overlap_headroom()
+        return {
+            "device_bubble_ratio": sched.anatomy.device_bubble_ratio(),
+            "host_s_per_hot_step": hr["host_s_per_hot_step"],
+            "projected_speedup": hr["projected_speedup"],
+            "measured_tokens_per_s": hr["measured_tokens_per_s"],
+        }
+
+    an_off, an_on = anatomy_block(best_off), anatomy_block(best_on)
+    gen_tokens = sum(len(o) for o in outs_on)
+    tps_off = gen_tokens / max(best_off_s, 1e-9)
+    tps_on = gen_tokens / max(best_on_s, 1e-9)
+    ratio = tps_on / max(tps_off, 1e-9)
+    steady_retraces = {
+        k: engine.trace_counts[k] - traces_after_warmup.get(k, 0)
+        for k in engine.trace_counts
+        if engine.trace_counts[k] - traces_after_warmup.get(k, 0) > 0
+    }
+    anatomy_artifact = None
+    if args.overlap_anatomy_out:
+        # one extra (untimed) overlap-on stream with a capture armed:
+        # the uploaded artifact carries the genuinely-diverged two-lane
+        # timeline, the measured arms stay pure wall clock
+        cap_sched = ContinuousBatchingScheduler(engine, overlap=True)
+        cap_sched.anatomy.arm_capture(32)
+        handles = [cap_sched.submit(p, sampling) for p in prompts]
+        while any(not h.done() for h in handles):
+            if not cap_sched.step():
+                break
+        for h in handles:
+            h.result(timeout=0)
+        anatomy_artifact = {
+            "report": cap_sched.anatomy.report(),
+            "timeline": cap_sched.anatomy.to_chrome_trace(),
+        }
+    report = {
+        "requests": args.requests,
+        "generated_tokens": gen_tokens,
+        "repeats": args.overlap_repeats,
+        "exact": outs_off == outs_on,
+        "overlap_off_best_s": round(best_off_s, 4),
+        "overlap_on_best_s": round(best_on_s, 4),
+        "decode_tokens_per_s_off": round(tps_off, 2),
+        "decode_tokens_per_s_on": round(tps_on, 2),
+        "tokens_per_s_ratio": round(ratio, 4),
+        "host_s_per_hot_step_off": an_off["host_s_per_hot_step"],
+        "host_s_per_hot_step_on": an_on["host_s_per_hot_step"],
+        "device_bubble_ratio_off": an_off["device_bubble_ratio"],
+        "device_bubble_ratio_on": an_on["device_bubble_ratio"],
+        "projected_speedup_off": an_off["projected_speedup"],
+        "projected_speedup_on": an_on["projected_speedup"],
+        "pipe_dispatches": best_on.pipe_dispatches,
+        "pipe_drains": dict(best_on.pipe_drains),
+        "pipe_discards": best_on.pipe_discards,
+        "steady_state_retraces": steady_retraces,
+        "capacity": capacity_block(best_on),
+        "backend": jax.default_backend(),
+    }
+    scheds = [s for _, s in off_runs] + [s for _, s in on_runs]
+    ok = check_no_self_healing(report, scheds, [engine])
+    print(json.dumps(report, indent=2))
+    if not report["exact"]:
+        print("FAIL: overlap-on token streams differ from overlap-off",
+              file=sys.stderr)
+        ok = False
+    if steady_retraces:
+        print(f"FAIL: steady-state stream retraced: {steady_retraces}",
+              file=sys.stderr)
+        ok = False
+    if best_on.pipe_dispatches == 0:
+        print("FAIL: the overlap pipeline never engaged", file=sys.stderr)
+        ok = False
+    h_off, h_on = an_off["host_s_per_hot_step"], an_on["host_s_per_hot_step"]
+    if h_off is None or h_on is None or not (h_on < h_off):
+        print(
+            f"FAIL: host_s_per_hot_step not strictly down with overlap on: "
+            f"off={h_off} on={h_on}",
+            file=sys.stderr,
+        )
+        ok = False
+    b_off, b_on = an_off["device_bubble_ratio"], an_on["device_bubble_ratio"]
+    if b_off is not None and b_on is not None and b_on > b_off + 0.02:
+        print(
+            f"FAIL: device_bubble_ratio rose with overlap on: "
+            f"off={b_off:.4f} on={b_on:.4f}",
+            file=sys.stderr,
+        )
+        ok = False
+    # "headroom gap closed": the Amdahl projection's remaining upside
+    # must shrink with the pipeline on — what overlap could buy, it did
+    p_off, p_on = an_off["projected_speedup"], an_on["projected_speedup"]
+    if p_off is None or p_on is None or not (p_on < p_off + 1e-9):
+        print(
+            f"FAIL: overlap-headroom gap did not close: projected_speedup "
+            f"off={p_off} on={p_on}",
+            file=sys.stderr,
+        )
+        ok = False
+    if ratio < args.min_overlap_win:
+        print(
+            f"FAIL: overlap tokens/s ratio {ratio:.3f} < required "
+            f"{args.min_overlap_win}",
+            file=sys.stderr,
+        )
+        ok = False
+    if args.overlap_anatomy_out:
+        with open(args.overlap_anatomy_out, "w") as f:
+            json.dump(anatomy_artifact, f, indent=2)
+    return report, ok
+
+
 def trace_overhead_bench(args, cfg, params) -> tuple:
     """Tracing-overhead guard: the same steady-state stream with
     observability off vs on, interleaved best-of-N. Returns
@@ -675,6 +842,25 @@ def main() -> int:
     ap.add_argument("--prefix-repeats", type=int, default=3,
                     help="interleaved (off, on) stream pairs; best-of-N "
                          "TTFT per arm")
+    ap.add_argument("--overlap", action="store_true",
+                    help="benchmark overlapped decode: interleaved A/B of "
+                         "the same stream with the pipeline off vs on, "
+                         "gating stream identity, zero retraces, and the "
+                         "host_s_per_hot_step drop")
+    ap.add_argument("--min-overlap-win", type=float, default=0.9,
+                    help="required overlap-on/off decode tokens/s ratio. "
+                         "On CPU CI the pipeline cannot buy wall clock "
+                         "(XLA:CPU parks the dispatch call on pending "
+                         "inputs), so the default only guards against a "
+                         "real regression; the hard CPU gates are "
+                         "host_s_per_hot_step strictly down and the "
+                         "headroom gap closing. On TPU pass e.g. 1.1")
+    ap.add_argument("--overlap-repeats", type=int, default=3,
+                    help="interleaved (off, on) stream pairs; best-of-N")
+    ap.add_argument("--overlap-anatomy-out", default="",
+                    help="with --overlap: write the overlap-on step-anatomy "
+                         "report + captured two-lane timeline (the tpu-ci "
+                         "artifact) to this file")
     ap.add_argument("--trace-out", default="",
                     help="benchmark tracing overhead; write report + "
                          "chrome timeline + sample trace to this file")
@@ -725,6 +911,25 @@ def main() -> int:
         print(
             f"OK: tracing overhead {report['tracing_overhead'] * 100:.2f}% "
             f"(< {args.max_trace_overhead * 100:.1f}%), zero additional retraces"
+        )
+        return 0
+
+    if args.overlap:
+        report, ok = overlap_bench(args, cfg, params)
+        write_bench_artifact(args.bench_out, "overlap", report)
+        append_history(args.history_out, "overlap", report, ok)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2)
+        if not ok:
+            return 1
+        print(
+            f"OK: byte-identical streams at {report['tokens_per_s_ratio']}x "
+            f"decode tokens/s with overlap on "
+            f"(host_s_per_hot_step {report['host_s_per_hot_step_off']:.6f} -> "
+            f"{report['host_s_per_hot_step_on']:.6f}, "
+            f"{report['pipe_dispatches']} pipelined dispatches), zero "
+            "steady-state retraces"
         )
         return 0
 

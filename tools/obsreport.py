@@ -498,13 +498,13 @@ def selfcheck() -> int:
         # human-readable blame string on the registry
         import jax.numpy as jnp
         b = eng.max_batch_slots + 1
-        keys = jnp.stack([jax.random.key(0)] * b)
         eng._decode_jit(
             eng.params, jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
             eng.cache.k, eng.cache.v,
             jnp.zeros((b, eng.max_blocks_per_seq), jnp.int32),
             jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32),
-            jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32), keys,
+            jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32),
+            jnp.zeros((b,), jnp.uint32), jnp.zeros((b,), jnp.int32),
         )
         retraces = _get_json(f"{base}/v2/debug/programs")["models"]["lm"]["retraces"]
         check(retraces, "forced retrace produced no registry record")
@@ -538,7 +538,7 @@ def selfcheck() -> int:
               and _math.isfinite(hr["projected_speedup"]),
               f"overlap-headroom projection missing: {hr}")
         decode_phases = rep.get("phases", {}).get("decode", {})
-        for phase in ("dispatch", "execute", "readback", "bookkeep", "sample"):
+        for phase in ("dispatch", "execute", "readback", "bookkeep"):
             check(decode_phases.get(phase, {}).get("count", 0) >= 1,
                   f"decode anatomy missing the {phase} phase: "
                   f"{sorted(decode_phases)}")

@@ -63,6 +63,15 @@ METRICS: Dict[str, Tuple[str, str, float]] = {
     # fire on the backend CI runs.) Wall-clock-derived -> the wide
     # relative floor wall clocks get.
     "host_s_per_hot_step": ("lower", "rel", 0.25),
+    # overlap mode (ISSUE 13): the A/B tokens/s ratio is a ratio of
+    # interleaved best-of-N runs (steadier than raw wall clocks); the
+    # on-arm tok/s and hidden-host seconds are wall-clock-derived and
+    # get the wide relative floor. A ratio drop past the floor means
+    # the pipeline stopped winning; a host_s rise means hidden host
+    # work crept back onto the decode critical path.
+    "overlap_tokens_per_s_ratio": ("higher", "rel", 0.10),
+    "overlap_decode_tokens_per_s": ("higher", "rel", 0.12),
+    "overlap_host_s_per_hot_step": ("lower", "rel", 0.25),
     # shared-prefix mode (prefix caching): the improvement ratio and
     # reuse fraction are ratios of interleaved best-of-N runs, so they
     # are steadier than raw wall clocks; cached TTFT is a wall clock
