@@ -103,6 +103,7 @@ from ..obs import (
     next_request_id,
 )
 from ..runtime import faults
+from ..serving.overload import OverloadConfig, OverloadController, Priority
 from ..serving.resilience import (
     CircuitBreaker,
     CircuitOpenError,
@@ -243,8 +244,17 @@ class Request:
         deadline: Optional[float] = None,
         speculation: Optional[SpeculationConfig] = None,
         drafter=None,
+        priority: str = Priority.STANDARD,
     ):
         self.id = next_request_id()
+        # overload control (serving/overload.py): the priority class
+        # orders admission, preemption victims, and shed order; the
+        # release hook returns this request's AdaptiveLimiter slot on
+        # terminal settle (set at submit, fired exactly once by the
+        # handle's settle-race winner)
+        self.priority = priority
+        self.priority_rank = Priority.rank(priority)
+        self.overload_release: Optional[Callable[[], None]] = None
         # observability: the scheduler swaps in a live RequestTrace (+
         # destination ring) at submit when tracing is enabled
         self.trace = NULL_TRACE
@@ -294,6 +304,14 @@ class Request:
     def _trace_done(self, outcome: str, err: Optional[BaseException]) -> None:
         """Terminal trace hook, called by the handle's settle-race
         winner (exactly once per request)."""
+        # limiter slot back first (claim-protected, so exactly once),
+        # and unconditionally — observability off must not leak slots
+        release, self.overload_release = self.overload_release, None
+        if release is not None:
+            try:
+                release()
+            except Exception:
+                pass  # limiter accounting must never poison a settle path
         if self.trace is NULL_TRACE:
             return
         self.trace.mark_finish(outcome, err)
@@ -416,6 +434,7 @@ class ContinuousBatchingScheduler:
         pressure_threshold: float = 0.10,
         fault_scope: Optional[str] = None,
         overlap: Optional[bool] = None,
+        overload: Optional[OverloadConfig] = None,
     ):
         self.engine = engine
         # fleet integration (serving/fleet.py): fault_scope tags every
@@ -507,6 +526,39 @@ class ContinuousBatchingScheduler:
             reclaimable=lambda: engine.prefix_cache.evictable_blocks,
         )
         self.capacity.register_gauges(self.stats, lambda: list(self._running.values()))
+        # overload control (ISSUE 14, serving/overload.py): priority-
+        # aware admission + AIMD concurrency limit (driven by the PR 5
+        # queue-time/TTFT windows and the cache-pressure flag above) +
+        # the graceful-degradation ladder. The roofline TTFT predictor
+        # backs the infeasibility fast-fail: predicted TTFT for a
+        # prompt behind `depth` queued requests is (depth + 1) prefills
+        # on the PR 7 serving roofline — injectable for pinned tests.
+        fm = engine.flops_model
+        self.overload = OverloadController(
+            clock=self.clock,
+            slots=engine.max_batch_slots,
+            max_queue=max_queue,
+            queue_depth=lambda: len(self._queue),
+            queue_p95=lambda: self.stats.window_p95("queue_time"),
+            ttft_p95=lambda: self.stats.window_p95("ttft"),
+            cache_pressure=lambda: self.capacity.under_pressure,
+            ttft_predictor=lambda n, depth: (depth + 1) * fm.roofline_s(
+                fm.prefill_flops(n), fm.prefill_bytes(n)
+            ),
+            stats=self.stats,
+            on_transition=self._note_degrade,
+            config=overload,
+        )
+        self.overload.register_gauges(self.stats)
+        # per-priority queue accounting (gauge snapshot is racy-ok,
+        # like every other scrape-side read of the live deque)
+        for p in Priority.ORDER:
+            self.stats.add_gauge(
+                f"overload_queue_depth_{p}",
+                lambda p=p: sum(
+                    1 for r in list(self._queue) if r.priority == p
+                ),
+            )
         # prefix-cache telemetry (flexflow_serving_prefix_cache_*):
         # hit ratio, reuse volume, COW copies, host-tier swaps and
         # residency — counters ride as gauges like the cache_* family
@@ -616,14 +668,23 @@ class ContinuousBatchingScheduler:
         deadline_s: Optional[float] = None,
         speculation: Optional[SpeculationConfig] = None,
         transport: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> GenerationHandle:
-        """Enqueue one request (FCFS). Typed rejections mirror the
-        batcher: QueueFullError on backpressure, CircuitOpenError while
-        the breaker holds traffic, ShuttingDownError while draining,
-        DeadlineExceededError for an already-expired budget.
-        ``speculation`` turns on (exact) speculative decoding for this
-        request; None falls back to the scheduler-wide default.
-        ``transport`` annotates the request's trace ("http"/"grpc")."""
+        """Enqueue one request (priority-ordered, FCFS within a class).
+        Typed rejections mirror the batcher: OverloadedError (a
+        QueueFullError subclass, carrying reason / priority /
+        retry_after_s) on backpressure, limiter throttling, or
+        degradation shedding; InfeasibleError when the roofline-
+        predicted TTFT already exceeds the deadline; CircuitOpenError
+        while the breaker holds traffic; ShuttingDownError while
+        draining; DeadlineExceededError for an already-expired budget.
+        A full queue sheds the youngest queued request of the LOWEST
+        class that is strictly below the newcomer's (never a mid-stream
+        resume) before rejecting the newcomer. ``speculation`` turns on
+        (exact) speculative decoding for this request; None falls back
+        to the scheduler-wide default. ``transport`` annotates the
+        request's trace ("http"/"grpc"). ``priority`` is one of
+        Priority.ORDER (default standard)."""
         if self._draining:
             raise ShuttingDownError("generation scheduler draining")
         if self._stopped:
@@ -646,10 +707,27 @@ class ContinuousBatchingScheduler:
         if deadline_s is not None and deadline_s <= 0:
             self.stats.incr("expired")
             raise DeadlineExceededError("deadline already expired at submit")
+        priority = Priority.parse(priority)
+        rank = Priority.rank(priority)
+        ctl = self.overload
+        # chaos hook: force admission-path failures (typically a typed
+        # OverloadedError) so tests drive the limiter/shed paths
+        # deterministically without generating real pressure
+        faults.inject(faults.SERVING_ADMISSION, (priority, len(self._queue)))
+        if ctl.degraded_reject(priority):
+            raise ctl.overload_error(
+                f"degraded: shedding {priority} traffic "
+                f"(ladder level {ctl.ladder.level})",
+                "degraded", priority,
+            )
+        if deadline_s is not None:
+            predicted = ctl.infeasible(len(prompt), deadline_s)
+            if predicted is not None:
+                raise ctl.infeasible_error(priority, predicted, deadline_s)
+        shed: List = []  # (victim, error) pairs, settled OUTSIDE the lock
         with self._lock:
-            if len(self._queue) >= self.max_queue:
-                self.stats.incr("rejected")
-                raise QueueFullError(f"generation queue full ({self.max_queue})")
+            # breaker FIRST — before any shed planning, so a submit the
+            # breaker is about to refuse can never destroy queued work.
             # ready(), NOT allow(): submit only enqueues — the device
             # call happens at admission, so the half-open probe slot
             # must be claimed by _admit. A submit that claimed it would
@@ -672,7 +750,7 @@ class ContinuousBatchingScheduler:
                 )
             req = Request(
                 list(prompt), sampling, deadline=deadline,
-                speculation=spec, drafter=drafter,
+                speculation=spec, drafter=drafter, priority=priority,
             )
             req.submitted_at = self.clock()
             if self.obs_enabled:
@@ -698,7 +776,77 @@ class ContinuousBatchingScheduler:
                 - len(prompt)
             )
             req.max_new = min(sampling.max_new_tokens, room, cache_room)
-            self._queue.append(req)
+            # degrade level 3+: clamp NEW admissions' budgets per class
+            # (running streams keep the budget they were admitted with)
+            cap = ctl.max_new_cap(priority)
+            if cap is not None:
+                req.max_new = min(req.max_new, max(1, cap))
+            # overload gates, planned BEFORE any victim is touched: the
+            # full shed set (one for queue space when full, at most one
+            # more when queued lower-priority work holds the limiter
+            # slot — no priority inversion) is feasibility-checked
+            # first, so a newcomer the gates will refuse anyway never
+            # destroys queued work. Victims' limiter slots release here
+            # (under the lock, so the acquire below cannot lose them);
+            # their handles settle AFTER the lock drops.
+            need = 1 if len(self._queue) >= self.max_queue else 0
+            freed = need
+            if not ctl.limiter.can_admit(priority, freed=freed):
+                freed += 1  # one extra shed, for the limiter slot itself
+                if not ctl.limiter.can_admit(priority, freed=freed):
+                    raise ctl.overload_error(
+                        "admission throttled by the adaptive concurrency "
+                        f"limit ({ctl.limiter.limit:.0f})",
+                        "limiter", priority,
+                    )
+            if freed:
+                victims = self._shed_victims_locked(rank, freed)
+                if len(victims) < freed:
+                    if need and not victims:
+                        raise ctl.overload_error(
+                            f"generation queue full ({self.max_queue})",
+                            "queue_full", priority,
+                        )
+                    raise ctl.overload_error(
+                        "admission throttled by the adaptive concurrency "
+                        f"limit ({ctl.limiter.limit:.0f})",
+                        "limiter", priority,
+                    )
+                reason = "queue_full" if need else "limiter"
+                detail = (
+                    f"queue full at {self.max_queue}" if need
+                    else f"adaptive limit {ctl.limiter.limit:.0f}"
+                )
+                for victim in victims:
+                    self._queue.remove(victim)
+                    release, victim.overload_release = (
+                        victim.overload_release, None
+                    )
+                    if release is not None:
+                        try:
+                            release()
+                        except Exception:
+                            pass
+                    shed.append((victim, ctl.overload_error(
+                        f"shed for a higher-priority admission ({detail})",
+                        reason, victim.priority, shed=True,
+                    )))
+            if not ctl.limiter.try_acquire(priority):
+                # unreachable by construction (can_admit held under this
+                # lock and inflight only shrinks concurrently); typed
+                # anyway rather than trusting the invariant with a hang
+                raise ctl.overload_error(
+                    "admission throttled by the adaptive concurrency "
+                    f"limit ({ctl.limiter.limit:.0f})",
+                    "limiter", priority,
+                )
+            req.overload_release = ctl.limiter.release
+            self._queue_insert_locked(req)
+        # settle shed victims OUTSIDE the lock: Future.set_exception
+        # runs client done-callbacks synchronously, and a callback that
+        # re-enters the scheduler must not deadlock on _lock
+        for victim, err in shed:
+            victim.handle._fail(err)
         self.stats.incr("admitted")
         self._wake.set()
         return req.handle
@@ -956,6 +1104,19 @@ class ContinuousBatchingScheduler:
             req.trace_ring = self.trace_ring
         if req.slo_sink is not None:
             req.slo_sink = self._slo_record
+        # retarget overload accounting too: release the dead replica's
+        # limiter slot and count the stream against THIS limiter —
+        # forced past the limit (a migrated stream was already admitted
+        # once and must never be dropped for headroom it cleared
+        # elsewhere), so would_admit/pressure see the true load
+        release, req.overload_release = req.overload_release, None
+        if release is not None:
+            try:
+                release()
+            except Exception:
+                pass
+        self.overload.limiter.acquire_forced()
+        req.overload_release = self.overload.limiter.release
         with self._lock:
             if front:
                 self._queue.appendleft(req)
@@ -1145,14 +1306,91 @@ class ContinuousBatchingScheduler:
         with self._stamped():
             return fn()
 
+    def _queue_insert_locked(self, req: Request) -> None:
+        """Priority-ordered enqueue: ahead of the first FRESH queued
+        request of a strictly lower class, FIFO within a class. Resumed
+        work (preempted / journal-replayed, requeued at the front by
+        appendleft) keeps absolute precedence — a new interactive
+        request must not starve a mid-stream resume whose client
+        already holds tokens."""
+        q = self._queue
+        for i, cand in enumerate(q):
+            if cand.n_generated > 0 or cand.preemptions > 0 or cand.replays > 0:
+                continue
+            if cand.priority_rank > req.priority_rank:
+                q.insert(i, req)
+                return
+        q.append(req)
+
+    def _shed_victims_locked(self, rank: int, n: int) -> List[Request]:
+        """Up to ``n`` shed victims for a newcomer of ``rank``: fresh
+        queued requests of classes strictly below the newcomer's (never
+        a mid-stream resume — its client already holds tokens), lowest
+        class first, youngest first within a class."""
+        cands = [
+            (cand.priority_rank, idx, cand)
+            for idx, cand in enumerate(self._queue)
+            if cand.priority_rank > rank
+            and cand.n_generated == 0 and cand.preemptions == 0
+            and cand.replays == 0 and not cand.handle.done()
+        ]
+        cands.sort(key=lambda t: (t[0], t[1]), reverse=True)
+        return [cand for _, _, cand in cands[:n]]
+
+    def _shed_queued_best_effort(self) -> None:
+        """Degrade level 4: every queued fresh best-effort request
+        fails typed (reason "degraded"); resumed best-effort streams
+        keep their place — shedding them would cut off clients
+        mid-stream."""
+        with self._lock:
+            victims = [
+                r for r in self._queue
+                if r.priority_rank == Priority.RANK[Priority.BEST_EFFORT]
+                and r.n_generated == 0 and r.preemptions == 0
+                and r.replays == 0 and not r.handle.done()
+            ]
+            for r in victims:
+                self._queue.remove(r)
+        for r in victims:
+            r.handle._fail(self.overload.overload_error(
+                "degraded: best-effort shed at ladder level "
+                f"{self.overload.ladder.level}",
+                "degraded", r.priority, shed=True,
+            ))
+
+    def _note_degrade(self, old: int, new: int, pressure: float) -> None:
+        """Ladder-transition hook: every level change is a flight-ring
+        event next to the steps that caused it."""
+        self.flight.record_event(
+            "degrade", level=new, prev=old, pressure=round(pressure, 3)
+        )
+
+    def _overload_tick(self) -> None:
+        """One overload-control iteration (limiter AIMD + ladder), plus
+        the ladder's level-4 action: shed queued best-effort work."""
+        self.overload.tick()
+        if self.overload.ladder.shed_best_effort():
+            self._shed_queued_best_effort()
+
     def _preempt_youngest(self, exclude: Optional[_Running] = None) -> bool:
-        """Evict the most recently admitted running sequence (vLLM's
-        LIFO recompute victim): free its blocks, fold its generated
-        tokens into the prompt, and requeue it at the FRONT."""
+        """Evict a running sequence for recompute under cache pressure:
+        the victim is the youngest member of the LOWEST priority class
+        present (vLLM's LIFO recompute victim, priority-ordered): free
+        its blocks, fold its generated tokens into the prompt, and
+        requeue it at the FRONT. ``exclude`` is the growing sequence:
+        it is never the victim here — and neither is anything that
+        OUTRANKS it (growing a best-effort stream must not evict an
+        interactive one; returning False makes the caller self-preempt
+        the grower instead)."""
         victims = [s for s in self._running.values() if s is not exclude]
+        if exclude is not None:
+            victims = [
+                s for s in victims
+                if s.req.priority_rank >= exclude.req.priority_rank
+            ]
         if not victims:
             return False
-        victim = max(victims, key=lambda s: s.admitted_seq)
+        victim = max(victims, key=lambda s: (s.req.priority_rank, s.admitted_seq))
         self.capacity.note_preempt(len(victim.blocks))
         # stash the victim's computed KV in the radix index before the
         # release: its re-admission (and any prefix-sharing request)
@@ -1399,6 +1637,12 @@ class ContinuousBatchingScheduler:
             budget = req.max_new - req.n_generated  # >= 1 while running
             pos_room = (self.engine.max_seq_len - 1) - state.cached_len
             state.step_k = max(0, min(req.spec_k, budget - 1, pos_room))
+            # degrade ladder: level 1 caps the window, level 2 disables
+            # drafting outright — exact either way (PR 3's acceptance
+            # rule: any k, including 0, emits the same greedy stream)
+            cap = self.overload.spec_cap()
+            if cap is not None:
+                state.step_k = min(state.step_k, cap)
 
     def _grow(self) -> None:
         """Ensure every running sequence has cache blocks for its next
@@ -2146,6 +2390,7 @@ class ContinuousBatchingScheduler:
                         hot=not info.get("handled_failure", False),
                     )
                 self.capacity.tick()
+                self._overload_tick()
                 return r
         self._expire()
         t1 = time.perf_counter()
@@ -2183,6 +2428,8 @@ class ContinuousBatchingScheduler:
             )
         # integrate time-at-pressure AFTER the step's allocations, so
         # the pressure flag reflects the state the next interval runs in
-        # (injectable clock: virtual-clock tests integrate exactly)
+        # (injectable clock: virtual-clock tests integrate exactly);
+        # the overload control plane ticks on the fresh pressure flag
         self.capacity.tick()
+        self._overload_tick()
         return did

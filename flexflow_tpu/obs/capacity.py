@@ -101,6 +101,13 @@ class CacheTelemetry:
         self._last_tick: Optional[float] = None
         self._was_under = False
 
+    @property
+    def under_pressure(self) -> bool:
+        """The most recent tick's pressure flag (free + reclaimable at
+        or below the threshold) — the AdaptiveLimiter's cache-pressure
+        input."""
+        return self._was_under
+
     # ------------------------------------------------------------- hooks
     def tick(self) -> None:
         """Integrate time-at-pressure; called once per scheduler step."""

@@ -105,6 +105,19 @@ _HELP = {
     "step_overlap_projected_tokens_per_s": "Amdahl projection: tokens/s if host phases were hidden behind device execution.",
     "step_overlap_projected_speedup": "Projected step-wall speedup from fully overlapping host work with device execution.",
     "step_anatomy_steps_observed": "Scheduler iterations folded into the step-anatomy aggregator.",
+    "overload_limit": "AdaptiveLimiter's live AIMD concurrency limit (queued + running requests).",
+    "overload_inflight": "Live requests currently counted against the adaptive concurrency limit.",
+    "overload_throttled_total": "Admissions refused by the adaptive concurrency limit (cumulative).",
+    "overload_limit_cuts_total": "Multiplicative-decrease events of the adaptive concurrency limit (cumulative).",
+    "overload_sheds_total": "Queued requests shed for higher-priority admissions or by the degradation ladder (cumulative).",
+    "overload_infeasible_total": "Requests denied because predicted TTFT already exceeded their deadline (cumulative).",
+    "overload_queue_depth_interactive": "Queued interactive-priority requests.",
+    "overload_queue_depth_standard": "Queued standard-priority requests.",
+    "overload_queue_depth_best_effort": "Queued best-effort-priority requests.",
+    "degrade_level": "Graceful-degradation ladder level (0 = normal service).",
+    "degrade_transitions_total": "Degradation-ladder level transitions (cumulative).",
+    "autoscale_signal": "Fleet autoscale signal: 1 want-more, -1 want-fewer, 0 steady.",
+    "autoscale_want_replicas": "Replica count the fleet's sustained limiter state asks for.",
     "fleet_replicas": "Current fleet replicas per lifecycle state.",
     "fleet_failovers_total": "Replica deaths whose live streams were handed over for cross-replica journal-replay.",
     "fleet_migrated_streams_total": "Streams journal-replayed onto a surviving or replacement replica.",
@@ -315,6 +328,23 @@ def render_prometheus(
                     'flexflow_serving_router_decisions_total{model="%s",reason="%s"} %s'
                     % (fl, escape_label_value(reason),
                        format_value(decisions[reason]))
+                )
+        # autoscaling signal (serving/overload.py AutoscaleAdvisor):
+        # want-more/want-fewer from sustained limiter saturation
+        for short, key in (
+            ("autoscale_signal", "signal"),
+            ("autoscale_want_replicas", "want_replicas"),
+        ):
+            family = "flexflow_serving_%s" % short
+            _help_type(lines, family, "gauge")
+            for f in fnames:
+                auto = fleets[f].get("autoscale")
+                if auto is None:
+                    continue
+                lines.append(
+                    '%s{model="%s"} %s'
+                    % (family, escape_label_value(f),
+                       format_value(auto.get(key, 0)))
                 )
 
     # ---------------------------------------------------------- fault sites

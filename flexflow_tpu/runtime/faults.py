@@ -65,6 +65,7 @@ EXECUTOR_PREDICT = "executor.predict"
 ELASTIC_STEP = "elastic.step"
 SERVING_MODEL_INFER = "serving.model.infer"
 SERVING_BATCHER_DISPATCH = "serving.batcher.dispatch"
+SERVING_ADMISSION = "serving.admission"
 SERVING_REPOSITORY_LOAD = "serving.repository.load"
 CHECKPOINT_SAVE = "checkpoint.save"
 GENERATION_PREFILL = "generation.prefill"
@@ -85,6 +86,12 @@ SITES = MappingProxyType({
     ELASTIC_STEP: "top of each `ElasticTrainer` step",
     SERVING_MODEL_INFER: "before a served model's device call (value: inputs)",
     SERVING_BATCHER_DISPATCH: "before the batcher runs a device batch",
+    SERVING_ADMISSION: (
+        "inside the generation scheduler's submit, before the overload "
+        "gates (value: (priority, queue depth)); an error here is a forced "
+        "admission failure, so chaos plans can drive the limiter/shed "
+        "paths deterministically"
+    ),
     SERVING_REPOSITORY_LOAD: "before a repository model load",
     CHECKPOINT_SAVE: "top of `save_checkpoint`",
     GENERATION_PREFILL: "before a generation prefill (value: prompt tokens)",

@@ -6,10 +6,20 @@ Reference: triton/ (16k LoC Legion-based Triton backend, SURVEY §2.9).
 from .batcher import DynamicBatcher
 from .model import InferenceModel, TensorMeta
 from .repository import ModelRepository, load_model, save_model
+from .overload import (
+    AdaptiveLimiter,
+    AutoscaleAdvisor,
+    DegradeLadder,
+    OverloadConfig,
+    OverloadController,
+    Priority,
+)
 from .resilience import (
     CircuitBreaker,
     CircuitOpenError,
     DeadlineExceededError,
+    InfeasibleError,
+    OverloadedError,
     QueueFullError,
     ResilienceError,
     RetryPolicy,
@@ -19,9 +29,12 @@ from .server import InferenceServer
 from .stats import FleetStats, Histogram, LatencyWindow, ServingStats, TokenRate
 
 __all__ = [
+    "AdaptiveLimiter",
+    "AutoscaleAdvisor",
     "CircuitBreaker",
     "CircuitOpenError",
     "DeadlineExceededError",
+    "DegradeLadder",
     "DynamicBatcher",
     "Fleet",
     "FleetRouter",
@@ -29,10 +42,15 @@ __all__ = [
     "GenerationModel",
     "GrpcInferenceServer",
     "Histogram",
+    "InfeasibleError",
     "InferenceModel",
     "InferenceServer",
     "LatencyWindow",
     "ModelRepository",
+    "OverloadConfig",
+    "OverloadController",
+    "OverloadedError",
+    "Priority",
     "QueueFullError",
     "ResilienceError",
     "RetryPolicy",

@@ -43,11 +43,12 @@ import numpy as np
 from ..obs import RequestTrace, TraceRing, next_request_id
 from ..runtime import faults
 from .model import InferenceModel
+from .overload import Priority
 from .resilience import (
     CircuitBreaker,
     CircuitOpenError,
     DeadlineExceededError,
-    QueueFullError,
+    OverloadedError,
     RetryPolicy,
     ShuttingDownError,
 )
@@ -205,12 +206,17 @@ class DynamicBatcher:
         inputs: Sequence[np.ndarray],
         deadline_s: Optional[float] = None,
         transport: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> Future:
         """Enqueue one request (batch <= max_batch); returns a Future of
         the output list. ``deadline_s`` is this request's latency budget:
         if it expires before the request reaches the device, the request
         fails with DeadlineExceededError instead of wasting batch space.
-        ``transport`` annotates the request's trace ("http"/"grpc")."""
+        ``transport`` annotates the request's trace ("http"/"grpc").
+        ``priority`` (interactive / standard / best_effort) labels the
+        rejection accounting: a full queue answers with the typed
+        OverloadedError (HTTP 503 + Retry-After) counted per reason AND
+        per class, so /v2/stats explains why load was refused."""
         # draining outranks stopped: a wedged drain leaves _running False
         # with _draining set, and those submits must stay 503, not 500
         if self._draining:
@@ -233,10 +239,16 @@ class DynamicBatcher:
         if deadline_s is not None and deadline_s <= 0:
             self.stats.incr("expired")
             raise DeadlineExceededError("deadline already expired at submit")
+        priority = Priority.parse(priority)
         if self._q.qsize() >= self.max_queue:
+            # per-reason / per-priority split next to the aggregate, so
+            # /v2/stats explains WHY load was refused (ISSUE 14)
             self.stats.incr("rejected")
-            raise QueueFullError(
-                f"model {self.model.name!r}: request queue full ({self.max_queue})"
+            self.stats.incr("rejected_queue_full")
+            self.stats.incr(f"rejected_{priority}")
+            raise OverloadedError(
+                f"model {self.model.name!r}: request queue full ({self.max_queue})",
+                reason="queue_full", priority=priority, retry_after_s=1.0,
             )
         # breaker LAST so a rejection on the cheap checks above can never
         # consume (and leak) the HALF_OPEN probe slot

@@ -76,9 +76,11 @@ from ..generation.scheduler import GenerationHandle, Request
 from ..obs import FlightRecorder
 from ..runtime import faults
 from .generation import GenerationModel
+from .overload import AutoscaleAdvisor, Priority
 from .resilience import (
     CircuitOpenError,
     DeadlineExceededError,
+    OverloadedError,
     ShuttingDownError,
 )
 from .stats import FleetStats
@@ -203,10 +205,28 @@ class FleetRouter:
             return 0
         return best
 
+    @staticmethod
+    def _would_admit(replica: Replica, priority: str) -> bool:
+        """Overload-gate probe for one replica (serving/overload.py):
+        would its scheduler admit this priority class right now? A
+        mid-iteration race degrades to True — the replica's own submit
+        still answers with the typed rejection."""
+        try:
+            return replica.scheduler.overload.would_admit(priority)
+        except Exception:
+            return True
+
     # ------------------------------------------------------------ routing
-    def route(self, prompt: Sequence[int]) -> Tuple[Replica, str]:
+    def route(
+        self, prompt: Sequence[int], priority: str = Priority.STANDARD,
+    ) -> Tuple[Replica, str]:
         """Pick the replica for one request; returns (replica, reason).
-        Raises CircuitOpenError when no replica is eligible (fleet
+        Saturated replicas (their overload controller would refuse this
+        priority) are SPILLED past: placement falls to whichever
+        eligible replicas still admit, and only when every eligible
+        replica is saturated does the fleet shed — the typed
+        OverloadedError, counted as a fleet shed. Raises
+        CircuitOpenError when no replica is eligible at all (fleet
         brownout) — except the single-replica fleet, which delegates to
         its lone replica so submit raises exactly the bare
         GenerationModel's typed error (parity)."""
@@ -224,8 +244,39 @@ class FleetRouter:
                 "fleet brownout: no eligible replica "
                 f"({', '.join(f'{r.id}={r.state}' for r in reps)})"
             )
+        admitting = [r for r in cands if self._would_admit(r, priority)]
+        spilled = len(admitting) < len(cands)
+        if not admitting:
+            if len(reps) == 1:
+                # n=1 parity: the lone replica's submit raises its own
+                # typed OverloadedError with the real reason
+                self.stats.note_decision("only_candidate")
+                return reps[0], "only_candidate"
+            # fleet-wide shed: EVERY eligible replica is saturated, so
+            # spilling has nowhere left to go. The reason reflects the
+            # actual mechanism: "degraded" when every replica's ladder
+            # is shedding this class, "limiter" otherwise.
+            self.stats.note_decision("fleet_shed")
+            self.fleet.fleet_stats.incr("sheds")
+            try:
+                degraded = all(
+                    r.scheduler.overload.degraded_reject(priority)
+                    for r in cands
+                )
+                retry_after = max(
+                    r.scheduler.overload.retry_after_s() for r in cands
+                )
+            except Exception:
+                degraded, retry_after = False, 1.0
+            raise OverloadedError(
+                f"fleet saturated: no eligible replica admits {priority} "
+                f"traffic ({', '.join(r.id for r in cands)})",
+                reason="degraded" if degraded else "limiter",
+                priority=priority, retry_after_s=retry_after,
+            )
+        cands = admitting
         if len(cands) == 1:
-            choice, reason = cands[0], "only_candidate"
+            choice, reason = cands[0], ("spill" if spilled else "only_candidate")
         else:
             loads = {r.id: self.load_score(r) for r in cands}
             best = min(loads.values())
@@ -244,6 +295,10 @@ class FleetRouter:
                     reason = "least_loaded"
             else:
                 choice, reason = near[0], "least_loaded"
+        if spilled:
+            # placement succeeded only because a saturated replica was
+            # passed over — count the spill, whatever broke the tie
+            reason = "spill"
         self.stats.note_decision(reason)
         return choice, reason
 
@@ -406,6 +461,12 @@ class Fleet:
         self._monitor: Optional[threading.Thread] = None
         self._monitor_stop = threading.Event()
         self.router = FleetRouter(self, self.fleet_stats)
+        # autoscaling signal (ISSUE 14 / ROADMAP item 3 remainder):
+        # sustained limiter saturation across every eligible replica ->
+        # want-more; sustained fleet-wide idleness -> want-fewer.
+        # Published on GET /v2/fleet/autoscale and as the
+        # flexflow_serving_autoscale_* gauges.
+        self.autoscale = AutoscaleAdvisor(clock=clock)
         # replaced-but-still-busy replicas: out of the routing set, kept
         # stepping until their residents finish (or expire), then torn
         # down — a drain timeout must never abort live streams
@@ -468,17 +529,22 @@ class Fleet:
         deadline_s: Optional[float] = None,
         speculation=None,
         transport: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> GenerationHandle:
         """Route + enqueue one request. Typed rejections mirror the
-        single-model path (QueueFullError / CircuitOpenError /
-        ShuttingDownError / DeadlineExceededError), plus
-        CircuitOpenError for a fleet-wide brownout."""
+        single-model path (OverloadedError / QueueFullError /
+        CircuitOpenError / ShuttingDownError / DeadlineExceededError),
+        plus CircuitOpenError for a fleet-wide brownout and
+        OverloadedError when every eligible replica is saturated (the
+        router spills by priority first; the fleet-wide shed is the
+        last resort)."""
         if self._draining or self._stopped:
             raise ShuttingDownError("fleet draining")
-        replica, reason = self.router.route(prompt)
+        priority = Priority.parse(priority)
+        replica, reason = self.router.route(prompt, priority)
         handle = replica.model.submit(
             prompt, sampling, deadline_s=deadline_s,
-            speculation=speculation, transport=transport,
+            speculation=speculation, transport=transport, priority=priority,
         )
         handle.trace.event("route", replica=replica.id, reason=reason)
         self.fleet_flight.record_event(
@@ -637,8 +703,32 @@ class Fleet:
                     self._replace(rep, reason="drain_timeout", retire=True)
             elif rep.state == ReplicaState.DEAD and self.auto_replace:
                 self._replace(rep, reason="failover")
+        self._observe_autoscale()
         self._expire_pending(now)
         self._drain_pending()
+
+    def _observe_autoscale(self) -> None:
+        """Feed the autoscale advisor one fleet-wide observation: the
+        fraction of eligible replicas that are saturated (their
+        overload controller would refuse standard-priority work, or
+        their ladder is degraded) and the mean limiter utilization. No
+        eligible replicas at all counts as full saturation — a brownout
+        is the strongest possible want-more signal."""
+        eligible = [r for r in self._replicas_snapshot() if r.eligible()]
+        if not eligible:
+            self.autoscale.observe(1.0, 1.0)
+            return
+        saturated = 0
+        util = 0.0
+        for r in eligible:
+            try:
+                ctl = r.scheduler.overload
+                util += ctl.limiter.utilization()
+                if not ctl.would_admit(Priority.STANDARD) or ctl.ladder.level >= 1:
+                    saturated += 1
+            except Exception:
+                pass  # a dying replica's telemetry must not kill check()
+        self.autoscale.observe(saturated / len(eligible), util / len(eligible))
 
     def _sweep_retiring(self) -> None:
         """Tear down retired replicas once their residents are gone
@@ -990,14 +1080,43 @@ class Fleet:
         out["recent_events"] = self.fleet_flight.snapshot(32)
         return out
 
+    def autoscale_report(self) -> Dict:
+        """The ``GET /v2/fleet/autoscale`` payload: the want-more /
+        want-fewer signal from sustained limiter state, with the
+        per-replica overload evidence behind it."""
+        reps = self._replicas_snapshot()
+        out = self.autoscale.report(len(reps))
+        replicas = {}
+        for r in reps:
+            try:
+                ctl = r.scheduler.overload
+                replicas[r.id] = {
+                    "state": r.state,
+                    "eligible": r.eligible(),
+                    "limiter": ctl.limiter.snapshot(),
+                    "degrade_level": ctl.ladder.level,
+                }
+            except Exception:
+                replicas[r.id] = {"state": r.state, "eligible": False}
+        out["replicas"] = replicas
+        out["fleet_sheds"] = self.fleet_stats.snapshot()["sheds"]
+        return out
+
     def prom_fleet(self) -> Dict:
         """The ``fleets=`` input to obs.prom.render_prometheus: replica
-        states, lifecycle counters, and router decisions."""
+        states, lifecycle counters, router decisions, and the
+        autoscale signal."""
         fs = self.fleet_stats.snapshot()
+        with self._lock:
+            n = len(self.replicas)
         return {
             "states": self.states(),
             "failovers_total": fs["failovers"],
             "migrated_streams_total": fs["migrated_streams"],
             "replaced_total": fs["replaced"],
             "router_decisions": fs["router_decisions"],
+            "autoscale": {
+                "signal": self.autoscale.signal,
+                "want_replicas": self.autoscale.want_replicas(n),
+            },
         }

@@ -109,6 +109,16 @@ class GenerationModel:
     def goodput(self):
         return self.scheduler.goodput
 
+    @property
+    def overload(self):
+        """The overload controller: priority-aware admission, the AIMD
+        concurrency limiter, and the degradation ladder
+        (GET /v2/overload)."""
+        return self.scheduler.overload
+
+    def overload_report(self):
+        return self.scheduler.overload.report()
+
     def cache_report(self):
         return self.scheduler.cache_report()
 
@@ -125,6 +135,9 @@ class GenerationModel:
             "watchdog_trips": rs.watchdog_trips,
             "engine_failures": rs.engine_failures,
             "slo_breaching": self.scheduler.slo.breaching(),
+            # degraded-but-up: a nonzero ladder level explains reduced
+            # QoS in the rationale without flipping readiness
+            "degrade_level": self.scheduler.overload.ladder.level,
         }
 
     # --------------------------------------------------------------- run
@@ -135,10 +148,11 @@ class GenerationModel:
         deadline_s: Optional[float] = None,
         speculation: Optional[SpeculationConfig] = None,
         transport: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> GenerationHandle:
         return self.scheduler.submit(
             prompt, sampling, deadline_s=deadline_s, speculation=speculation,
-            transport=transport,
+            transport=transport, priority=priority,
         )
 
     def generate(

@@ -25,7 +25,7 @@ import numpy as np
 
 from .batcher import DynamicBatcher, make_batcher
 from .model import InferenceModel
-from .resilience import ResilienceError, grpc_code
+from .resilience import ResilienceError, grpc_code, retry_after_s
 
 try:
     from . import kserve_v2_pb2 as pb
@@ -267,7 +267,24 @@ class GrpcInferenceServer:
     def _model_ready(self, request, context):
         return pb.ModelReadyResponse(ready=self._is_model_ready(request.name))
 
-    def _abort(self, context, code, msg):
+    def _abort(self, context, code, msg, err=None):
+        """Abort the RPC; an overload rejection (``err`` carrying
+        retry_after_s — serving/overload.py) additionally ships
+        retry-info as trailing metadata (``retry-after-ms``, plus the
+        structured reason/priority) so clients back off intelligently
+        on RESOURCE_EXHAUSTED."""
+        if err is not None:
+            ra = retry_after_s(err)
+            if ra is not None:
+                md = [("retry-after-ms", str(int(ra * 1000)))]
+                for field in ("reason", "priority"):
+                    v = getattr(err, field, None)
+                    if v is not None:
+                        md.append((f"overload-{field}", str(v)))
+                try:
+                    context.set_trailing_metadata(tuple(md))
+                except Exception:
+                    pass  # metadata must never mask the typed status
         context.abort(code, msg)
 
     def _model_metadata(self, request, context):
@@ -333,11 +350,20 @@ class GrpcInferenceServer:
                     raise ValueError(f"missing input {meta.name}")
                 arrays.append(a)
             # propagate the client's gRPC deadline into the batcher so a
-            # request that expires while queued never reaches the device
+            # request that expires while queued never reaches the device;
+            # the parameters map may carry the priority class
+            pp = request.parameters.get("priority") if request.parameters else None
+            priority = None
+            if pp is not None:
+                kind = pp.WhichOneof("parameter_choice")
+                priority = getattr(pp, kind) if kind else None
             remaining = context.time_remaining()
-            fut = batcher.submit(arrays, deadline_s=remaining, transport="grpc")
+            fut = batcher.submit(
+                arrays, deadline_s=remaining, transport="grpc",
+                priority=priority,
+            )
         except ResilienceError as e:  # backpressure/deadline/breaker/drain
-            self._abort(context, grpc_code(e, grpc), str(e))
+            self._abort(context, grpc_code(e, grpc), str(e), err=e)
         except RuntimeError as e:  # batcher stopped
             self._abort(context, grpc.StatusCode.UNAVAILABLE, str(e))
         except Exception as e:
@@ -346,7 +372,7 @@ class GrpcInferenceServer:
             # a client deadline owns the wait; 60s only for budget-less calls
             outs = fut.result(timeout=remaining if remaining is not None else 60.0)
         except ResilienceError as e:
-            self._abort(context, grpc_code(e, grpc), str(e))
+            self._abort(context, grpc_code(e, grpc), str(e), err=e)
         except (TimeoutError, futures.TimeoutError):
             # futures.TimeoutError only aliases the builtin from 3.11 on;
             # cancel so the abandoned request never occupies device batch
@@ -406,10 +432,11 @@ class GrpcInferenceServer:
             sampling = gen.sampling_from(params)
             remaining = context.time_remaining()
             handle = gen.submit(
-                prompt, sampling, deadline_s=remaining, transport="grpc"
+                prompt, sampling, deadline_s=remaining, transport="grpc",
+                priority=params.get("priority"),
             )
         except ResilienceError as e:
-            self._abort(context, grpc_code(e, grpc), str(e))
+            self._abort(context, grpc_code(e, grpc), str(e), err=e)
         except Exception as e:
             self._abort(context, grpc.StatusCode.INVALID_ARGUMENT, str(e))
         wait = remaining if remaining is not None else 300.0
@@ -434,7 +461,7 @@ class GrpcInferenceServer:
             yield final
         except ResilienceError as e:
             handle.cancel()
-            self._abort(context, grpc_code(e, grpc), str(e))
+            self._abort(context, grpc_code(e, grpc), str(e), err=e)
         except Exception as e:
             handle.cancel()
             self._abort(context, grpc.StatusCode.INTERNAL, str(e))

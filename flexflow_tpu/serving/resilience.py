@@ -44,6 +44,66 @@ class QueueFullError(ResilienceError):
     HTTP 503 / gRPC RESOURCE_EXHAUSTED."""
 
 
+class OverloadedError(QueueFullError):
+    """Priority-aware overload rejection (serving/overload.py): the
+    admission layer refused this request BEFORE it consumed queue or
+    device capacity. Subclasses :class:`QueueFullError` so every
+    pre-existing backpressure handler (HTTP 503, gRPC
+    RESOURCE_EXHAUSTED, retry loops catching QueueFullError) keeps
+    working; adds the structured fields clients need to back off
+    intelligently:
+
+      reason         why load was refused: "queue_full" (bounded queue,
+                     possibly after a priority-ordered shed), "limiter"
+                     (AdaptiveLimiter throttled admission before the
+                     queue filled), "infeasible" (predicted TTFT already
+                     exceeds the deadline), or "degraded" (the
+                     degradation ladder is shedding this priority class)
+      priority       the refused request's priority class
+      retry_after_s  server-suggested backoff; rendered as the HTTP
+                     ``Retry-After`` header and the gRPC
+                     ``retry-after-ms`` trailing metadata
+    """
+
+    def __init__(
+        self,
+        msg: str,
+        *,
+        reason: str = "queue_full",
+        priority: "str | None" = None,
+        retry_after_s: "float | None" = None,
+    ):
+        super().__init__(msg)
+        self.reason = reason
+        self.priority = priority
+        self.retry_after_s = retry_after_s
+
+
+class InfeasibleError(OverloadedError):
+    """Roofline-based infeasibility fast-fail: the request's predicted
+    TTFT (PR 7 serving roofline x current queue) already exceeds its
+    deadline, so admitting it could only burn capacity on work that is
+    guaranteed to expire. Counted separately from sheds
+    (``rejected_infeasible``)."""
+
+    def __init__(self, msg: str, *, priority=None, retry_after_s=None,
+                 predicted_ttft_s: "float | None" = None):
+        super().__init__(msg, reason="infeasible", priority=priority,
+                         retry_after_s=retry_after_s)
+        self.predicted_ttft_s = predicted_ttft_s
+
+
+def retry_after_s(err: BaseException) -> "float | None":
+    """The server-suggested backoff riding a typed rejection (None when
+    the error carries none) — the single helper both transports use to
+    render ``Retry-After`` / ``retry-after-ms``."""
+    v = getattr(err, "retry_after_s", None)
+    try:
+        return None if v is None else max(0.0, float(v))
+    except (TypeError, ValueError):
+        return None
+
+
 class DeadlineExceededError(ResilienceError):
     """The request's deadline expired before (or while) it could be
     dispatched. HTTP 504 / gRPC DEADLINE_EXCEEDED."""

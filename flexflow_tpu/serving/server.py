@@ -56,12 +56,25 @@ API. This server implements the same surface directly (stdlib only):
                                               debug endpoints
   GET  /v2/slo                             -> per-model SLO objectives
                                               with fast/slow burn rates
+  GET  /v2/overload[?model=M]              -> overload control state per
+                                              generation unit: adaptive
+                                              concurrency limiter,
+                                              degrade-ladder level +
+                                              history, pressure, and the
+                                              per-reason / per-priority
+                                              rejection split
   GET  /v2/fleet                           -> fleet serving tier state:
                                               replica lifecycle states,
                                               residency, router score
                                               inputs + decisions, and
                                               recent failover / drain /
                                               replace events
+  GET  /v2/fleet/autoscale                 -> want-more / want-fewer
+                                              replica signal derived
+                                              from sustained limiter
+                                              saturation across the
+                                              fleet (ROADMAP item 3's
+                                              autoscaling remainder)
   GET  /v2/models/{name}                   -> model metadata
   GET  /v2/models/{name}/ready             -> per-model readiness
   POST /v2/models/{name}/infer             -> run inference
@@ -83,6 +96,13 @@ rejected with 504 before they reach the device.
 
 Status mapping for resilience rejections: queue full / circuit open /
 draining -> 503, expired deadline -> 504, backend death -> 500.
+Overload rejections (serving/overload.py) are 503s that additionally
+carry a ``Retry-After`` header and a structured body (``reason`` =
+queue_full / limiter / infeasible / degraded, ``priority``,
+``retry_after_s``). A request's priority class rides the generate
+body's ``"priority"`` field, the infer request's
+``{"parameters": {"priority": ...}}``, or the ``X-Request-Priority``
+header.
 """
 from __future__ import annotations
 
@@ -95,11 +115,33 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+import math
+
 from ..obs import GLOBAL_LEDGER, GLOBAL_PROGRAMS, render_prometheus
 from ..runtime import faults
 from .batcher import DynamicBatcher, make_batcher
 from .model import InferenceModel
-from .resilience import ResilienceError, http_status
+from .resilience import ResilienceError, http_status, retry_after_s
+
+
+def _reject_payload(e: ResilienceError) -> dict:
+    """Error body for a typed rejection; OverloadedError additionally
+    carries the structured reason / priority / retry_after_s fields."""
+    payload = {"error": str(e), "type": type(e).__name__}
+    for field in ("reason", "priority", "retry_after_s", "predicted_ttft_s"):
+        v = getattr(e, field, None)
+        if v is not None:
+            payload[field] = v
+    return payload
+
+
+def _reject_headers(e: ResilienceError) -> "dict | None":
+    """``Retry-After`` for overload rejections (whole seconds, >= 1,
+    per RFC 9110)."""
+    ra = retry_after_s(e)
+    if ra is None:
+        return None
+    return {"Retry-After": str(max(1, int(math.ceil(ra))))}
 
 _V2_DTYPES = {
     "FP32": np.float32, "FP64": np.float64, "FP16": np.float16,
@@ -437,6 +479,21 @@ class InferenceServer:
             }
         }
 
+    def overload_report(self, model: Optional[str] = None) -> Dict:
+        """GET /v2/overload: per generation unit (one entry per fleet
+        replica), the overload controller's state — limiter, ladder
+        level + history, pressure, and the per-reason / per-priority
+        rejection split."""
+        out: Dict = {"models": {}}
+        for label, unit in self._generation_units():
+            if not self._unit_matches(label, model):
+                continue
+            try:
+                out["models"][label] = unit.overload.report()
+            except AttributeError:
+                continue  # non-generation unit
+        return out
+
     def fleet_report(self) -> Dict:
         """GET /v2/fleet: per-fleet replica states, residency, router
         score inputs + decisions, and recent lifecycle events."""
@@ -448,6 +505,18 @@ class InferenceServer:
             }
         }
 
+    def autoscale_report(self) -> Dict:
+        """GET /v2/fleet/autoscale: per-fleet want-more/want-fewer
+        replica signal derived from sustained limiter state (the
+        ROADMAP item 3 autoscaling remainder)."""
+        return {
+            "models": {
+                name: g.autoscale_report()
+                for name, g in sorted(self.generators.items())
+                if hasattr(g, "autoscale_report")
+            }
+        }
+
     # ------------------------------------------------------------ control
     def start(self):
         server = self
@@ -456,11 +525,13 @@ class InferenceServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _json(self, code: int, payload: dict):
+            def _json(self, code: int, payload: dict, headers=None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -551,8 +622,14 @@ class InferenceServer:
                     ))
                 if path == "/v2/slo":
                     return self._json(200, server.slo_report())
+                if path == "/v2/overload":
+                    return self._json(200, server.overload_report(
+                        model=(query.get("model") or [None])[0]
+                    ))
                 if path == "/v2/fleet":
                     return self._json(200, server.fleet_report())
+                if path == "/v2/fleet/autoscale":
+                    return self._json(200, server.autoscale_report())
                 if path == "/v2/models":
                     return self._json(
                         200,
@@ -597,12 +674,21 @@ class InferenceServer:
                     )
                     deadline_s = None if timeout_ms is None else float(timeout_ms) / 1000.0
                     speculation = gen.speculation_from(req)
+                    # priority class: body field first, then the
+                    # X-Request-Priority header (absent -> standard)
+                    priority = req.get(
+                        "priority", self.headers.get("X-Request-Priority")
+                    )
                     handle = gen.submit(
                         prompt, sampling, deadline_s=deadline_s,
                         speculation=speculation, transport="http",
+                        priority=priority,
                     )
                 except ResilienceError as e:
-                    return self._json(http_status(e), {"error": str(e)})
+                    return self._json(
+                        http_status(e), _reject_payload(e),
+                        headers=_reject_headers(e),
+                    )
                 except Exception as e:
                     return self._json(400, {"error": str(e)})
 
@@ -610,7 +696,7 @@ class InferenceServer:
                     """Failed generations ship their postmortem: the
                     request's trace, and (quarantine/engine-failure) the
                     flight-recorder snapshot riding the exception."""
-                    payload = {"error": str(e), "type": type(e).__name__}
+                    payload = _reject_payload(e)
                     tr = handle.trace_dict()
                     if tr:
                         payload["trace"] = tr
@@ -624,7 +710,10 @@ class InferenceServer:
                     try:
                         tokens = handle.result(timeout=wait)
                     except ResilienceError as e:
-                        return self._json(http_status(e), error_payload(e))
+                        return self._json(
+                            http_status(e), error_payload(e),
+                            headers=_reject_headers(e),
+                        )
                     except (TimeoutError, _FuturesTimeout):
                         handle.cancel()
                         return self._json(504, {"error": "generation timed out"})
@@ -690,9 +779,18 @@ class InferenceServer:
                             raise ValueError(f"missing input {meta.name}")
                         dt = _V2_DTYPES.get(t.get("datatype", "FP32"), np.float32)
                         arrays.append(np.asarray(t["data"], dtype=dt).reshape(t["shape"]))
-                    fut = batcher.submit(arrays, deadline_s=deadline_s, transport="http")
+                    priority = (req.get("parameters") or {}).get(
+                        "priority", self.headers.get("X-Request-Priority")
+                    )
+                    fut = batcher.submit(
+                        arrays, deadline_s=deadline_s, transport="http",
+                        priority=priority,
+                    )
                 except ResilienceError as e:  # backpressure/deadline/breaker/drain
-                    return self._json(http_status(e), {"error": str(e)})
+                    return self._json(
+                        http_status(e), _reject_payload(e),
+                        headers=_reject_headers(e),
+                    )
                 except RuntimeError as e:  # batcher stopped: server-side
                     return self._json(500, {"error": str(e)})
                 except Exception as e:
@@ -702,7 +800,10 @@ class InferenceServer:
                     # only the default for budget-less requests
                     outs = fut.result(timeout=deadline_s if deadline_s is not None else 60.0)
                 except ResilienceError as e:
-                    return self._json(http_status(e), {"error": str(e)})
+                    return self._json(
+                        http_status(e), _reject_payload(e),
+                        headers=_reject_headers(e),
+                    )
                 except (TimeoutError, _FuturesTimeout):
                     # futures.TimeoutError only aliases the builtin from
                     # 3.11 on; cancel so the abandoned request never

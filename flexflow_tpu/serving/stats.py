@@ -151,6 +151,14 @@ class ServingStats:
         w.record(seconds)
         h.observe(seconds)
 
+    def window_p95(self, name: str) -> float:
+        """One named window's rolling p95 (0.0 before any observation)
+        — the AdaptiveLimiter's queue-time / TTFT pressure inputs,
+        without snapshotting every window per control tick."""
+        with self._lock:
+            w = self._windows.get(name)
+        return w.snapshot()["p95_s"] if w is not None else 0.0
+
     def window_snapshots(self) -> Dict[str, Dict]:
         with self._lock:
             windows = dict(self._windows)
@@ -305,6 +313,9 @@ class FleetStats:
                        signal or operator call
       spawn_failures   replacement spawns that failed (engine factory or
                        warmup error; retried on the next check)
+      sheds            fleet-wide sheds: requests refused because EVERY
+                       eligible replica was saturated (the router's
+                       per-replica spill had nowhere left to go)
 
     Router decisions are counted by reason ("affinity", "least_loaded",
     "only_candidate", "no_candidate") — the
@@ -315,7 +326,10 @@ class FleetStats:
     assert counts.
     """
 
-    FIELDS = ("failovers", "migrated_streams", "replaced", "drains", "spawn_failures")
+    FIELDS = (
+        "failovers", "migrated_streams", "replaced", "drains",
+        "spawn_failures", "sheds",
+    )
 
     def __init__(self):
         self._lock = threading.Lock()

@@ -244,6 +244,22 @@ def _golden_stats():
     s.add_gauge("prefix_cache_host_bytes", lambda: 4096)
     s.add_gauge("prefix_cache_resident_blocks", lambda: 5)
     s.add_gauge("prefix_cache_offloaded_blocks", lambda: 2)
+    # ISSUE 14 overload-control families (binary-exact values); the
+    # per-reason/per-priority rejection split joins requests_total as
+    # dynamic counters like drafter_errors above
+    s.incr("rejected_limiter")
+    s.incr("rejected_best_effort")
+    s.add_gauge("overload_limit", lambda: 8)
+    s.add_gauge("overload_inflight", lambda: 6)
+    s.add_gauge("overload_throttled_total", lambda: 3)
+    s.add_gauge("overload_limit_cuts_total", lambda: 2)
+    s.add_gauge("overload_sheds_total", lambda: 1)
+    s.add_gauge("overload_infeasible_total", lambda: 1)
+    s.add_gauge("overload_queue_depth_interactive", lambda: 1)
+    s.add_gauge("overload_queue_depth_standard", lambda: 2)
+    s.add_gauge("overload_queue_depth_best_effort", lambda: 4)
+    s.add_gauge("degrade_level", lambda: 2)
+    s.add_gauge("degrade_transitions_total", lambda: 3)
     # ISSUE 12 step-anatomy families (binary-exact values)
     s.add_gauge("step_device_bubble_ratio", lambda: 0.75)
     s.add_gauge("step_host_bound", lambda: 1)
@@ -305,7 +321,8 @@ _GOLDEN_FLEET = {
     "failovers_total": 1,
     "migrated_streams_total": 3,
     "replaced_total": 1,
-    "router_decisions": {"affinity": 2, "least_loaded": 5},
+    "router_decisions": {"affinity": 2, "least_loaded": 5, "spill": 1},
+    "autoscale": {"signal": 1, "want_replicas": 3},
 }
 
 

@@ -233,9 +233,11 @@ def run_stream(engine, prompts, sampling, speculation=None):
 
 
 def check_no_self_healing(report, schedulers, engines) -> bool:
-    """Fault-free runs must never exercise the recovery path: a nonzero
-    count here means the supervisor or watchdog misfired under plain
-    load. Adds the counters to ``report``; returns ok."""
+    """Fault-free runs must never exercise the recovery path OR the
+    overload machinery: a nonzero count here means the supervisor /
+    watchdog misfired under plain load, or the limiter / shed /
+    degrade ladder acted off the pressure path (ISSUE 14's inertness
+    gate). Adds the counters to ``report``; returns ok."""
     restarts = sum(e.resets for e in engines)
     quarantined = sum(s.recovery_stats.quarantined for s in schedulers)
     trips = sum(s.recovery_stats.watchdog_trips for s in schedulers)
@@ -244,6 +246,17 @@ def check_no_self_healing(report, schedulers, engines) -> bool:
     report["quarantined"] = quarantined
     report["watchdog_trips"] = trips
     report["supervisor_step_retries"] = retries
+    overload = {}
+    for s in schedulers:
+        for k, v in s.overload.activations().items():
+            overload[k] = overload.get(k, 0) + v
+    report["overload_activations"] = overload
+    if any(overload.values()):
+        print(
+            f"FAIL: fault-free run activated overload control: {overload}",
+            file=sys.stderr,
+        )
+        return False
     if restarts or quarantined or trips or retries:
         print(
             f"FAIL: fault-free run exercised self-healing: "

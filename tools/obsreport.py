@@ -32,6 +32,12 @@ Against a live server (serving/server.py):
       and the calibration-drift alarms with blame — the "is the
       simulator lying?" answer.
 
+  python tools/obsreport.py --url ... overload
+      Overload-control view (GET /v2/overload): adaptive-limiter state,
+      degrade-ladder level + transition history, the per-reason /
+      per-priority shed table, and the fleet autoscale signal — the
+      "why is load being refused?" answer.
+
   python tools/obsreport.py --url ... anatomy [--capture K]
       [--anatomy-out anatomy.json]
       Step-anatomy view (GET /v2/debug/anatomy): per-kind phase
@@ -292,6 +298,50 @@ def show_anatomy(base: str, capture=None, out: str = "") -> int:
             json.dump(payload, f, indent=2)
         print(f"wrote anatomy report + two-lane timeline(s) to {out} "
               f"— open a 'trace' block in chrome://tracing")
+    return 0
+
+
+def show_overload(base: str) -> int:
+    """Overload-control view (GET /v2/overload): limiter state, ladder
+    level + transition history, and the per-reason / per-priority shed
+    table — the "why is load being refused?" answer."""
+    payload = _get_json(f"{base}/v2/overload")
+    for name, rep in sorted(payload.get("models", {}).items()):
+        lim = rep["limiter"]
+        lad = rep["ladder"]
+        print(f"model {name!r}: degrade_level={lad['level']} "
+              f"(max seen {lad['max_level_seen']}, "
+              f"{lad['transitions_total']} transition(s))  "
+              f"pressure={rep['pressure']:.2f}")
+        print(f"    limiter: limit={lim['limit']:.0f} "
+              f"[{lim['min_limit']:.0f}..{lim['max_limit']:.0f}] "
+              f"inflight={lim['inflight']} "
+              f"util={lim['utilization']:.2f} last={lim['last_decision']}")
+        print(f"    counters: throttled={lim['throttled_total']} "
+              f"cuts={lim['cuts_total']} raises={lim['raises_total']}  "
+              f"retry_after={rep['retry_after_s']:.1f}s")
+        rej = rep.get("rejections", {})
+        by_r, by_p = rej.get("by_reason", {}), rej.get("by_priority", {})
+        if by_r or by_p:
+            print("    refused: "
+                  + "  ".join(f"{k}={v}" for k, v in sorted(by_r.items()))
+                  + "   by class: "
+                  + "  ".join(f"{k}={v}" for k, v in sorted(by_p.items())))
+        else:
+            print("    refused: (none)")
+        hist = lad.get("history", [])
+        if hist:
+            print("    ladder history:")
+            for h in hist[-8:]:
+                print(f"      t={h['t']:.2f}s  {h['from']} -> {h['to']} "
+                      f"(pressure {h['pressure']:.2f})")
+    auto = _get_json(f"{base}/v2/fleet/autoscale").get("models", {})
+    for name, rep in sorted(auto.items()):
+        print(f"fleet {name!r}: autoscale signal={rep['signal']:+d} "
+              f"want_replicas={rep['want_replicas']} "
+              f"(current {rep['current_replicas']}, "
+              f"sustained {rep['sustained_s']:.1f}s, "
+              f"fleet_sheds={rep.get('fleet_sheds', 0)})")
     return 0
 
 
@@ -671,12 +721,14 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("command", nargs="?", default="summary",
-                    choices=("summary", "cache", "slo", "predict", "anatomy"),
+                    choices=("summary", "cache", "slo", "predict", "anatomy",
+                             "overload"),
                     help="view: summary (default), cache (block "
                          "residency), slo (burn rates), predict "
                          "(cost-model truth: error table + drift alarms), "
                          "anatomy (step phases, device bubble, overlap "
-                         "headroom)")
+                         "headroom), overload (limiter state, ladder "
+                         "history, shed table, autoscale signal)")
     ap.add_argument("--url", default="", help="base URL of a running server")
     ap.add_argument("--request", type=int, default=None,
                     help="print one request's trace waterfall")
@@ -708,6 +760,8 @@ def main() -> int:
         return show_predictions(base)
     if args.command == "anatomy":
         return show_anatomy(base, capture=args.capture, out=args.anatomy_out)
+    if args.command == "overload":
+        return show_overload(base)
     return summarize(base)
 
 
