@@ -51,6 +51,7 @@ from .cache import BlockAllocator, CacheConfig, KVCache
 from .decoder import DecoderParams, forward_full, init_decoder_params
 from .engine import GenerationEngine, SamplingParams
 from .prefix import PrefixCache, PrefixEntry
+from .sharding import ServingLayout
 from .recovery import (
     EngineFailedError,
     EngineSupervisor,

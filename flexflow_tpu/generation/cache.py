@@ -82,20 +82,32 @@ class CacheConfig:
         head_dim: int,
         block_size: int = 16,
         dtype: DataType = DataType.FLOAT,
+        kv_shards: int = 1,
     ) -> "CacheConfig":
-        """Size the cache against a memory budget:
+        """Size the cache against a PER-DEVICE HBM budget:
 
-            num_blocks = budget // (2 * L * block_size * H * D * dtype_bytes)
+            num_blocks = budget * kv_shards
+                         // (2 * L * block_size * H * D * dtype_bytes)
 
-        (the README's cache-budget sizing formula). Raises if the budget
-        cannot hold even scratch + one usable block.
+        (the README's cache-budget sizing formula). ``kv_shards`` is the
+        serving mesh's tensor-parallel degree: the cache shards along
+        the head axis (generation/sharding.py), so each device holds
+        ``H / kv_shards`` heads of every block and the SAME byte budget
+        per chip buys ``kv_shards`` x the block count — the whole point
+        of sharded serving. Raises when the heads don't divide across
+        the shards, or when the budget cannot hold even scratch + one
+        usable block.
         """
+        from .sharding import validate_kv_shards
+
+        validate_kv_shards(num_heads, kv_shards)
         per_block = 2 * num_layers * block_size * num_heads * head_dim * dtype.size_bytes
-        num_blocks = budget_bytes // per_block
+        num_blocks = budget_bytes * kv_shards // per_block
         if num_blocks < 2:
             raise ValueError(
-                f"cache budget {budget_bytes}B holds {num_blocks} blocks of "
-                f"{per_block}B; need >= 2 (scratch + one usable)"
+                f"cache budget {budget_bytes}B x {kv_shards} shard(s) holds "
+                f"{num_blocks} blocks of {per_block}B; need >= 2 "
+                f"(scratch + one usable)"
             )
         return cls(
             num_layers=num_layers,
@@ -106,19 +118,70 @@ class CacheConfig:
             dtype=dtype,
         )
 
+    @classmethod
+    def for_slots(
+        cls,
+        num_layers: int,
+        num_heads: int,
+        head_dim: int,
+        max_seq_len: int,
+        max_batch_slots: int,
+        block_size: int = 16,
+        dtype: DataType = DataType.FLOAT,
+        expected_prefix_sharing: float = 0.0,
+    ) -> "CacheConfig":
+        """Worst-case slot sizing with the sharing-aware discount
+        (ROADMAP item 2): the default bound gives every slot room to
+        reach ``max_seq_len``, but a fleet of templated traffic shares
+        long prompt prefixes through the radix cache
+        (generation/prefix.py) and needs far fewer private blocks per
+        slot. ``expected_prefix_sharing`` in [0, 1) discounts the
+        aggregate bound by the fraction of cache positions expected to
+        be shared — 0.5 on a two-template workload roughly halves the
+        reservation — floored at one slot's full bound plus one block
+        per remaining slot, so a single unshared stream can always run
+        to ``max_seq_len`` and every slot can hold at least its COW
+        boundary block.
+        """
+        if not 0.0 <= expected_prefix_sharing < 1.0:
+            raise ValueError(
+                f"expected_prefix_sharing must be in [0, 1), got "
+                f"{expected_prefix_sharing}"
+            )
+        per_seq = -(-max_seq_len // block_size)
+        worst = per_seq * max_batch_slots
+        discounted = int(-(-worst * (1.0 - expected_prefix_sharing) // 1))
+        floor = per_seq + max(0, max_batch_slots - 1)
+        return cls(
+            num_layers=num_layers,
+            num_heads=num_heads,
+            head_dim=head_dim,
+            num_blocks=1 + max(floor, discounted),
+            block_size=block_size,
+            dtype=dtype,
+        )
+
 
 class KVCache:
     """Device storage: ``k``/``v`` of shape [L, num_blocks, block_size,
     H, D]. Functional updates — jitted steps take the arrays and return
-    replacements; this object just holds the current ones."""
+    replacements; this object just holds the current ones.
 
-    def __init__(self, config: CacheConfig, k: jax.Array, v: jax.Array):
+    ``sharding`` (a NamedSharding over the serving mesh, heads sharded —
+    generation/sharding.py) commits the arrays across the mesh at
+    creation AND at every :meth:`reset`: crash recovery must hand the
+    jits a cache with the exact sharding they were compiled for, or the
+    first replay step would silently recompile every program."""
+
+    def __init__(self, config: CacheConfig, k: jax.Array, v: jax.Array,
+                 sharding=None):
         self.config = config
         self.k = k
         self.v = v
+        self.sharding = sharding
 
     @classmethod
-    def create(cls, config: CacheConfig) -> "KVCache":
+    def create(cls, config: CacheConfig, sharding=None) -> "KVCache":
         shape = (
             config.num_layers,
             config.num_blocks,
@@ -127,7 +190,9 @@ class KVCache:
             config.head_dim,
         )
         zeros = jnp.zeros(shape, config.dtype.jnp)
-        return cls(config, zeros, zeros)
+        if sharding is not None:
+            zeros = jax.device_put(zeros, sharding)
+        return cls(config, zeros, zeros, sharding=sharding)
 
     def update(self, k: jax.Array, v: jax.Array) -> None:
         self.k = k
@@ -138,6 +203,8 @@ class KVCache:
         rewritten by recompute-replay prefills, and rezeroing also clears
         any NaN a poisoned batch may have written."""
         zeros = jnp.zeros(self.k.shape, self.config.dtype.jnp)
+        if self.sharding is not None:
+            zeros = jax.device_put(zeros, self.sharding)
         self.k = zeros
         self.v = zeros
 

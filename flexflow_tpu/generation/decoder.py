@@ -167,6 +167,7 @@ def decode_step(
     block_tables: jax.Array,
     context_lens: jax.Array,
     backend: str = "cpu",
+    mesh=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for every batch slot.
 
@@ -194,7 +195,8 @@ def decode_step(
         cache_k = cache_k.at[li].set(flat_k.reshape(cache_k.shape[1:]))
         cache_v = cache_v.at[li].set(flat_v.reshape(cache_v.shape[1:]))
         ctx = decode_attention_core(
-            q, cache_k[li], cache_v[li], block_tables, context_lens, backend=backend
+            q, cache_k[li], cache_v[li], block_tables, context_lens,
+            backend=backend, mesh=mesh,
         )
         x = x + jnp.einsum("bhd,hde->be", ctx, layer["wo"])
         x = _ffn(layer, x)
@@ -210,6 +212,7 @@ def verify_step(
     cache_v: jax.Array,
     block_tables: jax.Array,
     backend: str = "cpu",
+    mesh=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One chunked-append (speculative verification) step for every
     batch slot.
@@ -249,7 +252,8 @@ def verify_step(
         cache_k = cache_k.at[li].set(flat_k.reshape(cache_k.shape[1:]))
         cache_v = cache_v.at[li].set(flat_v.reshape(cache_v.shape[1:]))
         ctx = append_attention_core(
-            q, cache_k[li], cache_v[li], block_tables, positions, backend=backend
+            q, cache_k[li], cache_v[li], block_tables, positions,
+            backend=backend, mesh=mesh,
         )
         x = x + jnp.einsum("bwhd,hde->bwe", ctx, layer["wo"])
         x = _ffn(layer, x)

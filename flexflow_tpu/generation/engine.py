@@ -31,6 +31,17 @@ dispatch-return) and a later consume — the scheduler's two-deep
 pipeline. Slot-constant args stage device-resident (``_stage``), and
 ``donate_cache`` aliases the decode/verify jits' KV-cache inputs to
 their outputs (in-place update; auto on accelerators).
+
+ISSUE 15 made the engine MESH-NATIVE: pass ``tp_degree`` /
+``mesh_devices`` / ``mesh`` and the decoder weights + KV cache shard
+along the head axis over a ``"model"`` mesh axis
+(generation/sharding.py), every jit is built with explicit
+out-shardings, every non-sharded input commits replicated through one
+staging path (call-stable input shardings — the retrace contract), and
+the serving TP degree is chosen by the Unity-style search + cost model
+(search/serving_strategy.py). No mesh arguments -> the legacy
+single-device paths, untouched; a 1-device mesh is bit-for-bit the
+legacy engine.
 """
 from __future__ import annotations
 
@@ -49,6 +60,7 @@ from ..runtime import faults
 from .cache import BlockAllocator, CacheConfig, KVCache, slot_mapping
 from .decoder import DecoderParams, decode_step, prefill, verify_step
 from .prefix import PrefixCache, PrefixEntry
+from .sharding import ServingLayout
 
 NEG_INF = -1e30
 
@@ -216,32 +228,89 @@ class GenerationEngine:
         prefix_cache: bool = True,
         host_cache_bytes: Optional[int] = None,
         donate_cache: Optional[bool] = None,
+        mesh=None,
+        tp_degree: Optional[int] = None,
+        mesh_devices: Optional[int] = None,
+        expected_prefix_sharing: float = 0.0,
     ):
-        self.params = params
         self.cfg = cfg
         self.max_seq_len = max_seq_len or cfg.seq_length
         self.max_batch_slots = max_batch_slots
+        # ------------------------------------------------- serving mesh
+        # Mesh-native engine (ISSUE 15): decoder weights and the KV
+        # cache shard along the head axis over a "model" mesh axis
+        # (generation/sharding.py). Three ways in:
+        #   mesh=          an explicit Mesh carrying a "model" axis
+        #   tp_degree=N    a pinned degree (serving_mesh over N devices)
+        #   mesh_devices=N devices to serve on; the TP degree is CHOSEN
+        #                  by the existing Unity-style search + cost
+        #                  model (search/serving_strategy.py)
+        # All None -> the legacy single-device engine, untouched paths.
+        # A 1-device mesh is bit-for-bit the legacy engine — the
+        # exactness anchor the multi-device gates compare against.
+        self.layout: Optional[ServingLayout] = None
+        self.serving_strategy = None
+        if mesh is not None or tp_degree is not None or mesh_devices is not None:
+            from ..search.serving_strategy import choose_serving_strategy
+
+            if mesh is not None:
+                from ..parallel.mesh import MODEL_AXIS
+
+                tp = int(mesh.shape.get(MODEL_AXIS, 1))
+                self.layout = ServingLayout.build(cfg.num_heads, tp, mesh=mesh)
+            else:
+                n_dev = mesh_devices or tp_degree
+            self.serving_strategy = choose_serving_strategy(
+                cfg,
+                mesh_devices=(
+                    self.layout.mesh.size if self.layout is not None else n_dev
+                ),
+                max_batch_slots=max_batch_slots,
+                prefill_len=self.max_seq_len,
+                pinned_tp=(
+                    self.layout.tp_degree if self.layout is not None
+                    else tp_degree
+                ),
+            )
+            if self.layout is None:
+                self.layout = ServingLayout.build(
+                    cfg.num_heads, self.serving_strategy.tp_degree
+                )
+        self.tp_degree = self.layout.tp_degree if self.layout else 1
+        self.mesh_devices = self.layout.mesh.size if self.layout else 1
+        self.params = (
+            self.layout.shard_params(params) if self.layout else params
+        )
         if cache_config is None:
             if cache_budget_bytes is not None:
+                # per-device HBM budget: the head-sharded cache holds
+                # H/tp heads of every block per chip, so the same chip
+                # budget buys tp x the blocks (ISSUE 15 satellite)
                 cache_config = CacheConfig.from_budget(
                     cache_budget_bytes,
                     num_layers=cfg.num_layers,
                     num_heads=cfg.num_heads,
                     head_dim=cfg.hidden_size // cfg.num_heads,
                     block_size=block_size,
+                    kv_shards=self.tp_degree,
                 )
             else:
-                # enough for every slot to reach max_seq_len, plus scratch
-                per_seq = -(-self.max_seq_len // block_size)
-                cache_config = CacheConfig(
+                # enough for every slot to reach max_seq_len (discounted
+                # by expected prefix sharing), plus scratch
+                cache_config = CacheConfig.for_slots(
                     num_layers=cfg.num_layers,
                     num_heads=cfg.num_heads,
                     head_dim=cfg.hidden_size // cfg.num_heads,
-                    num_blocks=1 + per_seq * max_batch_slots,
+                    max_seq_len=self.max_seq_len,
+                    max_batch_slots=max_batch_slots,
                     block_size=block_size,
+                    expected_prefix_sharing=expected_prefix_sharing,
                 )
         self.cache_config = cache_config
-        self.cache = KVCache.create(cache_config)
+        self.cache = KVCache.create(
+            cache_config,
+            sharding=self.layout.cache_sharding if self.layout else None,
+        )
         self.allocator = BlockAllocator(cache_config)
         self.max_blocks_per_seq = cache_config.blocks_for(self.max_seq_len)
         self.buckets = tuple(sorted(prompt_buckets or default_buckets(self.max_seq_len)))
@@ -262,6 +331,18 @@ class GenerationEngine:
         self.max_spec_tokens = max_spec_tokens
         self.spec_window = max_spec_tokens + 1
         self.backend = jax.default_backend()
+        # the mesh handed to the Pallas kernel dispatch (ISSUE 15): on
+        # TPU backends a tp>1 engine routes decode/append attention
+        # through the head-sharded shard_map kernel path; elsewhere the
+        # plain-XLA reference composition is partitioned by GSPMD and
+        # needs no manual mesh
+        self._kernel_mesh = (
+            self.layout.mesh
+            if self.layout is not None
+            and self.tp_degree > 1
+            and self.backend in ("tpu", "axon")
+            else None
+        )
         # retrace counters: the Python body runs only when XLA traces, so
         # these count compiles, not calls (genbench's recompile guard)
         self.trace_counts: Dict[str, int] = {}
@@ -294,9 +375,19 @@ class GenerationEngine:
         # The chip comes from the detected device kind (the calibration
         # preset table), so MFU and the truth ledger's roofline
         # predictions use real peaks instead of the generic default.
-        from ..search.calibration import chip_spec_for, detected_device_kind
+        from ..search.calibration import (
+            chip_spec_for,
+            detected_device_kind,
+            mesh_device_kind,
+        )
 
-        kind = detected_device_kind(self.backend)
+        # mesh geometry in the chip kind ("TPU v5e x4"): the aggregate
+        # spec scales peaks by the shard count, so a multi-chip engine's
+        # MFU divides by the MESH's peak FLOPs — against one chip's peak
+        # a 4-way engine would report >100% MFU (ISSUE 15 satellite)
+        kind = mesh_device_kind(
+            detected_device_kind(self.backend), self.tp_degree
+        )
         self.flops_model = ServingFlops.from_config(
             cfg, dtype=cache_config.dtype, chip=chip_spec_for(kind)
         )
@@ -330,7 +421,7 @@ class GenerationEngine:
         # returns this very object, so the steady-state decode path pays
         # one identity check instead of a fresh alloc + device transfer
         self._zero_bias = np.zeros((max_batch_slots,), np.float32)
-        self._zero_bias_dev = jnp.zeros((max_batch_slots,), jnp.float32)
+        self._zero_bias_dev = self._dev(np.zeros((max_batch_slots,), np.float32))
         # KV-cache buffer donation on the hot fixed-shape programs: the
         # decode/verify jits alias their cache inputs to their cache
         # outputs, so XLA updates the (large) cache in place instead of
@@ -350,11 +441,29 @@ class GenerationEngine:
         # step. Keyed by arg name; each entry is (host snapshot, device
         # array). Loop-thread only (like the cache refs).
         self._staged: Dict[str, Tuple[np.ndarray, jax.Array]] = {}
-        self._prefill_jit = jax.jit(self._prefill_impl)
+        # sharded jits with EXPLICIT out-shardings (ISSUE 15): cache
+        # outputs stay head-sharded across steps (no resharding between
+        # chained fixed-shape programs), tokens/ok/emit counts come back
+        # replicated so the host bookkeeping reads one copy. On the
+        # legacy (no-mesh) path the jits are built exactly as before.
         dec_donate = (3, 4) if self.donate else ()  # cache_k, cache_v
         ver_donate = (4, 5) if self.donate else ()
-        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=dec_donate)
-        self._verify_jit = jax.jit(self._verify_impl, donate_argnums=ver_donate)
+        if self.layout is None:
+            sharded = {}
+            dec_sh = ver_sh = {}
+        else:
+            repl = self.layout.replicated
+            csh = self.layout.cache_sharding
+            sharded = {"out_shardings": (repl, repl, csh, csh)}
+            dec_sh = dict(sharded)
+            ver_sh = {"out_shardings": (repl, repl, repl, csh, csh)}
+        self._prefill_jit = jax.jit(self._prefill_impl, **sharded)
+        self._decode_jit = jax.jit(
+            self._decode_impl, donate_argnums=dec_donate, **dec_sh
+        )
+        self._verify_jit = jax.jit(
+            self._verify_impl, donate_argnums=ver_donate, **ver_sh
+        )
         # cross-request prefix caching (generation/prefix.py): radix
         # index + refcounted COW blocks + host-RAM offload tier. The
         # block-level device programs below are admission-time only
@@ -364,10 +473,72 @@ class GenerationEngine:
             self.allocator, cache_config,
             enabled=prefix_cache, host_budget_bytes=host_cache_bytes,
         )
-        self._prefix_prefill_jit = jax.jit(self._prefix_prefill_impl)
-        self._copy_block_jit = jax.jit(self._copy_block_impl)
-        self._read_block_jit = jax.jit(self._read_block_impl)
-        self._write_block_jit = jax.jit(self._write_block_impl)
+        if self.layout is None:
+            blk_sh = rd_sh = {}
+        else:
+            # block-level programs over the sharded cache: COW copies and
+            # swap-in writes keep the cache sharding; a swap-out read
+            # gathers the full block to the host tier (replicated out)
+            blk_sh = {"out_shardings": (csh, csh)}
+            rd_sh = {"out_shardings": (repl, repl)}
+        self._prefix_prefill_jit = jax.jit(self._prefix_prefill_impl, **sharded)
+        self._copy_block_jit = jax.jit(self._copy_block_impl, **blk_sh)
+        self._read_block_jit = jax.jit(self._read_block_impl, **rd_sh)
+        self._write_block_jit = jax.jit(self._write_block_impl, **blk_sh)
+        self._register_strategy_predictions()
+
+    def _dev(self, x) -> jax.Array:
+        """Commit a host array onto the engine's devices. Mesh-native
+        engines pin every non-sharded jit input replicated on the mesh
+        (call-stable input shardings — a drifting placement would
+        recompile the fixed-shape programs); the legacy engine keeps the
+        plain uncommitted ``jnp.asarray``."""
+        if self.layout is not None:
+            return self.layout.put_replicated(x)
+        return jnp.asarray(x)
+
+    def _register_strategy_predictions(self) -> None:
+        """Put the chosen serving layout's predicted step times into the
+        truth ledger (keys ``serving_strategy:prefill`` / ``:decode``)
+        so drift telemetry covers the layout DECISION, not just the
+        per-step roofline: the engine's measured execute seconds pair
+        against the search's estimate on GET /v2/debug/predictions.
+        ``alarm=False`` — the strategy simulator is an analytic ranking
+        device (fwd cost of a training-shaped graph), expected to miss
+        absolute wall seconds; the pairs are for operators, the CHOICE
+        is what they grade."""
+        ch = self.serving_strategy
+        if ch is None:
+            return
+        prov = (
+            f"predict_strategy_time over TP candidates "
+            f"{[c['tp_degree'] for c in ch.candidates]} on "
+            f"{ch.device_kind}"
+        )
+        self.ledger.predict(
+            "serving_strategy:prefill", ch.prefill_s,
+            label=f"serving layout tp={ch.tp_degree} (prefill)",
+            provenance=prov, alarm=False,
+        )
+        self.ledger.predict(
+            "serving_strategy:decode", ch.decode_s,
+            label=f"serving layout tp={ch.tp_degree} (decode)",
+            provenance=prov, alarm=False,
+        )
+
+    def serving_strategy_block(self) -> Dict:
+        """The ``serving_strategy`` metadata block (engine metadata +
+        GET /v2/models/{name} + obsreport summary): mesh geometry, the
+        chosen layout, and every scored TP candidate."""
+        block: Dict = {
+            "tp_degree": self.tp_degree,
+            "mesh_devices": self.mesh_devices,
+        }
+        if self.layout is not None:
+            block["layout"] = self.layout.describe()
+        if self.serving_strategy is not None:
+            block["search"] = self.serving_strategy.describe()
+        return block
 
     # ------------------------------------------------------------ geometry
     def reset(self) -> None:
@@ -433,7 +604,7 @@ class GenerationEngine:
         })
         logits, cache_k, cache_v = decode_step(
             params, tokens, positions, cache_k, cache_v, block_tables,
-            context_lens, backend=self.backend,
+            context_lens, backend=self.backend, mesh=self._kernel_mesh,
         )
         # bias is the fault plan's per-slot NaN poison (zeros outside
         # chaos runs); applying it before the finiteness reduce makes the
@@ -469,7 +640,7 @@ class GenerationEngine:
         positions = jnp.where(offs <= n_draft[:, None], start[:, None] + offs, -1)
         logits, cache_k, cache_v = verify_step(
             params, tokens, positions, cache_k, cache_v, block_tables,
-            backend=self.backend,
+            backend=self.backend, mesh=self._kernel_mesh,
         )
         logits = logits + bias[:, None, None]
         # blame vector: finiteness over each slot's REAL window positions
@@ -507,7 +678,7 @@ class GenerationEngine:
         positions = jnp.where(offs < n_real, start + offs, -1)[None, :]
         logits, cache_k, cache_v = verify_step(
             params, tokens, positions, cache_k, cache_v, block_table[None],
-            backend=self.backend,
+            backend=self.backend, mesh=self._kernel_mesh,
         )
         last = logits[0, n_real - 1]
         ok = jnp.all(jnp.isfinite(last))  # blame: poisoned prompt
@@ -609,14 +780,14 @@ class GenerationEngine:
         table[: len(block_table)] = block_table
         token, ok, ck, cv = self._prefill_jit(
             self.params,
-            jnp.asarray(tokens),
+            self._dev(tokens),
             jnp.int32(n),
             self.cache.k,
             self.cache.v,
-            jnp.asarray(table),
+            self._dev(table),
             jnp.float32(sampling.temperature),
             jnp.int32(sampling.top_k),
-            key,
+            self._dev(key),
         )
         t_disp = time.perf_counter()
         jax.block_until_ready((token, ok, ck, cv))  # device execution done
@@ -650,6 +821,10 @@ class GenerationEngine:
                 provenance="serving roofline (ServingFlops x chip peak)",
                 alarm=self._roofline_alarm,
             )
+            if self.serving_strategy is not None:
+                # pair the measured step against the layout-search
+                # estimate too: drift telemetry covers the DECISION
+                self.ledger.measure("serving_strategy:prefill", execute_s)
         return out
 
     def _prefill_suffix(
@@ -677,15 +852,15 @@ class GenerationEngine:
         table[: len(block_table)] = block_table
         token, ok, ck, cv = self._prefix_prefill_jit(
             self.params,
-            jnp.asarray(tokens),
+            self._dev(tokens),
             jnp.int32(prefix_len),
             jnp.int32(len(suffix)),
             self.cache.k,
             self.cache.v,
-            jnp.asarray(table),
+            self._dev(table),
             jnp.float32(sampling.temperature),
             jnp.int32(sampling.top_k),
-            key,
+            self._dev(key),
         )
         t_disp = time.perf_counter()
         jax.block_until_ready((token, ok, ck, cv))  # device execution done
@@ -872,7 +1047,7 @@ class GenerationEngine:
             hk, hv = buf
             ck, cv = self._write_block_jit(
                 self.cache.k, self.cache.v, jnp.int32(dst),
-                jnp.asarray(hk), jnp.asarray(hv),
+                self._dev(hk), self._dev(hv),
             )
             self.cache.update(ck, cv)
         except Exception:
@@ -910,7 +1085,7 @@ class GenerationEngine:
             hk, hv = buf
             ck, cv = self._write_block_jit(
                 self.cache.k, self.cache.v, jnp.int32(dst),
-                jnp.asarray(hk), jnp.asarray(hv),
+                self._dev(hk), self._dev(hv),
             )
             self.cache.update(ck, cv)
         except Exception:
@@ -1004,7 +1179,7 @@ class GenerationEngine:
             and np.array_equal(cached[0], host)
         ):
             return cached[1]
-        dev = jnp.asarray(host)
+        dev = self._dev(host)
         self._staged[name] = (host.copy(), dev)
         return dev
 
@@ -1019,16 +1194,16 @@ class GenerationEngine:
         # first real block and silently corrupt the surviving stream
         tables = np.where(active[:, None], block_tables, 0).astype(np.int32)
         return (
-            jnp.asarray(safe_pos),
+            self._dev(safe_pos),
             self.cache.k,
             self.cache.v,
             self._stage("decode.tables", tables),
-            jnp.asarray(context_lens),
+            self._dev(context_lens),
             self._stage("decode.temps", temps.astype(np.float32)),
             self._stage("decode.top_ks", top_ks.astype(np.int32)),
             self._bias_arg(bias),
             self._stage("decode.seeds", seeds.astype(np.uint32)),
-            jnp.asarray(counts.astype(np.int32)),
+            self._dev(counts.astype(np.int32)),
         ), context_lens
 
     def decode(
@@ -1051,6 +1226,10 @@ class GenerationEngine:
         per-slot sampling key derives in-jit (see :func:`derive_keys`)."""
         masked = np.where(active, tokens, 0).astype(np.int32)
         masked, bias = faults.inject(faults.GENERATION_DECODE_STEP, (masked, self._zero_bias))
+        if self.tp_degree > 1:
+            # sharded step: the cross-shard psum boundary can fail or
+            # wedge like any device work — chaos plans target it here
+            faults.inject(faults.GENERATION_COLLECTIVE, ("decode", self.tp_degree))
         self.step_counts["decode"] += 1
         t0 = time.perf_counter()
         traces_before = self.trace_counts.get("decode", 0)
@@ -1058,7 +1237,7 @@ class GenerationEngine:
             positions, block_tables, active, temps, top_ks, seeds,
             counts, bias,
         )
-        out, ok, ck, cv = self._decode_jit(self.params, jnp.asarray(masked), *args)
+        out, ok, ck, cv = self._decode_jit(self.params, self._dev(masked), *args)
         t_disp = time.perf_counter()
         jax.block_until_ready((out, ok, ck, cv))  # device execution done
         t_exec = time.perf_counter()
@@ -1100,6 +1279,10 @@ class GenerationEngine:
                 provenance="serving roofline (ServingFlops x chip peak)",
                 alarm=self._roofline_alarm,
             )
+            if self.serving_strategy is not None:
+                # pair the measured step against the layout-search
+                # estimate too: drift telemetry covers the DECISION
+                self.ledger.measure("serving_strategy:decode", execute_s)
 
     def decode_async(
         self,
@@ -1137,6 +1320,8 @@ class GenerationEngine:
         masked, bias = faults.inject(
             faults.GENERATION_DECODE_STEP, (masked, self._zero_bias)
         )
+        if self.tp_degree > 1:
+            faults.inject(faults.GENERATION_COLLECTIVE, ("decode", self.tp_degree))
         self.step_counts["decode"] += 1
         t0 = time.perf_counter()
         traces_before = self.trace_counts.get("decode", 0)
@@ -1144,7 +1329,7 @@ class GenerationEngine:
             positions, block_tables, active, temps, top_ks, seeds,
             counts, bias,
         )
-        tok_arg = tokens_dev if tokens_dev is not None else jnp.asarray(masked)
+        tok_arg = tokens_dev if tokens_dev is not None else self._dev(masked)
         prev_k, prev_v = (None, None) if self.donate else (self.cache.k, self.cache.v)
         out, ok, ck, cv = self._decode_jit(self.params, tok_arg, *args)
         t_disp = time.perf_counter()
@@ -1210,7 +1395,7 @@ class GenerationEngine:
         actually poisoned this call."""
         if bias is self._zero_bias:
             return self._zero_bias_dev
-        return jnp.asarray(np.asarray(bias, np.float32))
+        return self._dev(np.asarray(bias, np.float32))
 
     def verify(
         self,
@@ -1240,6 +1425,8 @@ class GenerationEngine:
         """
         window = window_tokens.astype(np.int32)
         window, bias = faults.inject(faults.GENERATION_VERIFY, (window, self._zero_bias))
+        if self.tp_degree > 1:
+            faults.inject(faults.GENERATION_COLLECTIVE, ("verify", self.tp_degree))
         self.step_counts["verify"] += 1
         # useful verify work: per live slot, n_draft+1 window tokens;
         # window token j at position start+j attends to start+j+1 live
@@ -1254,9 +1441,9 @@ class GenerationEngine:
         traces_before = self.trace_counts.get("verify", 0)
         out, n_emitted, ok, ck, cv = self._verify_jit(
             self.params,
-            jnp.asarray(window),
-            jnp.asarray(start.astype(np.int32)),
-            jnp.asarray(n_draft.astype(np.int32)),
+            self._dev(window),
+            self._dev(start.astype(np.int32)),
+            self._dev(n_draft.astype(np.int32)),
             self.cache.k,
             self.cache.v,
             self._stage("verify.tables", block_tables.astype(np.int32)),
@@ -1264,7 +1451,7 @@ class GenerationEngine:
             self._stage("verify.top_ks", top_ks.astype(np.int32)),
             self._bias_arg(bias),
             self._stage("verify.seeds", seeds.astype(np.uint32)),
-            jnp.asarray(counts.astype(np.int32)),
+            self._dev(counts.astype(np.int32)),
         )
         t_disp = time.perf_counter()
         jax.block_until_ready((out, n_emitted, ok, ck, cv))  # execution done

@@ -479,6 +479,21 @@ class ContinuousBatchingScheduler:
             lambda: self.engine.allocator.num_total - self.engine.allocator.num_free,
         )
         self.stats.add_gauge("cache_blocks_total", lambda: self.engine.allocator.num_total)
+        # mesh-native serving (ISSUE 15): mesh geometry + the per-shard
+        # cache view — each device holds H/tp heads of every block, so
+        # the per-shard byte load is total / tp_degree
+        self.stats.add_gauge("mesh_devices", lambda: self.engine.mesh_devices)
+        self.stats.add_gauge("tp_degree", lambda: self.engine.tp_degree)
+        self.stats.add_gauge(
+            "cache_shard_bytes",
+            lambda: self.engine.cache_config.total_bytes
+            // max(1, self.engine.tp_degree),
+        )
+        self.stats.add_gauge(
+            "cache_shard_heads",
+            lambda: self.engine.cache_config.num_heads
+            // max(1, self.engine.tp_degree),
+        )
         self.stats.add_gauge(
             "cache_occupancy",
             lambda: 1.0 - self.engine.allocator.num_free / max(1, self.engine.allocator.num_total),

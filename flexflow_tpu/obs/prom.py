@@ -66,7 +66,11 @@ _HELP = {
     "cache_pressure_time_s": "Cumulative seconds spent below the free-block pressure threshold.",
     "cache_admission_waits": "Admissions that waited on cache blocks (episodes).",
     "cache_admission_wait_s": "Cumulative seconds requests sat blocked on cache blocks.",
-    "mfu": "Serving model-FLOPs utilization: useful FLOPs / device execute seconds / chip peak.",
+    "mesh_devices": "Devices in the engine's serving mesh (1 = single-device).",
+    "tp_degree": "Tensor-parallel degree: KV-head shards across the serving mesh.",
+    "cache_shard_bytes": "KV-cache bytes resident PER SHARD (total / tp_degree; each device holds H/tp heads of every block).",
+    "cache_shard_heads": "KV heads resident per shard (num_heads / tp_degree).",
+    "mfu": "Serving model-FLOPs utilization: useful FLOPs / device execute seconds / chip peak (divided by the MESH's aggregate peak on multi-chip engines).",
     "achieved_tflops": "Achieved useful TFLOP/s over cumulative device step time.",
     "model_tflops_total": "Cumulative useful model TFLOPs executed by generation steps.",
     "goodput_tokens_total": "Tokens generated across all requests (goodput denominator).",

@@ -209,6 +209,8 @@ def decode_attention_core(
     context_lens: jax.Array,
     backend: str = "tpu",
     scale: Optional[float] = None,
+    mesh=None,
+    head_axis: str = "model",
 ) -> jax.Array:
     """Decode-mode attention: one query token per sequence ([B, H, D])
     over a block-structured KV cache with position masking, so
@@ -217,20 +219,33 @@ def decode_attention_core(
     Dispatches to the Pallas paged-attention kernel on TPU backends
     (kernels/decode_attention.py) and to the XLA gather + masked softmax
     composition elsewhere (paged_decode_attention itself falls back on
-    pallas-less jax builds).
+    pallas-less jax builds). ``mesh`` with a >1 ``head_axis`` selects
+    the HEAD-SHARDED kernel path (ISSUE 15): each shard's kernel runs
+    over its local KV heads via shard_map; the reference path needs no
+    mesh plumb — GSPMD partitions the plain-XLA composition itself.
     """
     from .kernels.decode_attention import (
         on_tpu,
         paged_decode_attention,
         reference_paged_attention,
+        sharded_paged_decode_attention,
         supports_decode_shapes,
     )
 
+    tp = 1 if mesh is None else int(dict(mesh.shape).get(head_axis, 1))
     if (
         backend == "tpu"
         and on_tpu()
-        and supports_decode_shapes(q.shape[1], q.shape[2], k_cache.shape[1])
+        and q.shape[1] % max(1, tp) == 0
+        and supports_decode_shapes(
+            q.shape[1] // max(1, tp), q.shape[2], k_cache.shape[1]
+        )
     ):
+        if tp > 1:
+            return sharded_paged_decode_attention(
+                q, k_cache, v_cache, block_tables, context_lens,
+                mesh, axis=head_axis, scale=scale,
+            )
         return paged_decode_attention(
             q, k_cache, v_cache, block_tables, context_lens, scale=scale
         )
@@ -247,6 +262,8 @@ def append_attention_core(
     q_positions: jax.Array,
     backend: str = "tpu",
     scale: Optional[float] = None,
+    mesh=None,
+    head_axis: str = "model",
 ) -> jax.Array:
     """Chunked-append attention: a W-token window per sequence
     ([B, W, H, D], K/V already written) over the block-structured KV
@@ -259,22 +276,32 @@ def append_attention_core(
 
     Dispatches to the generalized Pallas paged kernel on TPU backends
     (kernels/decode_attention.py) and to the XLA gather + masked softmax
-    composition elsewhere.
+    composition elsewhere. ``mesh`` with a >1 ``head_axis`` selects the
+    head-sharded shard_map kernel path (see
+    :func:`decode_attention_core`).
     """
     from .kernels.decode_attention import (
         on_tpu,
         paged_append_attention,
         reference_paged_append_attention,
+        sharded_paged_append_attention,
         supports_append_shapes,
     )
 
+    tp = 1 if mesh is None else int(dict(mesh.shape).get(head_axis, 1))
     if (
         backend == "tpu"
         and on_tpu()
+        and q.shape[2] % max(1, tp) == 0
         and supports_append_shapes(
-            q.shape[2], q.shape[3], k_cache.shape[1], q.shape[1]
+            q.shape[2] // max(1, tp), q.shape[3], k_cache.shape[1], q.shape[1]
         )
     ):
+        if tp > 1:
+            return sharded_paged_append_attention(
+                q, k_cache, v_cache, block_tables, q_positions,
+                mesh, axis=head_axis, scale=scale,
+            )
         return paged_append_attention(
             q, k_cache, v_cache, block_tables, q_positions, scale=scale
         )

@@ -421,6 +421,92 @@ def paged_decode_attention(
     return out[:, 0]
 
 
+def sharded_paged_append_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    q_positions: jax.Array,
+    mesh,
+    axis: str = "model",
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+    kv_splits: int = 1,
+) -> jax.Array:
+    """Head-sharded paged append attention over a serving mesh (ISSUE
+    15): each shard runs the SINGLE-DEVICE Pallas kernel over its local
+    slice of KV heads — attention is embarrassingly parallel across
+    heads, so no collective runs inside the kernel at all. The one
+    cross-shard boundary lives at the attention OUTPUT projection,
+    where the decoder's head-sharded ``wo`` contraction produces
+    partial sums and GSPMD inserts the psum (the same reduction
+    ops/parallel_ops.py's ``ReductionOp`` annotates in the training
+    path). Shapes as in :func:`paged_append_attention`; ``q`` is
+    [B, W, H, D] with H sharded on ``axis``, the caches shard their head
+    dim, tables/positions are replicated, and the output keeps H
+    sharded.
+
+    ``scale`` must be passed explicitly when H is sharded — the default
+    would be computed from a LOCAL shape inside shard_map; head_dim is
+    unsharded so the usual ``d ** -0.5`` default stays correct."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    def local(q_, k_, v_, bt_, qp_):
+        return paged_append_attention(
+            q_, k_, v_, bt_, qp_, scale=scale, interpret=interpret,
+            kv_splits=kv_splits,
+        )
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(None, None, axis, None),  # q [B, W, H, D]
+            P(None, None, axis, None),  # k_cache [nb, bs, H, D]
+            P(None, None, axis, None),  # v_cache
+            P(None, None),  # block_tables (replicated)
+            P(None, None),  # q_positions (replicated)
+        ),
+        out_specs=P(None, None, axis, None),
+        check_rep=False,
+    )(q, k_cache, v_cache, block_tables, q_positions)
+
+
+def sharded_paged_decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    context_lens: jax.Array,
+    mesh,
+    axis: str = "model",
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+    kv_splits: Optional[int] = None,
+) -> jax.Array:
+    """One-token (decode) form of :func:`sharded_paged_append_attention`
+    (q [B, H, D], H sharded on ``axis``)."""
+    if kv_splits is None:
+        kv_splits = default_kv_splits(q.shape[0], block_tables.shape[1])
+    out = sharded_paged_append_attention(
+        q[:, None],
+        k_cache,
+        v_cache,
+        block_tables,
+        context_lens[:, None] - 1,
+        mesh,
+        axis=axis,
+        scale=scale,
+        interpret=interpret,
+        kv_splits=kv_splits,
+    )
+    return out[:, 0]
+
+
 def supports_decode_shapes(num_heads: int, head_dim: int, block_size: int) -> bool:
     """Shapes the TPU kernel handles without falling back: lane-multiple
     head_dim and a sublane-multiple block size."""

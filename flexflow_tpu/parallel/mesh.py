@@ -73,6 +73,24 @@ def data_parallel_mesh(num_devices: Optional[int] = None) -> Mesh:
     return build_mesh({DATA_AXIS: n}, devs[:n])
 
 
+def serving_mesh(tp_degree: int, devices: Optional[Sequence] = None) -> Mesh:
+    """The generation engine's mesh: a 1-D ``"model"`` axis over the
+    first ``tp_degree`` devices (tensor-parallel decode shards KV heads
+    on it). Unlike :func:`build_mesh`, a degree-1 mesh KEEPS the named
+    axis — the engine's PartitionSpecs always reference ``"model"``, and
+    a 1-device mesh must lower them as no-ops rather than KeyErrors (the
+    bit-for-bit single-device path)."""
+    if tp_degree < 1:
+        raise ValueError(f"tp_degree must be >= 1, got {tp_degree}")
+    if devices is None:
+        devices = jax.devices()
+    if tp_degree > len(devices):
+        raise ValueError(
+            f"serving mesh needs {tp_degree} devices, have {len(devices)}"
+        )
+    return Mesh(np.asarray(list(devices)[:tp_degree]), (MODEL_AXIS,))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

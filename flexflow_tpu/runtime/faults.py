@@ -73,6 +73,7 @@ GENERATION_DECODE_STEP = "generation.decode_step"
 GENERATION_VERIFY = "generation.verify"
 GENERATION_JOURNAL_REPLAY = "generation.journal_replay"
 GENERATION_ASYNC_READBACK = "generation.async_readback"
+GENERATION_COLLECTIVE = "generation.collective"
 GENERATION_PREFIX_LOOKUP = "generation.prefix_lookup"
 GENERATION_KV_OFFLOAD = "generation.kv_offload"
 FLEET_ROUTE = "fleet.route"
@@ -111,6 +112,14 @@ SITES = MappingProxyType({
         "before the overlap pipeline consumes an in-flight decode step "
         "(value: ('decode', n_states)); an error discards the frontier and "
         "re-runs the step sequentially under the supervisor — byte-exact"
+    ),
+    GENERATION_COLLECTIVE: (
+        "before each sharded (tp_degree > 1) decode/verify step's "
+        "cross-shard collective boundary (value: (step kind, tp_degree)); "
+        "an error or stall here models a failed/wedged ICI collective and "
+        "routes through the supervisor's retry -> restart ladder with "
+        "byte-exact journal replay (prefill failures ride the existing "
+        "generation.prefill site)"
     ),
     GENERATION_PREFIX_LOOKUP: (
         "before each radix prefix-index lookup at admission (value: prompt "

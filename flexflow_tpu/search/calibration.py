@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import re
 import time
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -75,6 +76,18 @@ def detected_device_kind(default: str = "cpu") -> str:
         )
     except Exception:
         return default
+
+
+def mesh_device_kind(kind: str, count: int) -> str:
+    """Mesh geometry as a device kind: ``"TPU v5e x4"`` — kind x chip
+    count. :func:`chip_spec_for` parses the suffix back into an
+    AGGREGATE chip spec (peaks and capacity scaled by the count), so a
+    multi-chip serving engine's MFU divides by the mesh's peak FLOPs
+    instead of one chip's — a 4-chip engine reporting against a single
+    chip would happily claim >100% MFU."""
+    if count <= 1:
+        return kind
+    return f"{kind} x{int(count)}"
 
 
 @dataclasses.dataclass
@@ -659,6 +672,24 @@ CPU_FITTED_CONTENTION = 5.0
 
 def chip_spec_for(device_kind: str) -> TPUChipSpec:
     kind = device_kind.lower()
+    # mesh geometry ("TPU v5e x4", from mesh_device_kind): resolve the
+    # per-chip spec, then scale compute/memory peaks by the chip count —
+    # the aggregate machine MFU and the serving roofline divide by.
+    # Per-link ICI numbers stay per-chip (they do not add up).
+    m = re.search(r"\s+x(\d+)$", kind)
+    if m is not None:
+        n = int(m.group(1))
+        base = chip_spec_for(device_kind[: m.start()])
+        if n <= 1:
+            return base
+        return dataclasses.replace(
+            base,
+            name=f"{base.name} x{n}",
+            bf16_flops=base.bf16_flops * n,
+            f32_flops=base.f32_flops * n,
+            hbm_bandwidth=base.hbm_bandwidth * n,
+            hbm_capacity=base.hbm_capacity * n,
+        )
     if kind == "cpu":
         return _CHIP_PRESETS["cpu"]
     for sub, spec in (

@@ -229,6 +229,9 @@ class GenerationModel:
                 "mfu": self.engine.mfu(),
                 "model_tflops_total": self.engine.total_flops() / 1e12,
             },
+            # ISSUE 15: mesh geometry + the search-chosen (or pinned)
+            # tensor-parallel serving layout with every scored candidate
+            "serving_strategy": self.engine.serving_strategy_block(),
             "slo": {
                 "objectives": [o.name for o in self.scheduler.slo.objectives],
                 "breaching": self.scheduler.slo.breaching(),

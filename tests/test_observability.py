@@ -225,6 +225,11 @@ def _golden_stats():
     s.add_gauge("cache_admission_waits", lambda: 1)
     s.add_gauge("mfu", lambda: 0.125)
     s.add_gauge("achieved_tflops", lambda: 0.5)
+    # ISSUE 15 mesh families (binary-exact values)
+    s.add_gauge("mesh_devices", lambda: 4)
+    s.add_gauge("tp_degree", lambda: 4)
+    s.add_gauge("cache_shard_bytes", lambda: 4096)
+    s.add_gauge("cache_shard_heads", lambda: 2)
     s.add_gauge("goodput_tokens_total", lambda: 8)
     s.add_gauge("goodput_ratio", lambda: 0.75)
     s.add_gauge("slo_ttft_p95_burn_fast", lambda: 2)

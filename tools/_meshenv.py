@@ -1,0 +1,39 @@
+"""Shared ``--mesh N`` bootstrap for the bench/chaos CLIs (genbench,
+chaoscheck): forcing N host devices must happen BEFORE jax initializes
+its backend — ``--xla_force_host_platform_device_count`` in XLA_FLAGS
+cannot take effect after import — so the tools re-exec themselves once
+with the flag set. One copy here; both CLIs call it first thing."""
+import os
+import sys
+
+_FLAG = "xla_force_host_platform_device_count"
+
+
+def force_host_devices_for_mesh() -> None:
+    """Re-exec with ``--xla_force_host_platform_device_count=N`` when
+    the argv asks for ``--mesh N`` and the environment's XLA_FLAGS does
+    not already force at least N host devices (an existing LOWER count
+    gets bumped, not trusted). On a real multi-chip host the forced CPU
+    count is inert — jax serves the accelerator backend."""
+    if "--mesh" not in sys.argv:
+        return
+    try:
+        n = int(sys.argv[sys.argv.index("--mesh") + 1])
+    except (IndexError, ValueError):
+        return  # argparse rejects it properly later
+    if n <= 1:
+        return
+    parts = os.environ.get("XLA_FLAGS", "").split()
+    have = 0
+    for p in parts:
+        if p.startswith(f"--{_FLAG}="):
+            try:
+                have = int(p.split("=", 1)[1])
+            except ValueError:
+                have = 0
+    if have >= n:
+        return  # environment already provides enough host devices
+    parts = [p for p in parts if not p.startswith(f"--{_FLAG}=")]
+    parts.append(f"--{_FLAG}={n}")
+    os.environ["XLA_FLAGS"] = " ".join(parts)
+    os.execv(sys.executable, [sys.executable] + sys.argv)

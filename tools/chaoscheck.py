@@ -76,6 +76,13 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from _meshenv import force_host_devices_for_mesh  # noqa: E402
+
+force_host_devices_for_mesh()
+
+
 def no_leaked_blocks(engine) -> bool:
     """Post-drain allocator invariant under prefix caching: blocks not
     on the free list are exactly the radix index's warm reusable KV."""
@@ -661,6 +668,152 @@ def run_overload_sweep() -> bool:
     return not failures
 
 
+def run_mesh_sweep(n: int) -> bool:
+    """Sharded-generation chaos (ISSUE 15): a tp=N engine over a forced
+    N-device host mesh rides the SAME self-healing ladder as the
+    single-device engine when its cross-shard collectives fail. Legs:
+
+      * reference   — fault-free tp=N run; also the byte-exactness
+                      baseline for every chaos leg below
+      * retry       — one failed collective (``generation.collective``
+                      error) absorbs into the supervisor's single step
+                      retry; streams byte-exact
+      * restart     — a collective that fails again on the retry walks
+                      the full ladder (bisection probes find no lone
+                      crasher -> engine reset + journal replay over the
+                      SHARDED cache); streams byte-exact
+      * stall       — a wedged collective trips the real-clock watchdog,
+                      the stale step is discarded, and replay is exact
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO)
+
+    import jax
+
+    if len(jax.devices()) < n:
+        print(
+            f"FAIL: mesh sweep needs {n} devices, have {len(jax.devices())}",
+            file=sys.stderr,
+        )
+        return False
+
+    from flexflow_tpu.generation import (
+        ContinuousBatchingScheduler,
+        GenerationEngine,
+        RecoveryPolicy,
+        SamplingParams,
+        WatchdogPolicy,
+        init_decoder_params,
+    )
+    from flexflow_tpu.models.transformer import TransformerConfig
+    from flexflow_tpu.runtime import faults
+    from flexflow_tpu.runtime.faults import FaultPlan
+
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=32, num_heads=4, ff_size=64,
+        seq_length=64, vocab_size=50, causal=True,
+    )
+    params = init_decoder_params(jax.random.key(0), cfg)
+    prompts = [[1, 2, 3], [4, 5, 6, 7], [9, 8, 7, 6, 5]]
+    sampling = SamplingParams(max_new_tokens=10)
+    policy = RecoveryPolicy(sleep=lambda _s: None)
+
+    eng = GenerationEngine(params, cfg, max_batch_slots=3, block_size=8,
+                           tp_degree=n)
+    eng.generate([[1] * 12], SamplingParams(max_new_tokens=2))
+
+    def make(**kw):
+        return eng, ContinuousBatchingScheduler(eng, recovery=policy, **kw)
+
+    def drive(sched, handles, steps=500):
+        for _ in range(steps):
+            if all(h.done() for h in handles):
+                return
+            if not sched.step():
+                return
+
+    report, failures = {}, []
+
+    def check(scenario, cond, msg):
+        if not cond:
+            failures.append(f"{scenario}: {msg}")
+
+    check("geometry", eng.tp_degree == n,
+          f"engine tp_degree {eng.tp_degree} != {n}")
+    check("geometry", f"x{n}" in eng.flops_model.chip.name,
+          f"chip spec did not scale: {eng.flops_model.chip.name}")
+
+    # --------------------------------------------------- reference run
+    eng, sched = make()
+    handles = [sched.submit(p, sampling) for p in prompts]
+    drive(sched, handles)
+    ref = [h.result(timeout=0) for h in handles]
+    check("reference", eng.resets == 0, "fault-free sharded run restarted")
+    report["reference"] = {"tokens": sum(len(r) for r in ref)}
+
+    # --------------------------------------------- collective retry
+    eng, sched = make()
+    plan = FaultPlan(seed=0)
+    plan.on(faults.GENERATION_COLLECTIVE, mode="error",
+            error=RuntimeError("injected collective failure"), nth=(2,))
+    with plan.active():
+        handles = [sched.submit(p, sampling) for p in prompts]
+        drive(sched, handles)
+    got = [h.result(timeout=0) for h in handles]
+    rs = sched.recovery_stats
+    check("retry", got == ref, f"streams diverged after retry: {got} != {ref}")
+    check("retry", rs.step_retries >= 1, "failed collective was not retried")
+    check("retry", eng.resets == 0, "single collective failure restarted")
+    report["retry"] = {"step_retries": rs.step_retries, "exact": got == ref}
+
+    # ------------------------------------- collective restart + replay
+    eng, sched = make()
+    plan = FaultPlan(seed=0)
+    plan.on(faults.GENERATION_COLLECTIVE, mode="error",
+            error=RuntimeError("injected collective failure"), nth=(2, 3))
+    with plan.active():
+        handles = [sched.submit(p, sampling) for p in prompts]
+        drive(sched, handles)
+    got = [h.result(timeout=0) for h in handles]
+    rs = sched.recovery_stats
+    check("restart", got == ref,
+          f"streams diverged after restart replay: {got} != {ref}")
+    check("restart", rs.recoveries >= 1,
+          f"persistent collective failure never restarted: {rs.recoveries}")
+    report["restart"] = {"recoveries": rs.recoveries,
+                         "replayed_tokens": rs.replayed_tokens,
+                         "exact": got == ref}
+
+    # -------------------------------------------------- collective stall
+    _, sched = make(watchdog=WatchdogPolicy(stall_timeout_s=1.0, poll_s=0.05))
+    gate = threading.Event()
+    plan = FaultPlan(seed=0)
+    plan.on(faults.GENERATION_COLLECTIVE, mode="stall", gate=gate, nth=(2,))
+    with plan.active():
+        sched.start()
+        handles = [sched.submit(p, sampling) for p in prompts]
+        t0 = time.monotonic()
+        while sched.recovery_stats.watchdog_trips == 0 and time.monotonic() - t0 < 10:
+            time.sleep(0.02)
+        gate.set()
+        got = [h.result(timeout=30) for h in handles]
+    rs = sched.recovery_stats
+    sched.stop()
+    check("stall", rs.watchdog_trips >= 1, "watchdog never tripped")
+    check("stall", got == ref, f"streams diverged after stall: {got} != {ref}")
+    report["stall"] = {"watchdog_trips": rs.watchdog_trips,
+                       "recoveries": rs.recoveries, "exact": got == ref}
+
+    print(json.dumps({"mesh_sweep": report, "devices": n}, indent=2))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(f"OK: mesh sweep — failed/stalled collectives on the tp={n} "
+              "engine rode the retry -> restart ladder with byte-exact "
+              "journal replay over the sharded cache")
+    return not failures
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--sweep-only", action="store_true",
@@ -674,7 +827,18 @@ def main() -> int:
                     help="also run the overload storm (priority-ordered "
                          "shed, degrade-ladder hysteresis, byte-exact "
                          "survivors)")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="run ONLY the sharded-generation sweep on a "
+                         "forced N-device host mesh (failed/stalled "
+                         "collectives -> retry/restart ladder, byte-exact "
+                         "replay); re-execs with XLA_FLAGS when needed")
     args, pytest_args = ap.parse_known_args()
+
+    if args.mesh:
+        # the mesh sweep runs alone: the forced host-device count
+        # changes the process's device geometry, which the other sweeps'
+        # timings and the pytest legs were not calibrated for
+        return 0 if run_mesh_sweep(args.mesh) else 1
 
     rc = 0
     if not args.sweep_only:

@@ -74,11 +74,19 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
-import jax
-import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _meshenv import force_host_devices_for_mesh  # noqa: E402
+
+force_host_devices_for_mesh()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
 
 sys.path.insert(0, ".")
 
@@ -190,6 +198,11 @@ def _history_metrics(mode: str, report: dict) -> dict:
             "overlap_tokens_per_s_ratio": report.get("tokens_per_s_ratio"),
             "overlap_decode_tokens_per_s": report.get("decode_tokens_per_s_on"),
             "overlap_host_s_per_hot_step": report.get("host_s_per_hot_step_on"),
+        }
+    if mode == "mesh":
+        return {
+            "mesh_decode_tokens_per_s": report.get("mesh_decode_tokens_per_s"),
+            "mesh_tokens_per_s_ratio": report.get("mesh_tokens_per_s_ratio"),
         }
     return {}
 
@@ -680,6 +693,140 @@ def overlap_bench(args, cfg, params) -> tuple:
     return report, ok
 
 
+def mesh_bench(args, cfg, params) -> tuple:
+    """Multi-chip sharded generation gate (ISSUE 15): the same request
+    streams through a 1-device engine and a tp=N engine over a forced
+    N-device host mesh (or real chips). Gates: BYTE-IDENTICAL token
+    streams across mixed sampling (greedy / seeded temperature / top-k),
+    speculative decoding, and the overlap pipeline; zero steady-state
+    retraces on BOTH engines (the sharded jits must stay one compile
+    per program); no self-healing misfires; and the engine's
+    serving-strategy metadata reporting the pinned degree. Throughput
+    lands in the history as ``mesh_*`` metrics with perfwatch floors —
+    on a CPU host mesh the sharded arm is EXPECTED slower (collectives
+    over threads); the ratio trend is the regression signal, not an
+    absolute win. Returns (report dict, ok bool)."""
+    n = args.mesh
+    if n < 2:
+        print(f"FAIL: --mesh needs N >= 2, got {n}", file=sys.stderr)
+        return {}, False
+    if len(jax.devices()) < n:
+        print(
+            f"FAIL: --mesh {n} needs {n} devices, have {len(jax.devices())} "
+            f"(set XLA_FLAGS=--xla_force_host_platform_device_count={n})",
+            file=sys.stderr,
+        )
+        return {}, False
+    if args.heads % n != 0:
+        print(f"FAIL: --heads {args.heads} does not divide over --mesh {n}",
+              file=sys.stderr)
+        return {}, False
+    rs = np.random.RandomState(5)
+    max_new = args.max_new if args.max_new_set else 16
+    lengths = [int(rs.randint(4, args.seq_len - max_new)) for _ in range(args.requests)]
+    prompts = [rs.randint(0, args.vocab, k).tolist() for k in lengths]
+    # mixed sampling: greedy / seeded temperature / temperature+top-k,
+    # cycling per request — one batch carries all three in both arms
+    samplings = [
+        (SamplingParams(max_new_tokens=max_new),
+         SamplingParams(max_new_tokens=max_new, temperature=0.8, seed=100 + i),
+         SamplingParams(max_new_tokens=max_new, temperature=1.0, top_k=8,
+                        seed=200 + i))[i % 3]
+        for i in range(len(prompts))
+    ]
+    motif = rs.randint(0, args.vocab, 4).tolist()
+    spec_prompts = [(motif * 12)[: int(rs.randint(10, 24))] for _ in range(4)]
+    spec = SpeculationConfig(k=args.spec_k, method="ngram")
+
+    def build(tp):
+        eng = GenerationEngine(
+            params, cfg, max_batch_slots=args.slots, block_size=16,
+            max_spec_tokens=args.spec_k, prefix_cache=False, tp_degree=tp,
+        )
+        # steady state: warm every bucket + decode + verify (>= 4 new
+        # tokens so the scheduler actually reaches the verify program)
+        eng.generate([prompts[0]], SamplingParams(max_new_tokens=2))
+        eng.generate([spec_prompts[0]], SamplingParams(max_new_tokens=4),
+                     speculation=spec)
+        for b in sorted({eng.bucket_for(len(p)) for p in prompts + spec_prompts}):
+            eng.generate([[1] * min(b, args.seq_len - 2)],
+                         SamplingParams(max_new_tokens=1))
+        return eng
+
+    def drive(eng):
+        sched = ContinuousBatchingScheduler(eng)
+        t0 = time.perf_counter()
+        handles = [sched.submit(p, s) for p, s in zip(prompts, samplings)]
+        while any(not h.done() for h in handles):
+            if not sched.step():
+                break
+        elapsed = time.perf_counter() - t0
+        outs = [h.result(timeout=0) for h in handles]
+        s_out, s_sched, _ = run_stream(eng, spec_prompts,
+                                       SamplingParams(max_new_tokens=max_new),
+                                       speculation=spec)
+        return outs, s_out, elapsed, sched, s_sched
+
+    eng1 = build(1)
+    warm1 = dict(eng1.trace_counts)
+    out1, spec1, s1, sched1a, sched1b = drive(eng1)
+    engN = build(n)
+    warmN = dict(engN.trace_counts)
+    outN, specN, sN, schedNa, schedNb = drive(engN)
+
+    gen_tokens = sum(len(o) for o in outN)
+    tps1 = gen_tokens / max(s1, 1e-9)
+    tpsN = gen_tokens / max(sN, 1e-9)
+    steady_retraces = {}
+    for eng, warm in ((eng1, warm1), (engN, warmN)):
+        for k in eng.trace_counts:
+            d = eng.trace_counts[k] - warm.get(k, 0)
+            if d > 0:
+                steady_retraces[k] = steady_retraces.get(k, 0) + d
+    strategy = engN.serving_strategy_block()
+    report = {
+        "requests": args.requests,
+        "mesh_devices": n,
+        "generated_tokens": gen_tokens,
+        "exact": out1 == outN,
+        "exact_speculative": spec1 == specN,
+        "stream_s_tp1": round(s1, 4),
+        "stream_s_tpN": round(sN, 4),
+        "mesh_decode_tokens_per_s": round(tpsN, 2),
+        "mesh_tokens_per_s_ratio": round(tpsN / max(tps1, 1e-9), 4),
+        "steady_state_retraces": steady_retraces,
+        "serving_strategy": strategy,
+        "chip": engN.flops_model.chip.name,
+        "capacity": capacity_block(schedNa),
+        "backend": jax.default_backend(),
+    }
+    ok = check_no_self_healing(
+        report, [sched1a, sched1b, schedNa, schedNb], [eng1, engN]
+    )
+    print(json.dumps(report, indent=2))
+    if not report["exact"]:
+        print("FAIL: sharded streams differ from single-device (mixed "
+              "sampling arm)", file=sys.stderr)
+        ok = False
+    if not report["exact_speculative"]:
+        print("FAIL: sharded speculative streams differ from single-device",
+              file=sys.stderr)
+        ok = False
+    if steady_retraces:
+        print(f"FAIL: steady-state stream retraced: {steady_retraces}",
+              file=sys.stderr)
+        ok = False
+    if strategy.get("tp_degree") != n:
+        print(f"FAIL: serving strategy reports tp_degree "
+              f"{strategy.get('tp_degree')}, expected {n}", file=sys.stderr)
+        ok = False
+    if f"x{n}" not in report["chip"]:
+        print(f"FAIL: chip spec did not scale to mesh geometry: "
+              f"{report['chip']}", file=sys.stderr)
+        ok = False
+    return report, ok
+
+
 def trace_overhead_bench(args, cfg, params) -> tuple:
     """Tracing-overhead guard: the same steady-state stream with
     observability off vs on, interleaved best-of-N. Returns
@@ -855,6 +1002,13 @@ def main() -> int:
     ap.add_argument("--prefix-repeats", type=int, default=3,
                     help="interleaved (off, on) stream pairs; best-of-N "
                          "TTFT per arm")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="benchmark multi-chip sharded generation: the "
+                         "same streams through a 1-device and a tp=N "
+                         "engine (forces N host devices via XLA_FLAGS + "
+                         "re-exec when needed); gates byte-identical "
+                         "streams, zero retraces, no self-healing "
+                         "misfires")
     ap.add_argument("--overlap", action="store_true",
                     help="benchmark overlapped decode: interleaved A/B of "
                          "the same stream with the pipeline off vs on, "
@@ -914,6 +1068,23 @@ def main() -> int:
         causal=True,
     )
     params = init_decoder_params(jax.random.key(0), cfg)
+
+    if args.mesh:
+        report, ok = mesh_bench(args, cfg, params)
+        write_bench_artifact(args.bench_out, "mesh", report)
+        append_history(args.history_out, "mesh", report, ok)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2)
+        if not ok:
+            return 1
+        print(
+            f"OK: tp={args.mesh} streams byte-identical to single-device "
+            f"(mixed sampling + speculative) at "
+            f"{report['mesh_tokens_per_s_ratio']}x tokens/s, zero "
+            "steady-state retraces"
+        )
+        return 0
 
     if args.trace_out:
         report, ok = trace_overhead_bench(args, cfg, params)

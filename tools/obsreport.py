@@ -118,6 +118,30 @@ def summarize(base: str) -> int:
             }
             if rec:
                 print("    recovery: " + "  ".join(f"{k}={v}" for k, v in rec.items()))
+            if section == "generation":
+                # serving layout (ISSUE 15): mesh geometry + the
+                # search-chosen (or pinned) tensor-parallel degree
+                try:
+                    meta = _get_json(f"{base}/v2/models/{name}")
+                except Exception:
+                    meta = {}
+                ss = meta.get("serving_strategy") or {}
+                if ss:
+                    line = (
+                        f"    serving: mesh_devices={ss.get('mesh_devices')}"
+                        f"  tp_degree={ss.get('tp_degree')}"
+                    )
+                    search = ss.get("search") or {}
+                    if search:
+                        line += (
+                            f"  layout={'pinned' if search.get('pinned') else 'searched'}"
+                            f"  candidates="
+                            f"{[c['tp_degree'] for c in search.get('candidates', [])]}"
+                        )
+                    chip = (meta.get("compute") or {}).get("chip")
+                    if chip:
+                        line += f"  chip={chip}"
+                    print(line)
     return 0
 
 
@@ -534,6 +558,12 @@ def selfcheck() -> int:
               "prefix-cached repeat stream differs from first run")
         check(eng.prefix_cache.tokens_reused_total > reused_before,
               "repeat admission did not reuse cached prefix blocks")
+
+        # -------------------- serving-strategy metadata (ISSUE 15)
+        meta = _get_json(f"{base}/v2/models/lm")
+        ss = meta.get("serving_strategy") or {}
+        check(ss.get("tp_degree") == 1 and ss.get("mesh_devices") == 1,
+              f"single-device serving_strategy block wrong: {ss}")
 
         # -------------------- program registry: non-empty, blame works
         progs = _get_json(f"{base}/v2/debug/programs")

@@ -79,6 +79,14 @@ METRICS: Dict[str, Tuple[str, str, float]] = {
     "ttft_p50_improvement": ("higher", "rel", 0.15),
     "prefill_reuse_ratio": ("higher", "rel", 0.10),
     "ttft_p50_cached_s": ("lower", "rel", 0.25),
+    # mesh mode (ISSUE 15): sharded-arm decode tokens/s and the
+    # sharded/single ratio. On CPU CI the ratio sits well below 1
+    # (collectives over host threads); the gate guards the TREND — a
+    # drop past the floor means sharded execution got slower relative
+    # to its own history, not that sharding must beat one device.
+    # Wall-clock-derived -> the wide relative floors wall clocks get.
+    "mesh_decode_tokens_per_s": ("higher", "rel", 0.25),
+    "mesh_tokens_per_s_ratio": ("higher", "rel", 0.20),
 }
 
 
