@@ -59,7 +59,7 @@ from ..obs.truth import PredictionLedger
 from ..runtime import faults
 from .cache import BlockAllocator, CacheConfig, KVCache, slot_mapping
 from .decoder import DecoderParams, decode_step, prefill, verify_step
-from .prefix import PrefixCache, PrefixEntry
+from .prefix import KVHandoffPayload, PackedBlock, PrefixCache, PrefixEntry
 from .sharding import ServingLayout
 
 NEG_INF = -1e30
@@ -485,6 +485,11 @@ class GenerationEngine:
         self._copy_block_jit = jax.jit(self._copy_block_impl, **blk_sh)
         self._read_block_jit = jax.jit(self._read_block_impl, **rd_sh)
         self._write_block_jit = jax.jit(self._write_block_impl, **blk_sh)
+        # batched handoff-wire programs (one dispatch per payload, not
+        # per block): padded to max_blocks_per_seq so ONE fixed-shape
+        # program serves every prompt length
+        self._read_blocks_jit = jax.jit(self._read_blocks_impl, **rd_sh)
+        self._write_blocks_jit = jax.jit(self._write_blocks_impl, **blk_sh)
         self._register_strategy_predictions()
 
     def _dev(self, x) -> jax.Array:
@@ -725,6 +730,43 @@ class GenerationEngine:
                 cache_v, host_v[:, None].astype(cache_v.dtype), dst, axis=1
             ),
         )
+
+    def _read_blocks_impl(self, cache_k, cache_v, srcs):
+        """Batched wire read: one payload's blocks ([L, n, bs, H, D]
+        each) gathered in a single program. ``srcs`` is padded to
+        ``max_blocks_per_seq`` by repeating the last id, so every
+        prompt length shares ONE fixed-shape program."""
+        self.trace_counts["kv_blocks_read"] = self.trace_counts.get("kv_blocks_read", 0) + 1
+        self.programs.note_trace("kv_blocks_read", {"cache_k": cache_k, "srcs": srcs})
+        return (
+            jnp.take(cache_k, srcs, axis=1),
+            jnp.take(cache_v, srcs, axis=1),
+        )
+
+    def _write_blocks_impl(self, cache_k, cache_v, dsts, host_ks, host_vs):
+        """Batched wire write: commit one payload's blocks in a single
+        program. A scan keeps the duplicate padding ids harmless — a
+        repeated destination is simply rewritten with the same data."""
+        self.trace_counts["kv_blocks_write"] = self.trace_counts.get("kv_blocks_write", 0) + 1
+        self.programs.note_trace("kv_blocks_write", {
+            "cache_k": cache_k, "dsts": dsts, "host_ks": host_ks,
+        })
+
+        def body(carry, x):
+            ck, cv = carry
+            dst, hk, hv = x
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                ck, hk[:, None].astype(ck.dtype), dst, axis=1
+            )
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                cv, hv[:, None].astype(cv.dtype), dst, axis=1
+            )
+            return (ck, cv), None
+
+        (ck, cv), _ = jax.lax.scan(
+            body, (cache_k, cache_v), (dsts, host_ks, host_vs)
+        )
+        return ck, cv
 
     # ----------------------------------------------------------- host API
     def _record_step_phases(
@@ -1163,6 +1205,63 @@ class GenerationEngine:
             return np.asarray(k), np.asarray(v)
 
         return self.prefix_cache.reclaim(max(1, n_blocks), read)
+
+    def pack_kv_blocks(
+        self, table: List[int], n_positions: int
+    ) -> KVHandoffPayload:
+        """Pack the blocks covering positions ``[0, n_positions)`` into
+        the prefill->decode wire format: full-head host reads through
+        the same jitted block reader the host tier uses (the reader's
+        replicated out_shardings gather every head even when this
+        engine's cache is sharded, so the payload is TP-agnostic), each
+        block CRC-stamped at packing time."""
+        bs = self.cache_config.block_size
+        n_blocks = self.cache_config.blocks_for(n_positions)
+        ids = list(table[:n_blocks])
+        srcs = ids + [ids[-1]] * (self.max_blocks_per_seq - len(ids))
+        ks, vs = self._read_blocks_jit(
+            self.cache.k, self.cache.v,
+            self._dev(np.asarray(srcs, dtype=np.int32)),
+        )
+        ks, vs = np.asarray(ks), np.asarray(vs)
+        blocks = [
+            PackedBlock(np.ascontiguousarray(ks[:, i]),
+                        np.ascontiguousarray(vs[:, i]))
+            for i in range(len(ids))
+        ]
+        return KVHandoffPayload(n_positions, bs, blocks)
+
+    def import_kv_block(
+        self, dst: int, host_k: np.ndarray, host_v: np.ndarray
+    ) -> None:
+        """Commit one wire block into this engine's cache at ``dst``
+        through the jitted block writer — the write's out_shardings
+        reshard the full-head payload onto this engine's own head
+        partitioning, so differing pool TP degrees need no explicit
+        reshard step."""
+        ck, cv = self._write_block_jit(
+            self.cache.k, self.cache.v, jnp.int32(dst),
+            self._dev(host_k), self._dev(host_v),
+        )
+        self.cache.update(ck, cv)
+
+    def import_kv_blocks(self, dsts: Sequence[int], blocks) -> None:
+        """Commit one payload's wire blocks in a single batched program
+        (same resharding semantics as :meth:`import_kv_block`): padded
+        to ``max_blocks_per_seq`` by repeating the last block, so a
+        decode-pool replica pays one dispatch per adopted stream, not
+        one per block, between its decode steps."""
+        ids = list(dsts)
+        pad = self.max_blocks_per_seq - len(ids)
+        idx = ids + [ids[-1]] * pad
+        hk = np.stack([b.host_k for b in blocks] + [blocks[-1].host_k] * pad)
+        hv = np.stack([b.host_v for b in blocks] + [blocks[-1].host_v] * pad)
+        ck, cv = self._write_blocks_jit(
+            self.cache.k, self.cache.v,
+            self._dev(np.asarray(idx, dtype=np.int32)),
+            self._dev(hk), self._dev(hv),
+        )
+        self.cache.update(ck, cv)
 
     def _stage(self, name: str, host: np.ndarray) -> jax.Array:
         """Device-resident staging: upload ``host`` once and reuse the

@@ -113,6 +113,67 @@ def _crc(k: np.ndarray, v: np.ndarray) -> int:
     return zlib.crc32(v.tobytes(), zlib.crc32(k.tobytes()))
 
 
+class PackedBlock:
+    """One KV block on the prefill->decode handoff wire.
+
+    The host tier's pack format promoted to a wire format: full-head
+    ``[layers, block_size, heads, head_dim]`` host arrays (the jitted
+    block reader gathers all heads regardless of the source engine's
+    sharding, so the wire is TP-degree-agnostic) plus the same CRC seam
+    the offload tier uses — a block corrupted in flight is detected at
+    the decode side before any device write happens."""
+
+    __slots__ = ("host_k", "host_v", "crc")
+
+    def __init__(self, host_k: np.ndarray, host_v: np.ndarray,
+                 crc: Optional[int] = None):
+        self.host_k = host_k
+        self.host_v = host_v
+        self.crc = _crc(host_k, host_v) if crc is None else crc
+
+    def verify(self) -> bool:
+        """True when the payload still matches its packing-time CRC."""
+        try:
+            return _crc(self.host_k, self.host_v) == self.crc
+        except Exception:
+            return False
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.host_k.nbytes) + int(self.host_v.nbytes)
+
+
+class KVHandoffPayload:
+    """A prefilled prompt's KV state in transit between pools.
+
+    ``n_positions`` is the number of cache positions the payload covers
+    (the full prompt length — the prefill side packs every block the
+    prompt wrote, including the trailing partial one). ``geometry`` is
+    the full-head per-block shape ``(layers, block_size, heads,
+    head_dim)``; the importing engine checks it against its own cache
+    config, NOT against the source's TP degree — head-axis resharding
+    is implicit because the wire carries all heads and the target's
+    jitted block writer commits into its own sharded cache."""
+
+    __slots__ = ("n_positions", "block_size", "blocks", "geometry")
+
+    def __init__(self, n_positions: int, block_size: int,
+                 blocks: List[PackedBlock]):
+        self.n_positions = n_positions
+        self.block_size = block_size
+        self.blocks = blocks
+        self.geometry: Tuple[int, ...] = (
+            tuple(blocks[0].host_k.shape) if blocks else ()
+        )
+
+    def verify(self) -> bool:
+        return all(b.verify() for b in self.blocks)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(b.nbytes for b in self.blocks)
+
+
 class PrefixCache:
     """Radix prefix index + host tier over one engine's block cache.
 

@@ -296,6 +296,11 @@ class Request:
         self.cache_wait_start: Optional[float] = None
         self.cache_wait_short = 0
         self.slo_sink = None
+        # disaggregated serving: a KVHandoffPayload attached by
+        # adopt(imported=...) — the decode-side admission imports these
+        # blocks instead of recompute-prefilling; cleared on use (or on
+        # rejection, which falls back to recompute)
+        self.imported_kv = None
 
     @property
     def n_generated(self) -> int:
@@ -445,6 +450,12 @@ class ContinuousBatchingScheduler:
         # them onto surviving replicas via adopt()
         self.fault_scope = fault_scope
         self.failover_sink: Optional[Callable] = None
+        # disaggregated serving: when set (prefill-pool replicas only),
+        # admission ends at the first token — the prompt's KV packs into
+        # the wire format and the (request, payload) pair goes to the
+        # sink for transfer to the decode pool instead of occupying a
+        # decode slot here
+        self.handoff_sink: Optional[Callable] = None
         # scheduler-wide default speculation policy (a request's own
         # config overrides it); draft_params backs 'draft_model' drafters
         self.speculation_default = speculation
@@ -1065,7 +1076,8 @@ class ContinuousBatchingScheduler:
             stolen, self._queue = list(self._queue), deque()
         return [r for r in stolen if not r.handle.done()]
 
-    def adopt(self, req: Request, *, front: bool = True) -> None:
+    def adopt(self, req: Request, *, front: bool = True,
+              imported=None) -> None:
         """Cross-replica journal-replay admission (fleet failover): take
         ownership of a Request journaled on a dead sibling scheduler.
         The replay state IS the request object — original prompt, every
@@ -1077,7 +1089,14 @@ class ContinuousBatchingScheduler:
         was already admitted once and must not be dropped for
         backpressure it cleared on its original replica. ``front``
         requeues ahead of fresh work (mid-stream requests were admitted
-        before anything now waiting)."""
+        before anything now waiting).
+
+        ``imported`` (disaggregated serving) attaches a CRC-verified
+        :class:`KVHandoffPayload`: admission imports the prefilled
+        blocks instead of recompute-prefilling, and any import failure
+        falls back to the recompute path — the stream is byte-exact
+        either way, so a handoff can degrade but never corrupt."""
+        req.imported_kv = imported
         req.prompt = req.original_prompt + list(req.generated)
         # heterogeneous-adopter guards (unreachable for fleet-built
         # replicas, which share one factory): mirror submit()'s
@@ -1108,7 +1127,10 @@ class ContinuousBatchingScheduler:
         req.max_new = min(
             req.max_new, req.n_generated + room, req.n_generated + cache_room
         )
-        if req.n_generated > 0:
+        if req.n_generated > 0 and imported is None:
+            # a recompute adoption replays the stream; an imported
+            # handoff is the disaggregated steady state and counts only
+            # if the import is later rejected (see _admit_imported)
             req.replays += 1
             req.trace.note_replay()
             self.recovery_stats.incr("replayed_tokens", req.n_generated)
@@ -1436,6 +1458,10 @@ class ContinuousBatchingScheduler:
             if not self.breaker.allow():
                 return False
             req = self._queue[0]
+        if req.imported_kv is not None:
+            # disaggregated decode pool: the prompt's KV arrived over
+            # the handoff wire — import it instead of prefilling
+            return self._admit_imported(req)
         # prefix match + block acquisition run OUTSIDE the submit lock:
         # the reclaim path does per-block device reads (host-tier
         # swap-outs) that must neither block concurrent submits nor —
@@ -1632,7 +1658,133 @@ class ContinuousBatchingScheduler:
         self.token_rate.record(1)
         if req.finished():
             self._finish(state)
+        elif self.handoff_sink is not None:
+            # disaggregated prefill pool: this replica's job ends at the
+            # first token. Pack the prompt's KV into the CRC-stamped
+            # wire format while the blocks are still resident, hand the
+            # slot back, and ship (request, payload) to the handoff
+            # supervisor — the stream continues on the decode pool.
+            with self._stamped():
+                payload = self.engine.pack_kv_blocks(
+                    state.blocks, state.cached_len
+                )
+            self._release(state)
+            req.trace.event(
+                "kv_handoff_pack", n_blocks=len(payload.blocks),
+                payload_bytes=payload.nbytes,
+            )
+            sink = self.handoff_sink
+            try:
+                sink(req, payload)
+            except Exception as e:
+                # the sink must never kill the loop; a sink crash fails
+                # the stream typed instead of losing it silently
+                if req.handle._fail(e):
+                    self.stats.incr("failed")
         self._span("admit", t_dev_end, time.perf_counter())
+        return True
+
+    def _admit_imported(self, req: Request) -> bool:
+        """Disaggregated decode-pool admission: commit a handed-off
+        prompt's KV blocks into this engine's cache (CRC-verified per
+        block, resharded onto this engine's head partitioning by the
+        jitted block writer) and seat the stream directly in a decode
+        slot — no prefill device call. Any failure — injected fault,
+        CRC mismatch, geometry mismatch — rejects the import and falls
+        back to the recompute-prefill path, which replays the stream
+        byte-exactly from the request object."""
+        payload = req.imported_kv
+        t0 = time.perf_counter()
+        need = self.engine.cache_config.blocks_for(payload.n_positions + 1)
+        blocks = self.engine.allocator.allocate(need)
+        if blocks is None:
+            with self._stamped():
+                reclaimed = self.engine.reclaim_cached(
+                    need - self.engine.allocator.num_free
+                )
+            if reclaimed:
+                blocks = self.engine.allocator.allocate(need)
+        if blocks is None:
+            if self.obs_enabled and req.cache_wait_start is None:
+                req.cache_wait_start = self.clock()
+            req.cache_wait_short = need - self.engine.allocator.num_free
+            return False
+        with self._lock:
+            if not self._queue or self._queue[0] is not req or not self._free_slots:
+                self.engine.allocator.free(blocks)
+                return False
+            self._queue.popleft()
+            slot = self._free_slots.pop()
+        try:
+            faults.inject(
+                faults.GENERATION_KV_IMPORT, (req.id, len(payload.blocks))
+            )
+            if payload.block_size != self.engine.cache_config.block_size:
+                raise ValueError(
+                    f"handoff block size {payload.block_size} != this "
+                    f"engine's {self.engine.cache_config.block_size}"
+                )
+            if len(payload.blocks) < self.engine.cache_config.blocks_for(
+                payload.n_positions
+            ):
+                raise ValueError("handoff payload is missing blocks")
+            n_import = self.engine.cache_config.blocks_for(payload.n_positions)
+            wire = payload.blocks[:n_import]
+            for pb in wire:
+                if not pb.verify():
+                    raise ValueError(
+                        "imported KV block failed CRC verification"
+                    )
+            # every block CRC-verified BEFORE any device write, then one
+            # batched program commits the whole payload — a decode-pool
+            # replica pays one dispatch per adopted stream between steps
+            with self._stamped():
+                self.engine.import_kv_blocks(blocks[:n_import], wire)
+        except Exception as e:
+            # reject the import: hand everything back and requeue for
+            # the recompute path (this is the replay the clean-handoff
+            # adopt() deliberately did not count)
+            req.imported_kv = None
+            self.engine.allocator.free(blocks)
+            with self._lock:
+                self._free_slots.append(slot)
+                self._queue.appendleft(req)
+            self.recovery_stats.incr("kv_imports_rejected")
+            if req.n_generated > 0:
+                req.replays += 1
+                req.trace.note_replay()
+                self.recovery_stats.incr("replayed_tokens", req.n_generated)
+            req.trace.event(
+                "kv_import_rejected", reason=type(e).__name__,
+                n_blocks=len(payload.blocks),
+            )
+            return True
+        req.imported_kv = None
+        self.recovery_stats.incr("kv_imports")
+        state = _Running(
+            req, slot, blocks, cached_len=payload.n_positions,
+            admitted_seq=next(self._admitted_seq),
+        )
+        self._running[slot] = state
+        self.journal.record(req, state.admitted_seq)
+        if req.handle.done():  # reaped while blocks were in flight
+            self._release(state)
+            return True
+        req.trace.mark_admit(
+            slot=slot, prompt_len=len(req.prompt),
+            preemptions=req.preemptions, replays=req.replays,
+        )
+        req.trace.event(
+            "kv_import", n_blocks=len(payload.blocks),
+            n_positions=payload.n_positions, payload_bytes=payload.nbytes,
+        )
+        self.flight.record_step(
+            "kv_import", phases={"admit": time.perf_counter() - t0},
+            request_id=req.id, prompt_len=len(req.prompt),
+            occupancy=len(self._running), queue_depth=len(self._queue),
+            blocks_free=self.engine.allocator.num_free,
+        )
+        self._span("admit", t0, time.perf_counter())
         return True
 
     def _emit_token(self, state: _Running, token: int) -> None:

@@ -23,6 +23,11 @@ rename breaks CI instead of dashboards):
       distribution (obs/steptrace.py): host phases schedule / admit /
       prefix_plan / draft / sample / dispatch / block / readback /
       bookkeep plus the device execute lane
+  flexflow_serving_fleet_pool_replicas{model,pool,state} gauge — a
+      disaggregated fleet's replicas per pool (prefill/decode)
+  flexflow_serving_handoff_*{model,...}               counter/histogram
+      — the prefill->decode KV handoff protocol: transfers_total by
+      outcome, bytes_total, replay_fallbacks_total, latency_seconds
   flexflow_fault_site_calls_total{site}               counter — times
       each fault-injection site was reached (active plan only)
   flexflow_fault_site_fires_total{site}               counter — times
@@ -122,7 +127,14 @@ _HELP = {
     "degrade_transitions_total": "Degradation-ladder level transitions (cumulative).",
     "autoscale_signal": "Fleet autoscale signal: 1 want-more, -1 want-fewer, 0 steady.",
     "autoscale_want_replicas": "Replica count the fleet's sustained limiter state asks for.",
+    "kv_imports": "KV handoff payloads imported into decode slots (disaggregated serving).",
+    "kv_imports_rejected": "KV handoff imports rejected at unpack (stream fell back to recompute-prefill).",
     "fleet_replicas": "Current fleet replicas per lifecycle state.",
+    "fleet_pool_replicas": "Disaggregated-fleet replicas per pool and lifecycle state.",
+    "handoff_transfers_total": "Prefill->decode KV handoff transfers by terminal outcome (ok/corrupt/error/stalled).",
+    "handoff_bytes_total": "KV bytes delivered onto decode replicas via the handoff wire (cumulative).",
+    "handoff_replay_fallbacks_total": "Handoffs that fell back to decode-pool journal replay (cumulative).",
+    "handoff_latency_seconds": "Prefill-done to decode-adoption latency per delivered handoff.",
     "fleet_failovers_total": "Replica deaths whose live streams were handed over for cross-replica journal-replay.",
     "fleet_migrated_streams_total": "Streams journal-replayed onto a surviving or replacement replica.",
     "fleet_replaced_total": "Replicas retired and swapped for a fresh warmed replica.",
@@ -349,6 +361,78 @@ def render_prometheus(
                     '%s{model="%s"} %s'
                     % (family, escape_label_value(f),
                        format_value(auto.get(key, 0)))
+                )
+        # disaggregated serving (serving/fleet.py DisaggregatedFleet):
+        # per-pool replica states + the KV handoff protocol families.
+        # Key-gated on the pools/handoff keys so unified fleets render
+        # byte-identically to before disaggregation existed.
+        if any(fleets[f].get("pools") for f in fnames):
+            family = "flexflow_serving_fleet_pool_replicas"
+            _help_type(lines, family, "gauge")
+            for f in fnames:
+                pools = fleets[f].get("pools")
+                if not pools:
+                    continue
+                fl = escape_label_value(f)
+                for pool in sorted(pools):
+                    states = pools[pool].get("states", {})
+                    for state in sorted(states):
+                        lines.append(
+                            '%s{model="%s",pool="%s",state="%s"} %s'
+                            % (family, fl, escape_label_value(pool),
+                               escape_label_value(state),
+                               format_value(states[state]))
+                        )
+        if any(fleets[f].get("handoff") for f in fnames):
+            family = "flexflow_serving_handoff_transfers_total"
+            _help_type(lines, family, "counter")
+            for f in fnames:
+                ho = fleets[f].get("handoff")
+                if not ho:
+                    continue
+                fl = escape_label_value(f)
+                transfers = ho.get("transfers", {})
+                for outcome in sorted(transfers):
+                    lines.append(
+                        '%s{model="%s",outcome="%s"} %s'
+                        % (family, fl, escape_label_value(outcome),
+                           format_value(transfers[outcome]))
+                    )
+            for short, key in (
+                ("handoff_bytes_total", "bytes_total"),
+                ("handoff_replay_fallbacks_total", "replay_fallbacks_total"),
+            ):
+                family = "flexflow_serving_%s" % short
+                _help_type(lines, family, "counter")
+                for f in fnames:
+                    ho = fleets[f].get("handoff")
+                    if not ho:
+                        continue
+                    lines.append(
+                        '%s{model="%s"} %s'
+                        % (family, escape_label_value(f),
+                           format_value(ho.get(key, 0)))
+                    )
+            family = "flexflow_serving_handoff_latency_seconds"
+            _help_type(lines, family, "histogram")
+            for f in fnames:
+                ho = fleets[f].get("handoff")
+                if not ho or ho.get("latency") is None:
+                    continue
+                ml = 'model="%s"' % escape_label_value(f)
+                snap = ho["latency"]
+                for le, cum in snap["buckets"]:
+                    lines.append(
+                        '%s_bucket{%s,le="%s"} %s'
+                        % (family, ml,
+                           "+Inf" if math.isinf(le) else format_value(le),
+                           format_value(cum))
+                    )
+                lines.append(
+                    '%s_sum{%s} %s' % (family, ml, format_value(snap["sum"]))
+                )
+                lines.append(
+                    '%s_count{%s} %s' % (family, ml, format_value(snap["count"]))
                 )
 
     # ---------------------------------------------------------- fault sites

@@ -78,6 +78,8 @@ GENERATION_PREFIX_LOOKUP = "generation.prefix_lookup"
 GENERATION_KV_OFFLOAD = "generation.kv_offload"
 FLEET_ROUTE = "fleet.route"
 FLEET_REPLICA_SPAWN = "fleet.replica_spawn"
+FLEET_KV_HANDOFF = "fleet.kv_handoff"
+GENERATION_KV_IMPORT = "generation.kv_import"
 
 # site -> "where it fires" (read-only: registering a site means adding a
 # constant + an entry here + the inject() call, in one reviewed place)
@@ -138,6 +140,18 @@ SITES = MappingProxyType({
     FLEET_REPLICA_SPAWN: (
         "before a fleet replica is built/warmed (value: the new replica id); "
         "an error here is a failed replacement spawn"
+    ),
+    FLEET_KV_HANDOFF: (
+        "around each per-block prefill->decode KV transfer (value: (host_k, "
+        "host_v) wire arrays); `nan` mode corrupts the block in flight (CRC "
+        "catches it on arrival), an error fails the attempt into bounded "
+        "retry, a stall wedges the transfer until the deadline expires — "
+        "every path terminates in decode-pool journal replay, byte-exact"
+    ),
+    GENERATION_KV_IMPORT: (
+        "before the decode-side unpack of an imported KV payload (value: "
+        "(request id, n_blocks)); an error rejects the import and the "
+        "stream falls back to recompute-prefill on the decode replica"
     ),
 })
 

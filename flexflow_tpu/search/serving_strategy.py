@@ -174,3 +174,65 @@ def choose_serving_strategy(
         mesh_devices=mesh_devices,
         candidates=scored,
     )
+
+
+def choose_pool_strategies(
+    cfg,
+    mesh_devices: int,
+    max_batch_slots: int = 4,
+    prefill_len: Optional[int] = None,
+    pinned_prefill_tp: Optional[int] = None,
+    pinned_decode_tp: Optional[int] = None,
+    calibration=None,
+) -> Dict[str, ServingStrategyChoice]:
+    """Disaggregated serving: choose a TP degree PER POOL from one
+    scored candidate set. The unified chooser minimizes the decode-
+    weighted blend because one mesh must run both programs; split
+    pools remove that coupling — the prefill pool takes the argmin of
+    the compute-bound prefill score, the decode pool the argmin of the
+    latency-bound decode score (DistServe/Splitwise: the two programs
+    genuinely want different layouts, and the KV handoff wire is
+    TP-agnostic so the degrees are free to differ). Returns
+    ``{"prefill": choice, "decode": choice}``; pins behave as in
+    :func:`choose_serving_strategy`."""
+    from .calibration import detected_device_kind, mesh_device_kind
+
+    scored = score_serving_layouts(
+        cfg, mesh_devices, max_batch_slots=max_batch_slots,
+        prefill_len=prefill_len, calibration=calibration,
+    )
+    if not scored:
+        raise ValueError(
+            f"no TP candidate divides {cfg.num_heads} heads over "
+            f"{mesh_devices} device(s)"
+        )
+    kind = detected_device_kind()
+
+    def pick(metric: str, pinned: Optional[int]) -> ServingStrategyChoice:
+        if pinned is not None:
+            chosen = next(
+                (c for c in scored if c["tp_degree"] == pinned), None
+            )
+            if chosen is None:
+                raise ValueError(
+                    f"pinned tp_degree {pinned} is not a valid candidate "
+                    f"for {cfg.num_heads} heads over {mesh_devices} "
+                    f"device(s) (candidates: "
+                    f"{[c['tp_degree'] for c in scored]})"
+                )
+        else:
+            chosen = min(scored, key=lambda c: (c[metric], c["tp_degree"]))
+        return ServingStrategyChoice(
+            tp_degree=chosen["tp_degree"],
+            pinned=pinned is not None,
+            prefill_s=chosen["prefill_s"],
+            decode_s=chosen["decode_s"],
+            device_kind=mesh_device_kind(kind, chosen["tp_degree"]),
+            mesh_devices=mesh_devices,
+            candidates=scored,
+        )
+
+    return {
+        "prefill": pick("prefill_s", pinned_prefill_tp),
+        "decode": pick("decode_s", pinned_decode_tp),
+    }

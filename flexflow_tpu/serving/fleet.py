@@ -70,7 +70,10 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from ..generation.engine import GenerationEngine, SamplingParams
+from ..generation.prefix import KVHandoffPayload, PackedBlock
 from ..generation.recovery import EngineFailedError
 from ..generation.scheduler import GenerationHandle, Request
 from ..obs import FlightRecorder
@@ -83,7 +86,7 @@ from .resilience import (
     OverloadedError,
     ShuttingDownError,
 )
-from .stats import FleetStats
+from .stats import FleetStats, Histogram
 
 
 class ReplicaState:
@@ -423,10 +426,19 @@ class Fleet:
         quarantine_streak_limit: int = 3,
         observability: bool = True,
         scheduler_kwargs: Optional[dict] = None,
+        rid_prefix: str = "r",
+        handoff_sink: Optional[Callable] = None,
     ):
         if n < 1:
             raise ValueError("a fleet needs at least one replica")
         self.engine_factory = engine_factory
+        # disaggregated serving (DisaggregatedFleet): a distinct replica
+        # id namespace per pool (chaos scopes target ONE pool's replica)
+        # and the handoff sink installed on every spawned replica's
+        # scheduler — replacements included, so a replaced prefill
+        # replica keeps handing off
+        self.rid_prefix = rid_prefix
+        self.handoff_sink = handoff_sink
         self.name = name
         self.clock = clock
         self.warmup = warmup
@@ -485,7 +497,7 @@ class Fleet:
         fixed-shape decode jit, the warm prompt's prefill bucket, and —
         when the fleet speculates by default — the verify jit) so the
         replica's first real request never pays a retrace."""
-        rid = f"r{next(self._rid)}"
+        rid = f"{self.rid_prefix}{next(self._rid)}"
         faults.inject(faults.FLEET_REPLICA_SPAWN, rid)
         engine = self.engine_factory()
         if self.warmup:
@@ -509,6 +521,11 @@ class Fleet:
         model.scheduler.failover_sink = (
             lambda reqs, cause, _rep=rep: self._on_replica_failed(_rep, reqs, cause)
         )
+        if self.handoff_sink is not None:
+            model.scheduler.handoff_sink = (
+                lambda req, payload, _rep=rep:
+                    self.handoff_sink(req, payload, _rep)
+            )
         if self._started:
             model.start()
         return rep
@@ -1120,3 +1137,744 @@ class Fleet:
                 "want_replicas": self.autoscale.want_replicas(n),
             },
         }
+
+
+class KVHandoff:
+    """One supervised prefill->decode KV transfer. State machine:
+
+        pending ──transfer ok──────────────> delivered
+           │  └──error (bounded retry, backoff)──┐
+           │──CRC mismatch on arrival────────────┤
+           │──deadline expiry (stall/wedge)──────┤
+           └──decode replica died at adopt───────┴─> replayed
+
+    ``replayed`` is the terminal fallback: the stream journal-replays
+    (recompute-prefill) on the decode pool from the request object —
+    degraded, never corrupted or lost."""
+
+    PENDING = "pending"
+    DELIVERED = "delivered"
+    REPLAYED = "replayed"
+
+    __slots__ = (
+        "id", "req", "payload", "source", "state", "created", "deadline",
+        "attempts", "next_attempt_at", "claimed",
+    )
+
+    def __init__(self, hid: int, req: Request, payload: KVHandoffPayload,
+                 source: str, now: float, timeout_s: Optional[float]):
+        self.id = hid
+        self.req = req
+        self.payload = payload
+        self.source = source  # prefill replica id (telemetry)
+        self.state = KVHandoff.PENDING
+        self.created = now
+        self.deadline = None if timeout_s is None else now + timeout_s
+        self.attempts = 0
+        self.next_attempt_at = now
+        self.claimed = False  # a thread is mid-transfer; guarded-by: manager lock
+
+
+class HandoffManager:
+    """The supervised prefill->decode transfer protocol: CRC-verified
+    per-block transfer onto the least-loaded eligible decode replica,
+    bounded retry with exponential backoff, deadline expiry for stalled
+    transfers, and decode-pool journal replay as the terminal fallback.
+
+    Transfers run wherever ``pump()`` is called from — the dedicated
+    handoff worker thread (when started; offers just notify it), the
+    prefill scheduler's loop thread (inline at offer, when no worker
+    is running), the disaggregated fleet's monitor thread, or a test's
+    step() driver — with a claim
+    flag so concurrent pumps never double-transfer one handoff, and a
+    post-transfer state re-check so a transfer that un-wedges AFTER its
+    deadline replayed the stream is discarded instead of adopting the
+    stream twice. The ``fleet.kv_handoff`` fault site wraps each
+    per-block wire copy: ``nan`` corrupts in flight (caught by CRC on
+    arrival), ``error`` fails the attempt into retry, ``stall`` wedges
+    the transfer until the deadline expires."""
+
+    OUTCOMES = ("ok", "corrupt", "error", "stalled")
+
+    def __init__(
+        self,
+        decode_fleet: "Fleet",
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        timeout_s: float = 30.0,
+        max_attempts: int = 3,
+        backoff_s: float = 0.05,
+        flight: Optional[FlightRecorder] = None,
+    ):
+        self.decode_fleet = decode_fleet
+        self.clock = clock
+        self.timeout_s = timeout_s
+        self.max_attempts = max(1, max_attempts)
+        self.backoff_s = backoff_s
+        self.flight = flight if flight is not None else FlightRecorder(
+            capacity=64, enabled=False, sched_clock=clock
+        )
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._worker: Optional[threading.Thread] = None
+        self._worker_stop = threading.Event()
+        self._hid = itertools.count()
+        self._inflight: Dict[int, KVHandoff] = {}  # guarded-by: _lock
+        # protocol counters (ints under the lock; prometheus families
+        # flexflow_serving_handoff_* render from prom())
+        self.transfers = {o: 0 for o in self.OUTCOMES}
+        self.bytes_total = 0
+        self.retries_total = 0
+        self.replay_fallbacks = 0
+        self.latency = Histogram()
+
+    # ------------------------------------------------------------ protocol
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def offer(self, req: Request, payload: KVHandoffPayload,
+              source: str) -> KVHandoff:
+        """Record one handoff (called from the prefill scheduler via its
+        handoff_sink). With the worker running the transfer is handed
+        to it — the prefill loop is back admitting the next prompt
+        while the blocks are still on the wire; without it (sync
+        drivers, tests) the fast path delivers in this call."""
+        now = self.clock()
+        h = KVHandoff(next(self._hid), req, payload, source, now, self.timeout_s)
+        with self._lock:
+            self._inflight[h.id] = h
+        self.flight.record_event(
+            "handoff_start", handoff=h.id, request_id=req.id,
+            source=source, n_blocks=len(payload.blocks),
+            payload_bytes=payload.nbytes,
+        )
+        w = self._worker
+        if w is not None and w.is_alive():
+            with self._lock:
+                self._cv.notify()
+        else:
+            self.pump()
+        return h
+
+    # ------------------------------------------------------------ worker
+    def start_worker(self) -> None:
+        """Run transfers on a dedicated thread instead of inline at
+        offer(): the transfer (fault-site wire copy, CRC verify,
+        decode-pool adopt) is serialized BEHIND prefill admissions when
+        pumped inline, which shows up directly in TTFT tails."""
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._worker_stop.clear()
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="kv-handoff", daemon=True
+        )
+        self._worker.start()
+
+    def stop_worker(self) -> None:
+        self._worker_stop.set()
+        with self._lock:
+            self._cv.notify_all()
+        w = self._worker
+        if w is not None:
+            w.join(timeout=5.0)
+            self._worker = None
+
+    def _worker_loop(self) -> None:
+        while not self._worker_stop.is_set():
+            try:
+                self.pump()
+            except Exception:
+                pass  # the worker must outlive any one transfer
+            with self._lock:
+                if self._worker_stop.is_set():
+                    return
+                # sleep until the earliest retry backoff comes due (or
+                # a fresh offer() notifies); cap the idle wait so a
+                # clock-skewed backoff can't wedge the thread
+                now = self.clock()
+                delay = 0.25
+                for h in self._inflight.values():
+                    if h.state == KVHandoff.PENDING and not h.claimed:
+                        delay = min(delay, max(0.001, h.next_attempt_at - now))
+                self._cv.wait(timeout=delay)
+
+    def pump(self) -> None:
+        """Run every due pending transfer (offer fast path, retry
+        backoffs that came due, handoffs that waited out a decode
+        brownout)."""
+        now = self.clock()
+        with self._lock:
+            due = [
+                h for h in list(self._inflight.values())
+                if h.state == KVHandoff.PENDING and not h.claimed
+                and now >= h.next_attempt_at
+            ]
+            for h in due:
+                h.claimed = True
+        for h in due:
+            try:
+                self._attempt(h)
+            finally:
+                with self._lock:
+                    h.claimed = False
+
+    def check(self, now: Optional[float] = None) -> None:
+        """Supervisor sweep: expire pending handoffs past their
+        deadline (a stalled/wedged transfer) into replay fallback, then
+        pump whatever is due."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            expired = [
+                h for h in list(self._inflight.values())
+                if h.state == KVHandoff.PENDING and h.deadline is not None
+                and now >= h.deadline
+            ]
+            for h in expired:
+                h.state = KVHandoff.REPLAYED
+                self._inflight.pop(h.id, None)
+        for h in expired:
+            self._replay(h, "stalled")
+        self.pump()
+
+    # ------------------------------------------------------------ internals
+    def _attempt(self, h: KVHandoff) -> None:
+        req, payload = h.req, h.payload
+        if req.handle.done():  # cancelled/expired while in flight
+            with self._lock:
+                self._inflight.pop(h.id, None)
+            self.flight.record_event(
+                "handoff_dropped", handoff=h.id, request_id=req.id
+            )
+            return
+        target = self.decode_fleet.router.place_failover(
+            self.decode_fleet._replicas_snapshot()
+        )
+        if target is None:
+            # decode brownout: stay pending — a replacement replica or
+            # the deadline (-> replay into the decode fleet's pending
+            # queue) resolves it
+            return
+        try:
+            wire: List[PackedBlock] = []
+            for pb in payload.blocks:
+                hk, hv = faults.inject(
+                    faults.FLEET_KV_HANDOFF, (pb.host_k, pb.host_v)
+                )
+                wire.append(
+                    PackedBlock(np.asarray(hk), np.asarray(hv), crc=pb.crc)
+                )
+        except Exception as e:
+            with self._lock:
+                if h.state != KVHandoff.PENDING:
+                    return
+                h.attempts += 1
+                exhausted = h.attempts >= self.max_attempts
+                if exhausted:
+                    h.state = KVHandoff.REPLAYED
+                    self._inflight.pop(h.id, None)
+                else:
+                    self.retries_total += 1
+                    h.next_attempt_at = (
+                        self.clock()
+                        + self.backoff_s * (2 ** (h.attempts - 1))
+                    )
+            if exhausted:
+                self._replay(h, "error", cause=e)
+            else:
+                self.flight.record_event(
+                    "handoff_retry", handoff=h.id, request_id=req.id,
+                    attempt=h.attempts, error=repr(e)[:200],
+                )
+            return
+        arrived = KVHandoffPayload(
+            payload.n_positions, payload.block_size, wire
+        )
+        intact = arrived.verify()
+        outcome = None
+        with self._lock:
+            if h.state != KVHandoff.PENDING:
+                return  # expired and replayed while this transfer was wedged
+            if not intact:
+                outcome = "corrupt"
+            elif h.deadline is not None and self.clock() >= h.deadline:
+                # a stall-mode wedge that finally un-blocked, too late:
+                # the deadline owns this handoff even if check() has not
+                # swept it yet
+                outcome = "stalled"
+            if outcome is not None:
+                h.state = KVHandoff.REPLAYED
+                self._inflight.pop(h.id, None)
+            else:
+                h.state = KVHandoff.DELIVERED
+                self._inflight.pop(h.id, None)
+        if outcome is not None:
+            self._replay(h, outcome)
+            return
+        try:
+            target.scheduler.adopt(req, front=True, imported=arrived)
+        except Exception as e:
+            # the chosen decode replica died between pick and adopt;
+            # fall back to recompute placement (which pends if the
+            # whole pool browned out)
+            self._replay(h, "error", cause=e)
+            return
+        with self._lock:
+            self.transfers["ok"] += 1
+            self.bytes_total += arrived.nbytes
+        self.latency.observe(max(0.0, self.clock() - h.created))
+        self.flight.record_event(
+            "handoff_delivered", handoff=h.id, request_id=req.id,
+            source=h.source, target=target.id, attempts=h.attempts + 1,
+        )
+        try:
+            req.trace.event(
+                "kv_handoff", source=h.source, target=target.id,
+                n_blocks=len(wire),
+            )
+        except Exception:
+            pass  # telemetry must not disturb an adopted stream
+
+    def _replay(self, h: KVHandoff, outcome: str,
+                cause: Optional[BaseException] = None) -> None:
+        """Terminal fallback: journal-replay the stream on the decode
+        pool (recompute-prefill from the request object — byte-exact).
+        ``_place`` pends the request if the pool has no eligible
+        replica, so even a brownout degrades to waiting, not loss."""
+        with self._lock:
+            self.transfers[outcome] = self.transfers.get(outcome, 0) + 1
+            self.replay_fallbacks += 1
+        self.flight.record_event(
+            "handoff_replay", handoff=h.id, request_id=h.req.id,
+            outcome=outcome,
+            **({"error": repr(cause)[:200]} if cause is not None else {}),
+        )
+        try:
+            h.req.trace.event("kv_handoff_replay", outcome=outcome)
+        except Exception:
+            pass
+        self.decode_fleet._place([h.req])
+
+    # ------------------------------------------------------------- reports
+    def report(self) -> Dict:
+        now = self.clock()
+        with self._lock:
+            in_flight = [
+                {
+                    "id": h.id,
+                    "request_id": h.req.id,
+                    "source": h.source,
+                    "attempts": h.attempts,
+                    "age_s": max(0.0, now - h.created),
+                    "deadline_in_s": (
+                        None if h.deadline is None else h.deadline - now
+                    ),
+                    "bytes": h.payload.nbytes,
+                }
+                for h in self._inflight.values()
+            ]
+            transfers = dict(self.transfers)
+        return {
+            "in_flight": in_flight,
+            "transfers": transfers,
+            "bytes_total": self.bytes_total,
+            "retries_total": self.retries_total,
+            "replay_fallbacks_total": self.replay_fallbacks,
+            "latency": self.latency.snapshot(),
+        }
+
+    def prom(self) -> Dict:
+        """The ``handoff`` block of a disaggregated fleet's prom_fleet()
+        payload (obs/prom.py renders the flexflow_serving_handoff_*
+        families from it)."""
+        with self._lock:
+            transfers = dict(self.transfers)
+        return {
+            "transfers": transfers,
+            "bytes_total": self.bytes_total,
+            "replay_fallbacks_total": self.replay_fallbacks,
+            "latency": self.latency.snapshot(),
+        }
+
+
+class DisaggregatedFleet:
+    """Disaggregated serving: a prefill pool and a decode pool with
+    independently chosen layouts, joined by the supervised KV handoff
+    (DistServe OSDI'24 / Splitwise ISCA'24 — prefill's compute-bound
+    bursts and decode's latency-bound steady state stop interfering
+    when they stop sharing replicas).
+
+    Requests admit on the prefill pool (full router treatment: typed
+    overload/priority rejections, prefix affinity, least-loaded). The
+    prefill replica emits the FIRST token — TTFT comes from a pool
+    that never competes with decode steps — then packs the prompt's KV
+    into the CRC-stamped wire format and hands the stream to the
+    :class:`HandoffManager`, which delivers it onto the least-loaded
+    decode replica via ``adopt(imported=...)``. Decode replicas never
+    prefill in steady state, so TPOT stops paying prefill bursts.
+
+    Each pool is a full :class:`Fleet` — drain/replace/failover,
+    overload control, and autoscale signals all work per pool, and a
+    prefill replica that dies AFTER its payload packed is harmless (the
+    wire format is host-resident and engine-agnostic). Pool TP degrees
+    are free to differ: the wire carries full-head blocks and the
+    importing engine's jitted block writer reshards onto its own
+    partitioning (search/serving_strategy.choose_pool_strategies picks
+    the per-pool degrees). Duck-types :class:`GenerationModel` /
+    :class:`Fleet` so the server and existing tooling work unchanged.
+    """
+
+    def __init__(
+        self,
+        prefill_factory: Callable[[], GenerationEngine],
+        decode_factory: Optional[Callable[[], GenerationEngine]] = None,
+        *,
+        n_prefill: int = 1,
+        n_decode: int = 1,
+        name: str = "generator",
+        clock: Callable[[], float] = time.monotonic,
+        handoff_timeout_s: float = 30.0,
+        handoff_max_attempts: int = 3,
+        handoff_backoff_s: float = 0.05,
+        warm_handoff: bool = True,
+        poll_s: float = 0.25,
+        **fleet_kwargs,
+    ):
+        self.name = name
+        self.clock = clock
+        self.poll_s = poll_s
+        self._started = False
+        self._stopped = False
+        self._monitor: Optional[threading.Thread] = None
+        self._monitor_stop = threading.Event()
+        # handoff lifecycle events land on the disagg fleet's own ring
+        # (GET /v2/fleet shows them alongside both pools' events)
+        self.fleet_flight = FlightRecorder(
+            capacity=256,
+            enabled=bool(fleet_kwargs.get("observability", True)),
+            sched_clock=clock,
+        )
+        # decode pool first: the handoff sink needs a live target pool
+        # before the first prefill replica can take traffic
+        self.decode = Fleet(
+            decode_factory or prefill_factory, n_decode, name=name,
+            clock=clock, rid_prefix="d", poll_s=poll_s, **fleet_kwargs,
+        )
+        self.handoff = HandoffManager(
+            self.decode, clock=clock, timeout_s=handoff_timeout_s,
+            max_attempts=handoff_max_attempts, backoff_s=handoff_backoff_s,
+            flight=self.fleet_flight,
+        )
+        self.prefill = Fleet(
+            prefill_factory, n_prefill, name=name, clock=clock,
+            rid_prefix="p", poll_s=poll_s,
+            handoff_sink=self._on_prefill_done, **fleet_kwargs,
+        )
+        if warm_handoff and fleet_kwargs.get("warmup", True):
+            # one end-to-end request through the handoff path: the
+            # pack/import block programs (kv_block_read on prefill,
+            # kv_block_write on decode) compile here, NOT on the first
+            # real request — zero steady-state retraces, same contract
+            # as Fleet warmup
+            self._warm_handoff()
+
+    # ------------------------------------------------------------- serving
+    def _on_prefill_done(self, req: Request, payload: KVHandoffPayload,
+                         replica: Replica) -> None:
+        self.handoff.offer(req, payload, replica.id)
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        sampling: Optional[SamplingParams] = None,
+        deadline_s: Optional[float] = None,
+        speculation=None,
+        transport: Optional[str] = None,
+        priority: Optional[str] = None,
+    ) -> GenerationHandle:
+        """Admission is the prefill pool's: its router places the
+        request (affinity/least-loaded/spill) and its overload
+        machinery raises the typed rejections. The stream's decode
+        residency arrives via the handoff."""
+        if self._stopped:
+            raise ShuttingDownError("fleet stopped")
+        return self.prefill.submit(
+            prompt, sampling, deadline_s=deadline_s, speculation=speculation,
+            transport=transport, priority=priority,
+        )
+
+    def generate(
+        self,
+        prompt: Sequence[int],
+        sampling: Optional[SamplingParams] = None,
+        timeout: Optional[float] = None,
+        speculation=None,
+    ) -> List[int]:
+        handle = self.submit(
+            prompt, sampling, deadline_s=timeout, speculation=speculation
+        )
+        if not self._started:
+            self._drive(handle)
+        return handle.result(timeout=timeout)
+
+    def _drive(self, handle: GenerationHandle, max_steps: int = 100000) -> None:
+        """Synchronous drive for unstarted fleets (warmup, tests): step
+        both pools + the handoff supervisor until the handle settles."""
+        for _ in range(max_steps):
+            if handle.done():
+                return
+            if not self.step() and handle.done():
+                return
+
+    def _warm_handoff(self) -> None:
+        warm = list(self.prefill.warm_prompt)
+        try:
+            handle = self.submit(
+                warm, SamplingParams(max_new_tokens=max(2, self.prefill.warm_tokens))
+            )
+            self._drive(handle, max_steps=10000)
+            handle.result(timeout=60.0)
+        except Exception:
+            # warmup must never fail construction — the first real
+            # handoff just pays the compile instead
+            pass
+        # the end-to-end request above compiled the wire programs on ONE
+        # replica per pool; warm the rest with a self-roundtrip (pack
+        # block 0, import it back — bit-identical, so it is safe even if
+        # block 0 is live) so no replica retraces on its first handoff
+        for rep in (self.prefill._replicas_snapshot()
+                    + self.decode._replicas_snapshot()):
+            try:
+                eng = rep.engine
+                payload = eng.pack_kv_blocks([0], eng.cache_config.block_size)
+                eng.import_kv_blocks([0], payload.blocks)
+            except Exception:
+                pass
+
+    # ---------------------------------------------------------- supervisor
+    def check(self) -> None:
+        self.prefill.check()
+        self.handoff.check()
+        self.decode.check()
+
+    def step(self) -> bool:
+        """One synchronous iteration across both pools + the handoff
+        supervisor (virtual-clock tests, warmup drive)."""
+        did = self.prefill.step()
+        self.handoff.check()
+        did = self.decode.step() or did
+        return did or self.handoff.in_flight > 0
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        self.prefill.start()
+        self.decode.start()
+        self.handoff.start_worker()
+        self._started = True
+        self._stopped = False
+        self._monitor_stop.clear()
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._monitor_stop.wait(timeout=self.poll_s):
+            try:
+                self.handoff.check()
+            except Exception:
+                pass  # the handoff supervisor must outlive any one sweep
+
+    def stop(self, drain: bool = True) -> None:
+        """Prefill pool first (stops new admissions; queued work
+        finishes and hands off), then the in-flight handoffs drain (or
+        expire into replay), then the decode pool."""
+        self._monitor_stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        self.prefill.stop(drain=drain)
+        # late handoffs from the draining prefills were still offered
+        # to the worker; stop it only after the prefill pool is quiet
+        # (offers after this pump inline — the sync path)
+        self.handoff.stop_worker()
+        if drain:
+            # real wall clock on purpose: this bounds a shutdown wait
+            # (self.clock may be virtual in tests, and a frozen clock
+            # must not wedge stop() forever)
+            deadline = time.monotonic() + 10.0  # flexlint: disable=clock-discipline
+            while self.handoff.in_flight and time.monotonic() < deadline:  # flexlint: disable=clock-discipline
+                self.handoff.check()
+                time.sleep(0.01)
+        self.decode.stop(drain=drain)
+        self._started = False
+        self._stopped = True
+
+    def ready(self) -> bool:
+        return self.prefill.ready() and self.decode.ready()
+
+    def has_work(self) -> bool:
+        return (
+            self.prefill.has_work()
+            or self.decode.has_work()
+            or self.handoff.in_flight > 0
+        )
+
+    # ------------------------------------------- GenerationModel surface
+    @property
+    def replicas(self) -> List[Replica]:
+        """Both pools' replicas (distinct id namespaces: p*/d*) — the
+        server's per-replica debug endpoints and /v2/fleet inclusion
+        key off this."""
+        return (
+            self.prefill._replicas_snapshot()
+            + self.decode._replicas_snapshot()
+        )
+
+    def _replicas_snapshot(self) -> List[Replica]:
+        return self.replicas
+
+    def states(self) -> Dict[str, int]:
+        out = self.prefill.states()
+        for k, v in self.decode.states().items():
+            out[k] = out.get(k, 0) + v
+        return out
+
+    @property
+    def breaker(self):
+        return _FleetBreakerView(self)
+
+    @property
+    def stats(self):
+        return _DisaggAggregateStats(self)
+
+    @property
+    def trace_ring(self):
+        return _MergedTraceRing(self)
+
+    @property
+    def flight(self):
+        return self.fleet_flight
+
+    @property
+    def capacity(self):
+        return None
+
+    @property
+    def slo(self):
+        return None
+
+    def cache_report(self) -> Dict:
+        return {
+            r.id: r.model.cache_report() for r in self.replicas
+        }
+
+    def readiness_rationale(self) -> Dict:
+        return {
+            "ready": self.ready(),
+            "fleet": True,
+            "disaggregated": True,
+            "pools": {
+                "prefill": self.prefill.readiness_rationale(),
+                "decode": self.decode.readiness_rationale(),
+            },
+            "handoffs_in_flight": self.handoff.in_flight,
+        }
+
+    sampling_from = staticmethod(GenerationModel.sampling_from)
+    speculation_from = staticmethod(GenerationModel.speculation_from)
+
+    def metadata(self) -> Dict:
+        md = self.prefill._replicas_snapshot()[0].model.metadata()
+        md["fleet"] = {
+            "disaggregated": True,
+            "pools": {
+                "prefill": {
+                    "replicas": len(self.prefill._replicas_snapshot()),
+                    "states": self.prefill.states(),
+                },
+                "decode": {
+                    "replicas": len(self.decode._replicas_snapshot()),
+                    "states": self.decode.states(),
+                },
+            },
+            "handoff_timeout_s": self.handoff.timeout_s,
+        }
+        return md
+
+    # ----------------------------------------------------------- reports
+    def report(self) -> Dict:
+        """GET /v2/fleet payload: the pools block (each pool's full
+        fleet report) + the handoffs block (in-flight transfers and
+        protocol counters) + the disagg-level lifecycle events."""
+        return {
+            "name": self.name,
+            "disaggregated": True,
+            "pools": {
+                "prefill": self.prefill.report(),
+                "decode": self.decode.report(),
+            },
+            "handoffs": self.handoff.report(),
+            "recent_events": self.fleet_flight.snapshot(32),
+        }
+
+    def autoscale_report(self) -> Dict:
+        return {
+            "disaggregated": True,
+            "pools": {
+                "prefill": self.prefill.autoscale_report(),
+                "decode": self.decode.autoscale_report(),
+            },
+        }
+
+    def prom_fleet(self) -> Dict:
+        """Unified families render from the pool-merged view (states,
+        lifecycle counters, router decisions); the pools/handoff keys
+        add the flexflow_serving_fleet_pool_replicas and
+        flexflow_serving_handoff_* families (key-gated in obs/prom.py,
+        so plain fleets render unchanged)."""
+        p = self.prefill.prom_fleet()
+        d = self.decode.prom_fleet()
+        decisions = dict(p["router_decisions"])
+        for k, v in d["router_decisions"].items():
+            decisions[k] = decisions.get(k, 0) + v
+        return {
+            "states": self.states(),
+            "failovers_total": p["failovers_total"] + d["failovers_total"],
+            "migrated_streams_total": (
+                p["migrated_streams_total"] + d["migrated_streams_total"]
+            ),
+            "replaced_total": p["replaced_total"] + d["replaced_total"],
+            "router_decisions": decisions,
+            "autoscale": p["autoscale"],
+            "pools": {
+                "prefill": {"states": self.prefill.states()},
+                "decode": {"states": self.decode.states()},
+            },
+            "handoff": self.handoff.prom(),
+        }
+
+
+class _DisaggAggregateStats:
+    """``/v2/stats`` view of a disaggregated fleet: summed admission
+    counters and load gauges across both pools (a stream submits on
+    prefill and completes on decode, so each counter increments in
+    exactly one pool), with the per-pool snapshots and the handoff
+    protocol counters nested."""
+
+    def __init__(self, dfleet: "DisaggregatedFleet"):
+        self._dfleet = dfleet
+
+    def snapshot(self) -> Dict:
+        from .stats import ServingStats
+
+        pre = self._dfleet.prefill.stats.snapshot()
+        dec = self._dfleet.decode.stats.snapshot()
+        out: Dict = {}
+        for c in ServingStats.COUNTERS:
+            out[c] = int(pre.get(c) or 0) + int(dec.get(c) or 0)
+        for g in _FleetAggregateStats._SUM_GAUGES:
+            out[g] = (pre.get(g) or 0) + (dec.get(g) or 0)
+        out["disaggregated"] = True
+        out["pools"] = {"prefill": pre, "decode": dec}
+        out["handoff"] = self._dfleet.handoff.report()
+        return out

@@ -269,6 +269,10 @@ class RecoveryStats:
                        kept going
       watchdog_trips   stalled device steps detected by the watchdog
       engine_failures  restart budgets exhausted (engine declared dead)
+      kv_imports       handed-off KV payloads committed into this
+                       engine's cache (disaggregated decode admission)
+      kv_imports_rejected  imported payloads rejected (CRC/geometry/
+                       injected fault) and recovered by recompute
 
     Writers: the scheduler loop thread and the watchdog thread; the
     lock keeps increments exact so chaoscheck can assert counts.
@@ -277,6 +281,7 @@ class RecoveryStats:
     FIELDS = (
         "recoveries", "step_retries", "replayed_tokens",
         "quarantined", "watchdog_trips", "engine_failures",
+        "kv_imports", "kv_imports_rejected",
     )
 
     def __init__(self):

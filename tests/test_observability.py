@@ -271,6 +271,9 @@ def _golden_stats():
     s.add_gauge("step_overlap_projected_tokens_per_s", lambda: 256)
     s.add_gauge("step_overlap_projected_speedup", lambda: 2)
     s.add_gauge("step_anatomy_steps_observed", lambda: 7)
+    # ISSUE 16 disaggregated-serving KV import counters (binary-exact)
+    s.add_gauge("kv_imports", lambda: 2)
+    s.add_gauge("kv_imports_rejected", lambda: 1)
     return s
 
 
@@ -321,6 +324,15 @@ def _golden_replica_stats():
     return s
 
 
+def _golden_handoff_latency():
+    """Deterministic handoff-latency histogram (binary-exact observes
+    landing in distinct buckets)."""
+    h = Histogram()
+    h.observe(0.0625)
+    h.observe(0.25)
+    return h.snapshot()
+
+
 _GOLDEN_FLEET = {
     "states": {"active": 1, "draining": 1, "dead": 0},
     "failovers_total": 1,
@@ -328,6 +340,19 @@ _GOLDEN_FLEET = {
     "replaced_total": 1,
     "router_decisions": {"affinity": 2, "least_loaded": 5, "spill": 1},
     "autoscale": {"signal": 1, "want_replicas": 3},
+    # ISSUE 16 disaggregated serving: per-pool states + the KV handoff
+    # protocol families (key-gated — unified fleets omit these keys and
+    # render exactly as before)
+    "pools": {
+        "prefill": {"states": {"active": 1, "draining": 0, "dead": 0}},
+        "decode": {"states": {"active": 2, "draining": 0, "dead": 1}},
+    },
+    "handoff": {
+        "transfers": {"ok": 4, "corrupt": 1, "error": 1, "stalled": 1},
+        "bytes_total": 4096,
+        "replay_fallbacks_total": 3,
+        "latency": _golden_handoff_latency(),
+    },
 }
 
 

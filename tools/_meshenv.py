@@ -9,18 +9,12 @@ import sys
 _FLAG = "xla_force_host_platform_device_count"
 
 
-def force_host_devices_for_mesh() -> None:
-    """Re-exec with ``--xla_force_host_platform_device_count=N`` when
-    the argv asks for ``--mesh N`` and the environment's XLA_FLAGS does
-    not already force at least N host devices (an existing LOWER count
-    gets bumped, not trusted). On a real multi-chip host the forced CPU
-    count is inert — jax serves the accelerator backend."""
-    if "--mesh" not in sys.argv:
-        return
-    try:
-        n = int(sys.argv[sys.argv.index("--mesh") + 1])
-    except (IndexError, ValueError):
-        return  # argparse rejects it properly later
+def force_host_devices(n: int) -> None:
+    """Re-exec with ``--xla_force_host_platform_device_count=n`` unless
+    the environment's XLA_FLAGS already forces at least that many host
+    devices (an existing LOWER count gets bumped, not trusted). On a
+    real multi-chip host the forced CPU count is inert — jax serves the
+    accelerator backend."""
     if n <= 1:
         return
     parts = os.environ.get("XLA_FLAGS", "").split()
@@ -37,3 +31,14 @@ def force_host_devices_for_mesh() -> None:
     parts.append(f"--{_FLAG}={n}")
     os.environ["XLA_FLAGS"] = " ".join(parts)
     os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
+def force_host_devices_for_mesh() -> None:
+    """:func:`force_host_devices` driven by an ``--mesh N`` argv."""
+    if "--mesh" not in sys.argv:
+        return
+    try:
+        n = int(sys.argv[sys.argv.index("--mesh") + 1])
+    except (IndexError, ValueError):
+        return  # argparse rejects it properly later
+    force_host_devices(n)

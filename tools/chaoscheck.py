@@ -53,6 +53,33 @@ mix against a live 2-replica Fleet —
                  everything on the survivor (the fleet stays ready);
                  nothing ever lands on the open replica
 
+Part 5 (``--disagg``) is the **disaggregated-serving sweep** (ISSUE
+16): the same request mix against a live prefill-pool + decode-pool
+fleet joined by the supervised KV-block handoff —
+
+  baseline       every stream prefills on the prefill pool, hands its
+                 KV off, and decodes on the decode pool byte-identically
+                 to a unified run; zero replay fallbacks
+  transfer error one per-block transfer fails (fleet.kv_handoff error)
+                 -> bounded retry with backoff delivers on the second
+                 attempt; byte-exact
+  corrupt        a block is corrupted in flight (fleet.kv_handoff nan)
+                 -> the CRC catches it on arrival -> decode-pool journal
+                 replay; byte-exact
+  prefill death  the prefill replica dies AFTER a stream's blocks
+                 shipped -> the decode-resident stream is untouched and
+                 the pool replaces the replica; a stream caught mid-
+                 prefill replays onto the replacement and still hands
+                 off; byte-exact
+  stalled        a handoff wedges on a gate (fleet.kv_handoff stall) ->
+                 the supervisor expires its deadline -> journal replay
+                 on the decode pool; the late un-wedged delivery is
+                 discarded (no double adoption); byte-exact
+  tp mismatch    prefill pool tp=1, decode pool tp=2 on a forced host
+                 mesh: the full-head wire format reshards on import and
+                 greedy + seeded-temperature streams match the unified
+                 tp=1 reference byte-for-byte
+
 Part 4 (``--overload``) is the **overload storm** (ISSUE 14): a
 loadgen-driven ~3x saturation burst (tools/loadgen.py Poisson schedule,
 priority mix) against one scheduler on a virtual clock — best-effort
@@ -63,7 +90,8 @@ level 2 and walk back to 0 after the burst without flapping
 unloaded run of the same prompt.
 
 Usage: python tools/chaoscheck.py [--sweep-only | --no-sweep] [--fleet]
-                                  [--overload] [extra pytest args]
+                                  [--overload] [--disagg]
+                                  [extra pytest args]
 """
 import argparse
 import json
@@ -78,9 +106,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 sys.path.insert(0, os.path.join(REPO, "tools"))
 
-from _meshenv import force_host_devices_for_mesh  # noqa: E402
+from _meshenv import force_host_devices, force_host_devices_for_mesh  # noqa: E402
 
 force_host_devices_for_mesh()
+if "--disagg" in sys.argv:
+    # the disagg sweep's tp-mismatch leg reshards a tp=1 prefill pool's
+    # KV onto a tp=2 decode pool — it needs 2 host devices
+    force_host_devices(2)
 
 
 def no_leaked_blocks(engine) -> bool:
@@ -668,6 +700,292 @@ def run_overload_sweep() -> bool:
     return not failures
 
 
+def run_disagg_sweep() -> bool:
+    """Disaggregated prefill/decode serving chaos (ISSUE 16): every
+    failure class of the KV-block handoff must terminate in a byte-
+    exact stream — delivered, retried, or journal-replayed on the
+    decode pool — never a corrupted or lost one."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO)
+
+    import jax
+
+    from flexflow_tpu.generation import (
+        GenerationEngine,
+        RecoveryPolicy,
+        SamplingParams,
+        init_decoder_params,
+    )
+    from flexflow_tpu.models.transformer import TransformerConfig
+    from flexflow_tpu.runtime import faults
+    from flexflow_tpu.runtime.faults import FaultPlan, replica_kill
+    from flexflow_tpu.search.serving_strategy import choose_pool_strategies
+    from flexflow_tpu.serving.fleet import DisaggregatedFleet
+
+    cfg = TransformerConfig(
+        num_layers=1, hidden_size=32, num_heads=4, ff_size=64,
+        seq_length=64, vocab_size=50, causal=True,
+    )
+    params = init_decoder_params(jax.random.key(0), cfg)
+
+    def factory(tp=None):
+        def make():
+            kw = {} if tp is None else {"tp_degree": tp}
+            return GenerationEngine(
+                params, cfg, max_batch_slots=3, block_size=8,
+                prompt_buckets=(8, 32, 64), **kw,
+            )
+        return make
+
+    prompts = [[1, 2, 3], [4, 5, 6, 7], [9, 8, 7, 6, 5], [1, 2, 3, 4, 4]]
+    sampling = SamplingParams(max_new_tokens=10)
+    tight = RecoveryPolicy(max_restarts=1, sleep=lambda _s: None)
+
+    # fault-free per-request unified reference (batch composition never
+    # changes a request's tokens — the PR 2 guarantee)
+    ref_eng = factory()()
+    ref = [ref_eng.generate([p], sampling)[0] for p in prompts]
+
+    report, failures = {}, []
+
+    def check(scenario, cond, msg):
+        if not cond:
+            failures.append(f"{scenario}: {msg}")
+
+    def make_disagg(**kw):
+        kw.setdefault("scheduler_kwargs", dict(recovery=tight))
+        return DisaggregatedFleet(factory(), n_prefill=1, n_decode=1, **kw)
+
+    def drive(dfleet, handles, steps=800):
+        for _ in range(steps):
+            if all(h.done() for h in handles):
+                return
+            dfleet.step()
+
+    # ------------------------------------------------ baseline handoff
+    dfleet = make_disagg()
+    warm_ok = dfleet.handoff.transfers["ok"]  # warm_handoff's transfer
+    handles = [dfleet.submit(p, sampling) for p in prompts]
+    drive(dfleet, handles)
+    got = [h.result(timeout=0) for h in handles]
+    ho = dfleet.handoff.report()
+    kv_imports = sum(
+        r.scheduler.recovery_stats.kv_imports
+        for r in dfleet.decode._replicas_snapshot()
+    )
+    check("baseline", got == ref,
+          f"disaggregated streams diverged from unified: {got} != {ref}")
+    check("baseline", ho["transfers"]["ok"] - warm_ok == len(prompts),
+          f"expected {len(prompts)} delivered handoffs, got {ho['transfers']}")
+    check("baseline", ho["replay_fallbacks_total"] == 0,
+          "fault-free run fell back to replay")
+    check("baseline", kv_imports >= len(prompts),
+          f"decode pool imported {kv_imports} payloads, want {len(prompts)}")
+    check("baseline", ho["bytes_total"] > 0, "no bytes accounted on the wire")
+    for pool in (dfleet.prefill, dfleet.decode):
+        for r in pool._replicas_snapshot():
+            check("baseline", no_leaked_blocks(r.engine),
+                  f"leaked blocks on {r.id}")
+    report["baseline"] = {"transfers": ho["transfers"],
+                          "bytes_total": ho["bytes_total"],
+                          "kv_imports": kv_imports, "exact": got == ref}
+
+    # ----------------------------------- transfer error -> bounded retry
+    dfleet = make_disagg()
+    base = dict(dfleet.handoff.transfers)
+    plan = FaultPlan(seed=0)
+    plan.on(faults.FLEET_KV_HANDOFF, mode="error",
+            error=RuntimeError("injected transfer failure"), nth=(0,))
+    with plan.active():
+        handles = [dfleet.submit(p, sampling) for p in prompts]
+        drive(dfleet, handles)
+    got = [h.result(timeout=0) for h in handles]
+    ho = dfleet.handoff.report()
+    check("retry", got == ref, f"streams diverged after retry: {got} != {ref}")
+    check("retry", ho["retries_total"] == 1,
+          f"retries_total = {ho['retries_total']}, want 1")
+    check("retry", ho["transfers"]["ok"] - base["ok"] == len(prompts),
+          "retried handoff was not delivered")
+    check("retry", ho["replay_fallbacks_total"] == 0,
+          "a single transfer error must retry, not replay")
+    report["retry"] = {"retries": ho["retries_total"], "exact": got == ref}
+
+    # ------------------------------- corrupt in flight -> CRC -> replay
+    dfleet = make_disagg()
+    base = dict(dfleet.handoff.transfers)
+    plan = FaultPlan(seed=0)
+    plan.on(faults.FLEET_KV_HANDOFF, mode="nan", nth=(0,))
+    with plan.active():
+        handles = [dfleet.submit(p, sampling) for p in prompts]
+        drive(dfleet, handles)
+    got = [h.result(timeout=0) for h in handles]
+    ho = dfleet.handoff.report()
+    check("corrupt", got == ref,
+          f"streams diverged after corrupt-block replay: {got} != {ref}")
+    check("corrupt", ho["transfers"]["corrupt"] - base["corrupt"] == 1,
+          f"CRC did not catch the corruption: {ho['transfers']}")
+    check("corrupt", ho["replay_fallbacks_total"] == 1,
+          f"replay_fallbacks = {ho['replay_fallbacks_total']}, want 1")
+    check("corrupt", ho["transfers"]["ok"] - base["ok"] == len(prompts) - 1,
+          "clean handoffs were disturbed by the corrupted one")
+    report["corrupt"] = {"transfers": ho["transfers"],
+                         "replay_fallbacks": ho["replay_fallbacks_total"],
+                         "exact": got == ref}
+
+    # --------------------- prefill replica death AFTER blocks shipped
+    # stream A hands off, then its origin replica starts dying on every
+    # prefill while A is still decoding: A must be untouched (the wire
+    # format is host-resident). A fresh request's prefill failure is
+    # attributed to the REQUEST (fail fast — PR 1 blame semantics), so
+    # the replica-death signal is the breaker: five consecutive prefill
+    # failures hold it OPEN, the pool supervisor drains the replica and
+    # replaces it, and a follow-up stream lands on the replacement and
+    # still hands off byte-exactly
+    dfleet = make_disagg()
+    base_ok = dfleet.handoff.transfers["ok"]
+    h_a = dfleet.submit(prompts[0], sampling)
+    for _ in range(200):
+        if dfleet.handoff.transfers["ok"] > base_ok:
+            break
+        dfleet.step()
+    check("prefill_death", dfleet.handoff.transfers["ok"] == base_ok + 1,
+          "stream A's blocks never shipped")
+    check("prefill_death", not h_a.done(), "stream A finished too early "
+          "(nothing left decoding through the murder)")
+    p0 = dfleet.prefill._replicas_snapshot()[0]
+    plan = FaultPlan(seed=0)
+    # prefill-pool replicas never run decode steps in steady state —
+    # the kill must hit the prefill program itself
+    replica_kill(plan, p0.id, site=faults.GENERATION_PREFILL, every=1)
+    with plan.active():
+        victims = [dfleet.submit(prompts[1], sampling) for _ in range(5)]
+        # Fleet.step() runs the supervisor check inline, so the breaker-
+        # open -> drain -> replace ladder completes during this drive
+        drive(dfleet, victims + [h_a])
+    got_a = h_a.result(timeout=0)
+    check("prefill_death", got_a == ref[0],
+          "decode-resident stream A diverged when its prefill replica died")
+    for h in victims:
+        try:
+            h.result(timeout=0)
+            check("prefill_death", False,
+                  "a request admitted on the dying replica did not fail")
+        except Exception:
+            pass
+    check("prefill_death", p0.model.breaker.state == "open",
+          f"breaker did not open on the failure storm: {p0.model.breaker.state}")
+    pfs = dfleet.prefill.fleet_stats.snapshot()
+    dfs = dfleet.decode.fleet_stats.snapshot()
+    check("prefill_death", pfs["drains"] == 1 and pfs["replaced"] == 1,
+          f"prefill pool lifecycle wrong: {pfs}")
+    check("prefill_death", dfs["drains"] == 0 and dfs["failovers"] == 0,
+          "the murder leaked into the decode pool")
+    check("prefill_death", p0.id not in
+          [r.id for r in dfleet.prefill._replicas_snapshot()],
+          "murdered prefill replica still in the pool")
+    # the replacement replica must have the handoff sink installed
+    h_c = dfleet.submit(prompts[2], sampling)
+    drive(dfleet, [h_c])
+    got_c = h_c.result(timeout=0)
+    check("prefill_death", got_c == ref[2],
+          "follow-up stream on the replacement replica diverged")
+    check("prefill_death", dfleet.handoff.transfers["ok"] == base_ok + 2,
+          "follow-up stream did not hand off from the replacement")
+    report["prefill_death"] = {
+        "prefill": {k: pfs[k] for k in ("drains", "replaced")},
+        "exact": got_a == ref[0] and got_c == ref[2],
+    }
+
+    # -------------------- stalled handoff -> deadline expiry -> replay
+    # live mode: the transfer wedges on the gate inside the dedicated
+    # handoff worker thread (started by dfleet.start()); the disagg
+    # monitor's supervisor sweep must expire the deadline and
+    # journal-replay on the decode pool while the transfer is still
+    # wedged, and the late un-wedged delivery must be discarded
+    dfleet = make_disagg(handoff_timeout_s=1.0, poll_s=0.05)
+    base = dict(dfleet.handoff.transfers)
+    gate = threading.Event()
+    plan = FaultPlan(seed=0)
+    plan.on(faults.FLEET_KV_HANDOFF, mode="stall", gate=gate, nth=(0,))
+    with plan.active():
+        dfleet.start()
+        h_s = dfleet.submit(prompts[2], sampling)
+        got_s = h_s.result(timeout=30)
+        stalled_when_done = dict(dfleet.handoff.transfers)
+        gate.set()
+        # let the wedged transfer un-block and (correctly) do nothing
+        t0 = time.monotonic()
+        while dfleet.handoff.in_flight and time.monotonic() - t0 < 10:
+            time.sleep(0.02)
+        dfleet.stop()
+    ho = dfleet.handoff.report()
+    check("stalled", got_s == ref[2],
+          f"stream diverged after stall replay: {got_s} != {ref[2]}")
+    check("stalled", stalled_when_done["stalled"] - base["stalled"] == 1,
+          f"deadline expiry not recorded: {stalled_when_done}")
+    check("stalled", ho["transfers"]["ok"] == base["ok"],
+          "the late un-wedged delivery was adopted after the replay "
+          "(two schedulers owned one stream)")
+    check("stalled", ho["replay_fallbacks_total"] == 1,
+          f"replay_fallbacks = {ho['replay_fallbacks_total']}, want 1")
+    check("stalled", ho["in_flight"] == [], "handoff leaked in flight")
+    report["stalled"] = {"transfers": ho["transfers"],
+                         "replay_fallbacks": ho["replay_fallbacks_total"],
+                         "exact": got_s == ref[2]}
+
+    # ------------------- TP mismatch: tp=1 prefill -> tp=2 decode pool
+    # the wire carries full-head blocks; the decode engine's jitted
+    # block writer reshards them onto its 2-way partitioning on import
+    if len(jax.devices()) >= 2:
+        choices = choose_pool_strategies(
+            cfg, 2, pinned_prefill_tp=1, pinned_decode_tp=2,
+        )
+        check("tp_mismatch",
+              choices["prefill"].tp_degree == 1
+              and choices["decode"].tp_degree == 2,
+              "choose_pool_strategies did not honor the per-pool pins")
+        dfleet = DisaggregatedFleet(
+            factory(tp=1), factory(tp=2), n_prefill=1, n_decode=1,
+            scheduler_kwargs=dict(recovery=tight),
+        )
+        base_ok = dfleet.handoff.transfers["ok"]
+        temp = SamplingParams(max_new_tokens=10, temperature=0.8, seed=11)
+        exact = True
+        for samp in (sampling, temp):
+            refs = [ref_eng.generate([p], samp)[0] for p in prompts]
+            handles = [dfleet.submit(p, samp) for p in prompts]
+            drive(dfleet, handles)
+            got = [h.result(timeout=0) for h in handles]
+            if got != refs:
+                exact = False
+                check("tp_mismatch", False,
+                      f"resharded streams diverged ({samp.temperature=}): "
+                      f"{got} != {refs}")
+        ho = dfleet.handoff.report()
+        check("tp_mismatch", ho["transfers"]["ok"] - base_ok == 2 * len(prompts),
+              f"resharded handoffs not all delivered: {ho['transfers']}")
+        check("tp_mismatch", ho["replay_fallbacks_total"] == 0,
+              "TP-mismatch handoff fell back to replay")
+        report["tp_mismatch"] = {
+            "prefill_tp": 1, "decode_tp": 2,
+            "transfers": ho["transfers"], "exact": exact,
+        }
+    else:
+        report["tp_mismatch"] = {"skipped": f"{len(jax.devices())} device(s)"}
+
+    report["ok"] = not failures
+    print(json.dumps({"disagg_sweep": report}, indent=2))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print("OK: disagg sweep — handoffs delivered byte-exactly; transfer "
+              "error retried, corruption CRC-caught, prefill death isolated, "
+              "and a stalled handoff expired into decode-pool journal "
+              "replay, all byte-identical to the unified run; tp=1 -> tp=2 "
+              "resharded handoff exact")
+    return not failures
+
+
 def run_mesh_sweep(n: int) -> bool:
     """Sharded-generation chaos (ISSUE 15): a tp=N engine over a forced
     N-device host mesh rides the SAME self-healing ladder as the
@@ -827,6 +1145,10 @@ def main() -> int:
                     help="also run the overload storm (priority-ordered "
                          "shed, degrade-ladder hysteresis, byte-exact "
                          "survivors)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="also run the disaggregated-serving sweep (KV "
+                         "handoff retry/corrupt/stall/prefill-death + the "
+                         "tp-mismatch resharded handoff, all byte-exact)")
     ap.add_argument("--mesh", type=int, default=0, metavar="N",
                     help="run ONLY the sharded-generation sweep on a "
                          "forced N-device host mesh (failed/stalled "
@@ -858,6 +1180,9 @@ def main() -> int:
             rc = 1
     if args.overload and rc == 0:
         if not run_overload_sweep():
+            rc = 1
+    if args.disagg and rc == 0:
+        if not run_disagg_sweep():
             rc = 1
     return rc
 

@@ -38,6 +38,13 @@ Against a live server (serving/server.py):
       per-priority shed table, and the fleet autoscale signal — the
       "why is load being refused?" answer.
 
+  python tools/obsreport.py --url ... disagg
+      Disaggregated-serving view (GET /v2/fleet): per-pool replica
+      states and load, in-flight KV handoffs with deadlines, the
+      transfer outcome table (ok/corrupt/error/stalled), delivered
+      bytes, replay fallbacks, and handoff latency percentiles — the
+      "is the prefill->decode handoff healthy?" answer.
+
   python tools/obsreport.py --url ... anatomy [--capture K]
       [--anatomy-out anatomy.json]
       Step-anatomy view (GET /v2/debug/anatomy): per-kind phase
@@ -366,6 +373,54 @@ def show_overload(base: str) -> int:
               f"(current {rep['current_replicas']}, "
               f"sustained {rep['sustained_s']:.1f}s, "
               f"fleet_sheds={rep.get('fleet_sheds', 0)})")
+    return 0
+
+
+def show_disagg(base: str) -> int:
+    """Disaggregated-serving view (GET /v2/fleet): pool states + the
+    KV handoff protocol counters — the "is the prefill->decode handoff
+    healthy?" answer."""
+    payload = _get_json(f"{base}/v2/fleet")
+    shown = 0
+    for name, rep in sorted(payload.get("models", {}).items()):
+        if not rep.get("disaggregated"):
+            continue
+        shown += 1
+        print(f"fleet {name!r} (disaggregated):")
+        for pool in ("prefill", "decode"):
+            prep = rep["pools"][pool]
+            states = "  ".join(
+                f"{r['id']}={r['state']}(q={r['queue_depth']} "
+                f"run={r['running']})"
+                for r in prep.get("replicas", [])
+            )
+            print(f"    {pool:<8} pending={prep.get('pending', 0)}  {states}")
+        ho = rep.get("handoffs", {})
+        t = ho.get("transfers", {})
+        print(f"    handoffs: ok={t.get('ok', 0)} corrupt={t.get('corrupt', 0)} "
+              f"error={t.get('error', 0)} stalled={t.get('stalled', 0)}  "
+              f"retries={ho.get('retries_total', 0)}  "
+              f"replay_fallbacks={ho.get('replay_fallbacks_total', 0)}  "
+              f"bytes={ho.get('bytes_total', 0)}")
+        lat = ho.get("latency") or {}
+        if lat.get("count"):
+            mean = lat["sum"] / lat["count"]
+            print(f"    handoff latency: n={lat['count']} "
+                  f"mean={mean * 1e3:.2f}ms total={lat['sum'] * 1e3:.1f}ms")
+        inflight = ho.get("in_flight", [])
+        if inflight:
+            print("    in flight:")
+            for h in inflight:
+                dl = h.get("deadline_in_s")
+                print(f"      handoff {h['id']} req={h['request_id']} "
+                      f"from={h['source']} attempts={h['attempts']} "
+                      f"age={h['age_s']:.2f}s "
+                      f"deadline_in={'-' if dl is None else f'{dl:.2f}s'} "
+                      f"bytes={h['bytes']}")
+        else:
+            print("    in flight: (none)")
+    if not shown:
+        print("no disaggregated fleets registered")
     return 0
 
 
@@ -752,13 +807,15 @@ def main() -> int:
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("command", nargs="?", default="summary",
                     choices=("summary", "cache", "slo", "predict", "anatomy",
-                             "overload"),
+                             "overload", "disagg"),
                     help="view: summary (default), cache (block "
                          "residency), slo (burn rates), predict "
                          "(cost-model truth: error table + drift alarms), "
                          "anatomy (step phases, device bubble, overlap "
                          "headroom), overload (limiter state, ladder "
-                         "history, shed table, autoscale signal)")
+                         "history, shed table, autoscale signal), disagg "
+                         "(pool states, KV handoff outcomes + latency, "
+                         "in-flight transfers)")
     ap.add_argument("--url", default="", help="base URL of a running server")
     ap.add_argument("--request", type=int, default=None,
                     help="print one request's trace waterfall")
@@ -792,6 +849,8 @@ def main() -> int:
         return show_anatomy(base, capture=args.capture, out=args.anatomy_out)
     if args.command == "overload":
         return show_overload(base)
+    if args.command == "disagg":
+        return show_disagg(base)
     return summarize(base)
 
 

@@ -87,6 +87,15 @@ METRICS: Dict[str, Tuple[str, str, float]] = {
     # Wall-clock-derived -> the wide relative floors wall clocks get.
     "mesh_decode_tokens_per_s": ("higher", "rel", 0.25),
     "mesh_tokens_per_s_ratio": ("higher", "rel", 0.20),
+    # disaggregated serving A/B (ISSUE 16): unified/disagg ratios of
+    # interleaved best-of-N arms (steadier than raw wall clocks) — a
+    # TTFT ratio drop past the floor means the prefill pool stopped
+    # winning admissions, a TPOT ratio drop means the decode pool's
+    # interference-free steps stopped paying for the handoff; the raw
+    # disagg TTFT is a wall clock and gets the wide relative floor.
+    "disagg_ttft_p95_ratio": ("higher", "rel", 0.15),
+    "disagg_tpot_p50_ratio": ("higher", "rel", 0.12),
+    "disagg_ttft_p95_s": ("lower", "rel", 0.25),
 }
 
 
