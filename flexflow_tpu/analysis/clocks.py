@@ -14,13 +14,21 @@ What counts as a violation: a CALL to ``time.time`` /
 import monotonic`` aliases). A bare REFERENCE as a default argument
 (``clock: Callable[[], float] = time.monotonic``) is the injectable
 pattern itself and is always allowed.
+
+Exception: under ``CLOCK_STRICT_PATHS`` (the digital twin,
+``flexflow_tpu/sim/``) the rule runs in strict virtual-time mode —
+ANY reference to a real clock, call or not, perf_counter included, is
+a violation and the whitelist does not apply. The sim's determinism
+contract (two replays → byte-identical event traces) dies the moment
+one real stamp leaks in, and the simcheck gate's sim-vs-live bound
+stops meaning anything.
 """
 from __future__ import annotations
 
 import ast
 from typing import Dict, FrozenSet, List, Union
 
-from .config import CLOCK_WHITELIST
+from .config import CLOCK_STRICT_PATHS, CLOCK_WHITELIST
 from .core import Context, Finding, Rule, SourceFile
 
 CLOCK_FUNCS = frozenset({"time", "monotonic", "perf_counter"})
@@ -68,6 +76,8 @@ class ClockRule(Rule):
                 for a in node.names:
                     if a.name == "time":
                         mod_aliases.add(a.asname or a.name)
+        if any(f.relpath.startswith(p) for p in CLOCK_STRICT_PATHS):
+            return self._check_strict(f, aliases, mod_aliases)
         out: List[Finding] = []
         for node in ast.walk(f.tree):
             if not isinstance(node, ast.Call):
@@ -89,5 +99,47 @@ class ClockRule(Rule):
                 f"direct wall-clock call time.{func}(); use the injectable "
                 "clock (or whitelist the file in analysis/config.py with a "
                 "reason)",
+            ))
+        return out
+
+    def _check_strict(
+        self,
+        f: SourceFile,
+        aliases: Dict[str, str],
+        mod_aliases: FrozenSet[str],
+    ) -> List[Finding]:
+        """Strict virtual-time mode: every reference counts, imports
+        included, whitelist ignored. Flagging the reference (not just
+        the call) means even the injectable-default idiom is out —
+        the sim has exactly one clock and it is the event loop's."""
+        out: List[Finding] = []
+        for node in ast.walk(f.tree):
+            func = None
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name in CLOCK_FUNCS:
+                        out.append(Finding(
+                            self.name, f.relpath, node.lineno,
+                            f"real-clock import time.{a.name} under the "
+                            "strict virtual-time path; the sim runs on the "
+                            "event loop's virtual clock only",
+                        ))
+                continue
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in mod_aliases
+                and node.attr in CLOCK_FUNCS
+            ):
+                func = node.attr
+            elif isinstance(node, ast.Name) and node.id in aliases:
+                func = aliases[node.id]
+            if func is None:
+                continue
+            out.append(Finding(
+                self.name, f.relpath, node.lineno,
+                f"real-clock reference time.{func} under the strict "
+                "virtual-time path (flexflow_tpu/sim/ is deterministic by "
+                "contract); use the event loop's virtual clock",
             ))
         return out

@@ -41,6 +41,15 @@ CLOCK_WHITELIST: Dict[str, Union[str, FrozenSet[str]]] = {
     "flexflow_tpu/obs/steptrace.py": frozenset({"perf_counter"}),
 }
 
+# Paths where clock-discipline runs in STRICT virtual-time mode: ANY
+# reference to a real clock — a call, a bare name, an injectable
+# default argument, even perf_counter — is a violation, and the
+# whitelist above does not apply. The fleet digital twin
+# (flexflow_tpu/sim/) is deterministic by contract: its only time
+# source is the event loop's virtual clock, and a single real stamp
+# breaks byte-identical replay and the simcheck divergence gate.
+CLOCK_STRICT_PATHS = ("flexflow_tpu/sim/",)
+
 # ----------------------------------------------------------- fault sites
 # Files the fault-site rule does not police: the registry itself (it
 # DEFINES the literals) and this analysis package (rule fixtures).

@@ -79,7 +79,7 @@ from ..generation.scheduler import GenerationHandle, Request
 from ..obs import FlightRecorder
 from ..runtime import faults
 from .generation import GenerationModel
-from .overload import AutoscaleAdvisor, Priority
+from .overload import AutoscaleAdvisor, OverloadConfig, Priority
 from .resilience import (
     CircuitOpenError,
     DeadlineExceededError,
@@ -477,8 +477,14 @@ class Fleet:
         # sustained limiter saturation across every eligible replica ->
         # want-more; sustained fleet-wide idleness -> want-fewer.
         # Published on GET /v2/fleet/autoscale and as the
-        # flexflow_serving_autoscale_* gauges.
-        self.autoscale = AutoscaleAdvisor(clock=clock)
+        # flexflow_serving_autoscale_* gauges. Hold times come from the
+        # same typed OverloadConfig that tunes each replica's limiter
+        # and ladder (scheduler_kwargs["overload"]) — one tuning
+        # surface, sweepable by the sim/ digital twin.
+        self.autoscale = AutoscaleAdvisor.from_config(
+            self._scheduler_kwargs.get("overload") or OverloadConfig(),
+            clock=clock,
+        )
         # replaced-but-still-busy replicas: out of the routing set, kept
         # stepping until their residents finish (or expire), then torn
         # down — a drain timeout must never abort live streams

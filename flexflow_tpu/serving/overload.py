@@ -118,6 +118,8 @@ class OverloadConfig:
     target_queue_s: float = 0.5         # queue-time p95 target
     target_ttft_s: float = 2.5          # TTFT p95 target (matches the SLO)
     min_queue_frac: float = 0.125       # occupancy floor before any cut
+    hard_queue_frac: float = 0.5        # occupancy at/above which the cut
+                                        # signal fires unconditionally
     # per-class admission headroom: fraction of the live limit each
     # class may fill — best-effort saturates first, interactive keeps a
     # reserve above the nominal limit
@@ -144,6 +146,13 @@ class OverloadConfig:
     )
     # ---- rejections
     retry_after_base_s: float = 1.0     # Retry-After = base * (1 + level)
+    # ---- AutoscaleAdvisor (fleet-level; serving/fleet.py builds its
+    # advisor from the same config that tunes each replica's
+    # controller, so operators — and the sim/ digital twin — sweep
+    # replica-count dynamics and admission dynamics from one place)
+    autoscale_up_hold_s: float = 3.0    # full saturation this long -> +1
+    autoscale_down_hold_s: float = 30.0  # idle this long -> -1
+    autoscale_low_util: float = 0.25    # "idle" = no saturation, util <= this
 
 
 class AdaptiveLimiter:
@@ -259,7 +268,7 @@ class AdaptiveLimiter:
         qfrac = self.queue_depth() / self.max_queue
         if qfrac < cfg.min_queue_frac:
             return False
-        if qfrac >= 0.5:
+        if qfrac >= cfg.hard_queue_frac:
             return True
         if self.queue_p95() > cfg.target_queue_s:
             return True
@@ -713,6 +722,19 @@ class AutoscaleAdvisor:
         self._idle_since: Optional[float] = None  # guarded-by: _lock
         self._signal = 0  # guarded-by: _lock
         self._last: Dict = {}  # guarded-by: _lock
+
+    @classmethod
+    def from_config(
+        cls, cfg: OverloadConfig, *, clock: Callable[[], float],
+    ) -> "AutoscaleAdvisor":
+        """Build from the typed overload config — fleet and simulator
+        share one tuning surface instead of scattered literals."""
+        return cls(
+            clock=clock,
+            up_hold_s=cfg.autoscale_up_hold_s,
+            down_hold_s=cfg.autoscale_down_hold_s,
+            low_util=cfg.autoscale_low_util,
+        )
 
     def observe(self, saturated_frac: float, mean_util: float) -> int:
         now = self.clock()

@@ -81,6 +81,36 @@ class TestClockRule:
         report = analyze_source(src, rule_names=["clock-discipline"])
         assert report.findings == [] and len(report.suppressed) == 1
 
+    def test_strict_path_flags_every_reference(self):
+        # under flexflow_tpu/sim/ the rule is strict: the import, the
+        # injectable-default reference, AND the calls are all findings,
+        # perf_counter included, whitelist ignored
+        src = (
+            "import time\n"
+            "from time import perf_counter as pc\n\n"
+            "def mk(clock=time.monotonic):\n"
+            "    return clock() + pc() + time.time()\n"
+        )
+        out = findings(src, "clock-discipline",
+                       relpath="flexflow_tpu/sim/example.py")
+        assert len(out) == 4
+        assert all("strict virtual-time" in f.message for f in out)
+        flagged = {m for f in out for m in
+                   ("perf_counter", "monotonic", "time.time")
+                   if m in f.message}
+        assert flagged == {"perf_counter", "monotonic", "time.time"}
+        # the same source outside the strict path: only the two calls
+        # (the default-argument reference stays the injectable idiom)
+        assert len(findings(src, "clock-discipline")) == 2
+
+    def test_strict_path_ignores_whitelist_shape(self):
+        # even a perf_counter-only usage — whitelisted for the engine
+        # under the PR 6 dual-stamp decision — is a violation in the sim
+        src = "import time\n\ndef f():\n    return time.perf_counter()\n"
+        out = findings(src, "clock-discipline",
+                       relpath="flexflow_tpu/sim/costs.py")
+        assert len(out) == 1 and "perf_counter" in out[0].message
+
     def test_suppression_with_hyphen_separated_reason(self):
         src = (
             "import time\n\ndef f():\n"

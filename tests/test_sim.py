@@ -1,0 +1,284 @@
+"""Fleet digital twin (flexflow_tpu/sim/): determinism, the checked-in
+usefulness demo facts (disagg TTFT win + capacity knee), cost-table
+provenance (cross-device refusal), schedule round-trips, the ``sim:``
+ledger honesty loop, autoscale ramp hysteresis, and — slow — the
+sim-vs-live simcheck gate end to end.
+"""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from flexflow_tpu.obs import PredictionLedger
+from flexflow_tpu.serving.overload import AutoscaleAdvisor, OverloadConfig
+from flexflow_tpu.sim import Scenario, SimCosts, run_scenario, sweep
+from flexflow_tpu.sim.report import SIM_PROVENANCE, measure_live
+
+pytestmark = pytest.mark.sim
+
+ROOT = Path(__file__).resolve().parent.parent
+STORM = ROOT / "tests" / "data" / "storm_schedule.json"
+
+sys.path.insert(0, str(ROOT))
+from tools.loadgen import build_schedule, load_schedule, save_schedule  # noqa: E402
+from tools.simfleet import STORM_DT, STORM_OVERLOAD, demo_costs  # noqa: E402
+
+STORM_ARGS = dict(
+    mix=(0.15, 0.15, 0.7), seed=7, vocab=40, deadlines_s=(None,), max_new=6,
+)
+
+
+# ----------------------------------------------------------- determinism
+class TestDeterminism:
+    def test_two_replays_are_identical(self):
+        sc = Scenario(name="det", arm="unified", replicas=2)
+        a = run_scenario(str(STORM), demo_costs(), sc).render()
+        b = run_scenario(str(STORM), demo_costs(), sc).render()
+        assert a == b
+        assert a["trace_digest"] == b["trace_digest"]
+
+    def test_tick_mode_is_deterministic_too(self):
+        sc = Scenario(
+            name="det-tick", arm="unified", replicas=1, slots=3,
+            max_queue=16, num_blocks=25, block_size=8,
+            overload=OverloadConfig(**STORM_OVERLOAD),
+        )
+        costs = SimCosts.fixed_tick(STORM_DT)
+        a = run_scenario(str(STORM), costs, sc).render()
+        b = run_scenario(str(STORM), costs, sc).render()
+        assert a == b and a["trace_digest"] == b["trace_digest"]
+
+    def test_traffic_scaling_changes_the_trace(self):
+        base = Scenario(name="x1", arm="unified", replicas=2)
+        hot = Scenario(name="x2", arm="unified", replicas=2, traffic_x=2.0)
+        a = run_scenario(str(STORM), demo_costs(), base).render()
+        b = run_scenario(str(STORM), demo_costs(), hot).render()
+        assert a["trace_digest"] != b["trace_digest"]
+        assert b["ttft_p95_s"] >= a["ttft_p95_s"]
+
+
+# ----------------------------------------------------------- demo facts
+class TestDemoFacts:
+    """The checked-in SIM_SWEEP.json usefulness claims, re-derived."""
+
+    @pytest.fixture(scope="class")
+    def ranked(self):
+        scens = [
+            Scenario(name=f"unified-x{n}", arm="unified", replicas=n)
+            for n in (1, 2, 3, 4)
+        ] + [Scenario(name="disagg-1p1d", arm="disagg",
+                      n_prefill=1, n_decode=1)]
+        out = sweep(str(STORM), demo_costs(), scens, target_ttft_p99_s=1.0)
+        return {r["scenario"]: r for r in out["ranked"]}
+
+    def test_disagg_beats_unified_at_equal_engines(self, ranked):
+        # the PR 16 direction: on the storm, 1 prefill + 1 decode beats
+        # 2 unified replicas on TTFT p95 (prefill never queues behind
+        # decode steps)
+        assert (ranked["disagg-1p1d"]["ttft_p95_s"]
+                < ranked["unified-x2"]["ttft_p95_s"])
+
+    def test_capacity_knee_as_replicas_shrink(self, ranked):
+        sheds = [ranked[f"unified-x{n}"]["shed_rate"] for n in (4, 3, 2, 1)]
+        assert sheds[-1] > 0.0, "1 replica should shed under the storm"
+        assert all(s == 0.0 for s in sheds[:-1]), (
+            f"the knee should sit at 1 replica, got {sheds}")
+
+    def test_infeasible_configs_rank_last(self, ranked):
+        assert not ranked["unified-x1"]["feasible"]
+        assert ranked["unified-x1"]["rank"] == max(
+            r["rank"] for r in ranked.values())
+
+    def test_checked_in_sweep_matches(self, ranked):
+        # SIM_SWEEP.json is a build artifact of `simfleet demo`; if it
+        # drifts from what the code produces, regenerate it
+        doc = json.loads((ROOT / "SIM_SWEEP.json").read_text())
+        pinned = {r["scenario"]: r for r in doc["ranked"]}
+        assert set(pinned) == set(ranked)
+        for name, row in ranked.items():
+            for k in ("rank", "feasible", "ttft_p95_s", "shed_rate"):
+                assert pinned[name][k] == row[k], (name, k)
+
+
+# ------------------------------------------------------------ cost table
+class TestCostTable:
+    def _export(self, tmp_path, device="cpu-test"):
+        doc = {
+            "schema": "flexflow-ledger-export-v1",
+            "exported_from": "http://test",
+            "models": {
+                "lm": {
+                    "device_kind": device,
+                    "entries": [
+                        {"key": "prefill[8]", "predicted_s": 0.004,
+                         "pairs": 3, "measured_p50_s": 0.005},
+                        {"key": "decode", "predicted_s": 0.002,
+                         "pairs": 0, "measured_p50_s": None},
+                    ],
+                    "counters": {},
+                }
+            },
+        }
+        p = tmp_path / "ledger.json"
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_measured_p50_wins_over_prediction(self, tmp_path):
+        costs = SimCosts.from_ledger_export(self._export(tmp_path))
+        assert costs.prefill_s[8] == 0.005   # 3 pairs -> measured
+        assert costs.decode_s == 0.002       # 0 pairs -> predicted
+
+    def test_cross_device_load_refused(self, tmp_path):
+        path = self._export(tmp_path, device="chip:v5e")
+        with pytest.raises(ValueError, match="device"):
+            SimCosts.from_ledger_export(path, expect_device="v6e")
+
+    def test_matching_device_accepted(self, tmp_path):
+        path = self._export(tmp_path, device="chip:v5e")
+        costs = SimCosts.from_ledger_export(path, expect_device="chip:v5e")
+        assert costs.device_kind == "chip:v5e"
+
+
+# -------------------------------------------------------------- schedule
+class TestScheduleRoundTrip:
+    def test_save_load_round_trip(self, tmp_path):
+        sched = build_schedule(40.0, 1.0, **STORM_ARGS)
+        p = tmp_path / "s.json"
+        save_schedule(sched, str(p), meta={"rate_rps": 40.0})
+        loaded, meta = load_schedule(str(p), with_meta=True)
+        assert meta["rate_rps"] == 40.0
+        assert loaded == sched
+
+    def test_wrong_schema_refused(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"schema": "not-a-schedule", "arrivals": []}))
+        with pytest.raises(ValueError, match="not a load schedule"):
+            load_schedule(str(p))
+
+    def test_canned_storm_matches_its_generator(self):
+        # tests/data/storm_schedule.json is pinned CI input for the
+        # simcheck gate; this guard catches silent drift between the
+        # artifact and the loadgen code that claims to reproduce it
+        loaded, meta = load_schedule(str(STORM), with_meta=True)
+        regen = build_schedule(
+            meta["rate_rps"], meta["duration_s"], mix=tuple(meta["mix"]),
+            seed=meta["seed"], vocab=meta["vocab"],
+            deadlines_s=tuple(meta["deadlines_s"]), max_new=meta["max_new"],
+        )
+        assert loaded == regen
+        assert len(loaded) == 111
+
+
+# --------------------------------------------------------- honesty loop
+class TestSimLedgerProvenance:
+    def test_register_and_pair(self):
+        clock = [0.0]
+        ledger = PredictionLedger(clock=lambda: clock[0])
+        sc = Scenario(name="honesty", arm="unified", replicas=2)
+        rep = run_scenario(str(STORM), demo_costs(), sc)
+        keys = rep.register_predictions(ledger, prefix="t", alarm=False)
+        assert keys and all(k.startswith("sim:t:") for k in keys)
+        live = {m: rep.metrics()[m] for m in rep.metrics()}
+        paired = measure_live(ledger, prefix="t", live_metrics=live)
+        assert set(paired) == set(keys)
+        entries = {e["key"]: e for e in ledger.report()["entries"]}
+        for k in keys:
+            assert entries[k]["provenance"] == SIM_PROVENANCE
+            assert entries[k]["pairs"] == 1
+            # sim predicted, "live" measured the same numbers -> 0 error
+            assert entries[k]["rel_err_p50"] == pytest.approx(0.0)
+
+    def test_unmeasured_metric_is_not_paired(self):
+        ledger = PredictionLedger(clock=lambda: 0.0)
+        sc = Scenario(name="h2", arm="unified", replicas=2)
+        rep = run_scenario(str(STORM), demo_costs(), sc)
+        rep.register_predictions(ledger, prefix="t", alarm=False)
+        paired = measure_live(ledger, prefix="t",
+                              live_metrics={"ttft_p50_s": 0.01})
+        assert paired == ["sim:t:ttft_p50_s"]
+
+
+# ----------------------------------------------------- autoscale ramp
+class TestAutoscaleRamp:
+    def test_advisor_ramp_no_flapping(self):
+        # synthetic ramp on a virtual clock: idle -> saturated (held)
+        # -> idle; the advisor must cross want-more exactly once, then
+        # settle through 0 before want-fewer — never a +1 <-> -1 flap
+        clock = [0.0]
+        adv = AutoscaleAdvisor(
+            clock=lambda: clock[0], up_hold_s=1.0, down_hold_s=5.0,
+            low_util=0.25,
+        )
+        signals = []
+
+        def run(duration, sat, util, dt=0.25):
+            end = clock[0] + duration
+            while clock[0] < end:
+                signals.append(adv.observe(sat, util))
+                clock[0] += dt
+
+        run(2.0, 0.0, 0.1)     # idle warmup (shorter than down_hold_s)
+        run(3.0, 1.0, 1.0)     # ramp: fully saturated, held past up_hold_s
+        run(8.0, 0.0, 0.05)    # cooldown: idle past down_hold_s
+        assert 1 in signals, "sustained saturation must signal want-more"
+        assert -1 in signals, "sustained idle must signal want-fewer"
+        flaps = sum(1 for a, b in zip(signals, signals[1:])
+                    if a != 0 and b != 0 and a != b)
+        assert flaps == 0
+        # hysteresis, not edge-triggering: the first saturated
+        # observation must NOT fire (up_hold_s has not elapsed)
+        first_sat = 2.0 / 0.25
+        assert signals[int(first_sat)] == 0
+
+    def test_brief_burst_does_not_signal(self):
+        clock = [0.0]
+        adv = AutoscaleAdvisor(
+            clock=lambda: clock[0], up_hold_s=3.0, down_hold_s=30.0,
+        )
+        for _ in range(4):                  # 1s of saturation < up_hold_s
+            adv.observe(1.0, 1.0)
+            clock[0] += 0.25
+        assert adv.signal == 0
+
+    def test_fleet_storm_wants_more_without_flapping(self):
+        # the overloaded single replica must raise the want-more signal
+        # during the storm and never flap directly to want-fewer
+        sc = Scenario(
+            name="ramp", arm="unified", replicas=1,
+            overload=OverloadConfig(autoscale_up_hold_s=0.3),
+        )
+        rep = run_scenario(str(STORM), demo_costs(), sc).render()
+        auto = rep["autoscale"]
+        assert auto["max_signal"] == 1
+        assert auto["flaps"] == 0
+
+    def test_idle_fleet_never_wants_more(self):
+        sc = Scenario(name="calm", arm="unified", replicas=4)
+        rep = run_scenario(str(STORM), demo_costs(), sc).render()
+        assert rep["autoscale"]["max_signal"] <= 0
+        assert rep["autoscale"]["flaps"] == 0
+
+
+# ------------------------------------------------------- simcheck (slow)
+@pytest.mark.slow
+class TestSimcheckGate:
+    def test_simcheck_cli_passes(self, tmp_path):
+        """The CI gate end to end: tick-mode twin vs a REAL engine
+        driven on a virtual clock over the same canned storm, TTFT
+        p50/p99 within the pinned bound, sim: predictions visible on
+        the debug endpoint with sim provenance."""
+        out = tmp_path / "SIM_REPORT.json"
+        proc = subprocess.run(
+            [sys.executable, str(ROOT / "tools" / "simfleet.py"),
+             "simcheck", "--out", str(out)],
+            capture_output=True, text=True, timeout=540, cwd=str(ROOT),
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(out.read_text())
+        assert doc["ok"] and not doc["failures"]
+        for metric in ("ttft_p50_s", "ttft_p99_s"):
+            assert doc["divergence"][metric]["abs_diff_s"] <= doc["bound_s"]
+        assert any(k.startswith("sim:storm:") for k in doc["ledger_keys"])

@@ -110,6 +110,85 @@ def build_schedule(
         ))
 
 
+# ------------------------------------------------------------ schedules
+SCHEDULE_SCHEMA = "flexflow-load-schedule-v1"
+
+
+def save_schedule(schedule: Sequence[Arrival], path: str,
+                  *, meta: Optional[Dict] = None) -> None:
+    """Serialize the exact arrival schedule (timestamps, prompts,
+    priorities, deadlines, max_new) so the identical workload can
+    drive live runs, A/B gates, and the sim/ digital twin. ``meta``
+    records how it was built (rate, seed, ...) for provenance."""
+    doc = {
+        "schema": SCHEDULE_SCHEMA,
+        "meta": dict(meta or {}),
+        "arrivals": [dataclasses.asdict(a) for a in schedule],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+
+
+def load_schedule(path: str, *, with_meta: bool = False):
+    """Replay a recorded schedule deterministically. Returns the
+    Arrival list (sorted by arrival time), or (arrivals, meta) with
+    ``with_meta=True``."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != SCHEDULE_SCHEMA:
+        raise ValueError(
+            f"{path}: not a load schedule "
+            f"(schema={doc.get('schema')!r}, want {SCHEDULE_SCHEMA!r})"
+        )
+    arrivals = [
+        Arrival(
+            t=float(d["t"]),
+            priority=str(d["priority"]),
+            prompt=[int(x) for x in d["prompt"]],
+            deadline_s=(
+                None if d.get("deadline_s") is None
+                else float(d["deadline_s"])
+            ),
+            max_new=int(d["max_new"]),
+        )
+        for d in doc["arrivals"]
+    ]
+    arrivals.sort(key=lambda a: a.t)
+    if with_meta:
+        return arrivals, dict(doc.get("meta") or {})
+    return arrivals
+
+
+def resolve_schedule(args) -> List[Arrival]:
+    """The CLI's schedule source: ``--schedule FILE`` replays a
+    recording (and restores its recorded duration for rate math);
+    otherwise build from the seeded generator, recording to
+    ``--record-schedule FILE`` when asked."""
+    if getattr(args, "schedule", ""):
+        arrivals, meta = load_schedule(args.schedule, with_meta=True)
+        if meta.get("duration_s"):
+            args.duration = float(meta["duration_s"])
+        elif arrivals:
+            args.duration = max(args.duration, arrivals[-1].t)
+        return arrivals
+    schedule = build_schedule(
+        args.rate, args.duration, mix=args.mix_t, seed=args.seed,
+        vocab=args.vocab, deadlines_s=args.deadlines_t,
+        max_new=args.max_new,
+    )
+    if getattr(args, "record_schedule", ""):
+        save_schedule(schedule, args.record_schedule, meta={
+            "rate_rps": args.rate, "duration_s": args.duration,
+            "mix": list(args.mix_t), "seed": args.seed,
+            "vocab": args.vocab, "max_new": args.max_new,
+            "deadlines_s": list(args.deadlines_t),
+        })
+        print(f"recorded {len(schedule)} arrivals -> "
+              f"{args.record_schedule}", file=sys.stderr)
+    return schedule
+
+
 class LoadReport:
     """Per-priority outcome + TTFT accounting; thread-safe for the
     --url mode's per-arrival threads."""
@@ -293,11 +372,7 @@ def run_inprocess(args) -> Dict:
         engine, clock=clock, max_queue=args.max_queue,
         overload=OverloadConfig(),
     )
-    schedule = build_schedule(
-        args.rate, args.duration, mix=args.mix_t, seed=args.seed,
-        vocab=args.vocab, deadlines_s=args.deadlines_t,
-        max_new=args.max_new,
-    )
+    schedule = resolve_schedule(args)
     report = drive_virtual(sched, schedule, clock, dt=args.dt)
     sched.stop()
     out = report.render(args.duration)
@@ -311,11 +386,7 @@ def run_http(args) -> Dict:
     """Real open-loop HTTP load: one thread per arrival fires at its
     scheduled wall time. TTFT is approximated by response latency
     (non-streaming generate); sheds are 503 answers."""
-    schedule = build_schedule(
-        args.rate, args.duration, mix=args.mix_t, seed=args.seed,
-        vocab=args.vocab, deadlines_s=args.deadlines_t,
-        max_new=args.max_new,
-    )
+    schedule = resolve_schedule(args)
     report = LoadReport()
     base = args.url.rstrip("/")
     url = f"{base}/v2/models/{args.model}/generate"
@@ -615,6 +686,16 @@ def main() -> int:
     ap.add_argument("--deadlines", default="none,5,30",
                     help="deadline choices in seconds ('none' = no deadline)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--schedule", default="",
+                    help="replay a recorded arrival schedule (JSON) instead "
+                    "of building one (in-process and --url modes)")
+    ap.add_argument("--record-schedule", default="",
+                    help="write the built arrival schedule here (JSON), so "
+                    "the identical workload can drive live runs and the "
+                    "sim/ digital twin")
+    ap.add_argument("--record-only", action="store_true",
+                    help="with --record-schedule: write the schedule and "
+                    "exit without driving it")
     ap.add_argument("--max-new", type=int, default=None,
                     help="tokens per request (default 8; 32 in --disagg-ab, "
                     "long enough to amortize the handoff over the stream "
@@ -657,6 +738,12 @@ def main() -> int:
         None if x.strip().lower() == "none" else float(x)
         for x in args.deadlines.split(",")
     )
+    if args.record_only:
+        if not args.record_schedule:
+            print("--record-only needs --record-schedule FILE", file=sys.stderr)
+            return 2
+        resolve_schedule(args)
+        return 0
     if args.disagg_ab:
         report = run_disagg_ab(args)
     elif args.url:

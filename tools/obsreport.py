@@ -32,6 +32,13 @@ Against a live server (serving/server.py):
       and the calibration-drift alarms with blame — the "is the
       simulator lying?" answer.
 
+  python tools/obsreport.py --url ... predict --export ledger.json
+      Dump the same ledger snapshot as a flexflow-ledger-export-v1
+      document (per-model entries + counters, tagged with each model's
+      device kind from its metadata) — the calibration artifact
+      `flexflow_tpu.sim.SimCosts.from_ledger_export` consumes. The
+      loader refuses cross-device loads, the apply_recalibration rule.
+
   python tools/obsreport.py --url ... overload
       Overload-control view (GET /v2/overload): adaptive-limiter state,
       degrade-ladder level + transition history, the per-reason /
@@ -285,6 +292,45 @@ def show_predictions(base: str) -> int:
         print(f"global ledger (cost model / calibration / executor): "
               f"{c['pairs_total']} pairs, {c['drift_alarms_total']} drift alarm(s)")
         _predict_rows(g)
+    return 0
+
+
+LEDGER_EXPORT_SCHEMA = "flexflow-ledger-export-v1"
+
+
+def export_predictions(base: str, out: str) -> int:
+    """Write the ledger snapshot as a ``flexflow-ledger-export-v1``
+    document: per-model entries + counters, each model tagged with the
+    device kind its engine reported (metadata ``compute.chip``). This
+    is the calibration artifact the fleet digital twin loads
+    (``SimCosts.from_ledger_export``); the device tag is what lets the
+    loader refuse cross-device loads."""
+    payload = _get_json(f"{base}/v2/debug/predictions")
+    models = {}
+    for name, rep in sorted(payload.get("models", {}).items()):
+        try:
+            meta = _get_json(f"{base}/v2/models/{name}")
+            device = meta.get("compute", {}).get("chip") or "unknown"
+        except Exception:
+            device = "unknown"
+        models[name] = {
+            "device_kind": device,
+            "entries": rep.get("entries", []),
+            "counters": rep.get("counters", {}),
+        }
+    doc = {
+        "schema": LEDGER_EXPORT_SCHEMA,
+        "exported_from": base,
+        "models": models,
+        "global": payload.get("global"),
+    }
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    pairs = sum(
+        m["counters"].get("pairs_total", 0) for m in models.values()
+    )
+    print(f"exported {len(models)} model ledger(s) ({pairs} pairs) -> {out}")
     return 0
 
 
@@ -826,6 +872,10 @@ def main() -> int:
     ap.add_argument("--anatomy-out", default="",
                     help="with `anatomy`: dump the report + two-lane "
                          "capture timeline JSON to this file")
+    ap.add_argument("--export", default="",
+                    help="with `predict`: write the ledger snapshot as "
+                         "a flexflow-ledger-export-v1 JSON document "
+                         "(the sim cost-table calibration artifact)")
     ap.add_argument("--selfcheck", action="store_true",
                     help="in-process end-to-end observability check (CI)")
     args = ap.parse_args()
@@ -844,6 +894,8 @@ def main() -> int:
     if args.command == "slo":
         return show_slo(base)
     if args.command == "predict":
+        if args.export:
+            return export_predictions(base, args.export)
         return show_predictions(base)
     if args.command == "anatomy":
         return show_anatomy(base, capture=args.capture, out=args.anatomy_out)
