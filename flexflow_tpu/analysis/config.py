@@ -34,6 +34,9 @@ CLOCK_WHITELIST: Dict[str, Union[str, FrozenSet[str]]] = {
     "flexflow_tpu/generation/engine.py": frozenset({"perf_counter"}),
     "flexflow_tpu/generation/scheduler.py": frozenset({"perf_counter"}),
     "flexflow_tpu/runtime/executor.py": frozenset({"perf_counter"}),
+    # Grammar-compile telemetry (ISSUE 18): compile_seconds is physical
+    # profiling data like the engine's phase spans — perf_counter only.
+    "flexflow_tpu/generation/constrained/tokens.py": frozenset({"perf_counter"}),
     # Step-anatomy profiler (ISSUE 12): perf_counter-only physical
     # profiling per the PR 6 dual-clock decision — it aggregates the
     # engine/scheduler perf_counter span stamps and must never mix in

@@ -422,6 +422,9 @@ class GenerationEngine:
         # one identity check instead of a fresh alloc + device transfer
         self._zero_bias = np.zeros((max_batch_slots,), np.float32)
         self._zero_bias_dev = self._dev(np.zeros((max_batch_slots,), np.float32))
+        # grammar-mask staging (ISSUE 18): per-shape cached zeros for
+        # batches with no constrained slot — see _mask_arg
+        self._zero_masks: Dict[str, jax.Array] = {}
         # KV-cache buffer donation on the hot fixed-shape programs: the
         # decode/verify jits alias their cache inputs to their cache
         # outputs, so XLA updates the (large) cache in place instead of
@@ -572,13 +575,13 @@ class GenerationEngine:
         )
 
     # ------------------------------------------------------- jitted bodies
-    def _prefill_impl(self, params, tokens, length, cache_k, cache_v, block_table, temp, top_k, key):
+    def _prefill_impl(self, params, tokens, length, cache_k, cache_v, block_table, temp, top_k, key, mask):
         s = tokens.shape[1]
         self.trace_counts[f"prefill[{s}]"] = self.trace_counts.get(f"prefill[{s}]", 0) + 1
         self.programs.note_trace(f"prefill[{s}]", {
             "params": params, "tokens": tokens, "length": length,
             "cache_k": cache_k, "block_table": block_table,
-            "temp": temp, "top_k": top_k, "key": key,
+            "temp": temp, "top_k": top_k, "key": key, "mask": mask,
         })
         nb, bs = cache_k.shape[1], cache_k.shape[2]
         logits, ks, vs = prefill(params, tokens, jnp.full((1,), length, jnp.int32))
@@ -594,18 +597,21 @@ class GenerationEngine:
         cache_v = jax.vmap(write)(cache_v, vs[:, 0])
         last = logits[0, length - 1]
         ok = jnp.all(jnp.isfinite(last))  # blame: poisoned prompt
+        # grammar mask: additive [V] bias, 0 / NEG (finite — the ok gate
+        # above still sees model NaN, never the mask)
+        last = last + mask
         token = _sample(last[None], temp[None], top_k[None], key[None])[0]
         return token, ok, cache_k, cache_v
 
     def _decode_impl(
-        self, params, tokens, positions, cache_k, cache_v, block_tables, context_lens, temps, top_ks, bias, seeds, counts
+        self, params, tokens, positions, cache_k, cache_v, block_tables, context_lens, temps, top_ks, bias, seeds, counts, mask
     ):
         self.trace_counts["decode"] = self.trace_counts.get("decode", 0) + 1
         self.programs.note_trace("decode", {
             "params": params, "tokens": tokens, "positions": positions,
             "cache_k": cache_k, "block_tables": block_tables,
             "context_lens": context_lens, "temps": temps, "top_ks": top_ks,
-            "bias": bias, "seeds": seeds, "counts": counts,
+            "bias": bias, "seeds": seeds, "counts": counts, "mask": mask,
         })
         logits, cache_k, cache_v = decode_step(
             params, tokens, positions, cache_k, cache_v, block_tables,
@@ -613,8 +619,11 @@ class GenerationEngine:
         )
         # bias is the fault plan's per-slot NaN poison (zeros outside
         # chaos runs); applying it before the finiteness reduce makes the
-        # injected poison indistinguishable from model-produced NaN/inf
-        logits = logits + bias[:, None]
+        # injected poison indistinguishable from model-produced NaN/inf.
+        # mask is the grammar constraint: [B, V] additive rows of 0 / NEG
+        # (finite, so it commutes with the poison semantics — the ok gate
+        # trips on model/injected NaN, never on a banned token)
+        logits = logits + bias[:, None] + mask
         ok = jnp.all(jnp.isfinite(logits), axis=-1)
         # sampling keys derive in-jit from (seed, token count): no host
         # fold_in/stack on the critical path, same key bits as before
@@ -622,7 +631,7 @@ class GenerationEngine:
         return _sample(logits, temps, top_ks, keys), ok, cache_k, cache_v
 
     def _verify_impl(
-        self, params, tokens, start, n_draft, cache_k, cache_v, block_tables, temps, top_ks, bias, seeds, counts
+        self, params, tokens, start, n_draft, cache_k, cache_v, block_tables, temps, top_ks, bias, seeds, counts, mask
     ):
         """Speculative verification: score a [B, W] window (committed
         token + drafts) in one forward and accept/emit in-jit.
@@ -635,7 +644,7 @@ class GenerationEngine:
             "params": params, "tokens": tokens, "start": start,
             "n_draft": n_draft, "cache_k": cache_k,
             "block_tables": block_tables, "temps": temps, "top_ks": top_ks,
-            "bias": bias, "seeds": seeds, "counts": counts,
+            "bias": bias, "seeds": seeds, "counts": counts, "mask": mask,
         })
         w = tokens.shape[1]
         keys = derive_window_keys(seeds, counts, w)  # in-jit, see decode
@@ -647,7 +656,11 @@ class GenerationEngine:
             params, tokens, positions, cache_k, cache_v, block_tables,
             backend=self.backend, mesh=self._kernel_mesh,
         )
-        logits = logits + bias[:, None, None]
+        # per-position grammar mask [B, W, V] rides next to the NaN-poison
+        # bias; draft and target score the SAME masked logits, so
+        # rejection sampling stays distribution-preserving over the
+        # constrained support and greedy stays token-for-token exact
+        logits = logits + bias[:, None, None] + mask
         # blame vector: finiteness over each slot's REAL window positions
         # only — padded positions (and whole inactive rows) attend to
         # nothing and may hold garbage that must not indict the request
@@ -661,7 +674,7 @@ class GenerationEngine:
         return out, jnp.where(n_draft >= 0, n_emitted, 0), ok, cache_k, cache_v
 
     def _prefix_prefill_impl(
-        self, params, tokens, start, n_real, cache_k, cache_v, block_table, temp, top_k, key
+        self, params, tokens, start, n_real, cache_k, cache_v, block_table, temp, top_k, key, mask
     ):
         """Suffix-only prefill against a cached prefix: the [1, W]
         suffix window attends over the block table (shared prefix
@@ -677,7 +690,7 @@ class GenerationEngine:
             "params": params, "tokens": tokens, "start": start,
             "n_real": n_real, "cache_k": cache_k,
             "block_table": block_table, "temp": temp, "top_k": top_k,
-            "key": key,
+            "key": key, "mask": mask,
         })
         offs = jnp.arange(w, dtype=jnp.int32)
         positions = jnp.where(offs < n_real, start + offs, -1)[None, :]
@@ -687,6 +700,7 @@ class GenerationEngine:
         )
         last = logits[0, n_real - 1]
         ok = jnp.all(jnp.isfinite(last))  # blame: poisoned prompt
+        last = last + mask  # grammar mask: [V], finite (see _prefill_impl)
         token = _sample(last[None], temp[None], top_k[None], key[None])[0]
         return token, ok, cache_k, cache_v
 
@@ -800,6 +814,7 @@ class GenerationEngine:
         sampling: SamplingParams,
         key: jax.Array,
         prefix_len: int = 0,
+        mask=None,
     ) -> int:
         """Prefill one sequence into its allocated blocks and sample its
         first generated token. ``block_table`` is the sequence's block
@@ -807,10 +822,12 @@ class GenerationEngine:
         ``prefix_len`` > 0 means positions [0, prefix_len) are already
         cached (shared prefix blocks at the front of the table): only
         the suffix is computed, attending to the cached prefix — the
-        O(suffix) admission path prefix caching exists for."""
+        O(suffix) admission path prefix caching exists for.
+        ``mask`` is an optional [vocab] grammar bias (0 / NEG) applied
+        to the sampled position; None stages the shared zeros row."""
         faults.inject(faults.GENERATION_PREFILL, prompt)
         if prefix_len > 0:
-            return self._prefill_suffix(prompt, block_table, sampling, key, prefix_len)
+            return self._prefill_suffix(prompt, block_table, sampling, key, prefix_len, mask)
         self.step_counts["prefill"] += 1
         t0 = time.perf_counter()
         n = len(prompt)
@@ -830,6 +847,7 @@ class GenerationEngine:
             jnp.float32(sampling.temperature),
             jnp.int32(sampling.top_k),
             self._dev(key),
+            self._mask_arg(mask, "prefill_mask", (self.cfg.vocab_size,)),
         )
         t_disp = time.perf_counter()
         jax.block_until_ready((token, ok, ck, cv))  # device execution done
@@ -876,6 +894,7 @@ class GenerationEngine:
         sampling: SamplingParams,
         key: jax.Array,
         prefix_len: int,
+        mask=None,
     ) -> int:
         """Suffix-only prefill: positions [prefix_len, len(prompt))
         computed against the cached prefix. Accounting mirrors
@@ -903,6 +922,7 @@ class GenerationEngine:
             jnp.float32(sampling.temperature),
             jnp.int32(sampling.top_k),
             self._dev(key),
+            self._mask_arg(mask, "prefill_mask", (self.cfg.vocab_size,)),
         )
         t_disp = time.perf_counter()
         jax.block_until_ready((token, ok, ck, cv))  # device execution done
@@ -1282,7 +1302,7 @@ class GenerationEngine:
         self._staged[name] = (host.copy(), dev)
         return dev
 
-    def _decode_args(self, positions, block_tables, active, temps, top_ks, seeds, counts, bias):
+    def _decode_args(self, positions, block_tables, active, temps, top_ks, seeds, counts, bias, mask=None):
         """Assemble the decode jit's argument tuple (minus the token
         array, which the pipelined path carries device-resident)."""
         context_lens = np.where(active, positions + 1, 0).astype(np.int32)
@@ -1303,6 +1323,10 @@ class GenerationEngine:
             self._bias_arg(bias),
             self._stage("decode.seeds", seeds.astype(np.uint32)),
             self._dev(counts.astype(np.int32)),
+            self._mask_arg(
+                mask, "decode_mask",
+                (self.max_batch_slots, self.cfg.vocab_size),
+            ),
         ), context_lens
 
     def decode(
@@ -1315,6 +1339,7 @@ class GenerationEngine:
         top_ks: np.ndarray,
         seeds: np.ndarray,
         counts: np.ndarray,
+        mask: Optional[np.ndarray] = None,
     ) -> np.ndarray:
         """One decode step across all ``max_batch_slots`` slots. Arrays
         are slot-indexed; inactive slots (active[i] False) write to
@@ -1334,7 +1359,7 @@ class GenerationEngine:
         traces_before = self.trace_counts.get("decode", 0)
         args, context_lens = self._decode_args(
             positions, block_tables, active, temps, top_ks, seeds,
-            counts, bias,
+            counts, bias, mask,
         )
         out, ok, ck, cv = self._decode_jit(self.params, self._dev(masked), *args)
         t_disp = time.perf_counter()
@@ -1394,6 +1419,7 @@ class GenerationEngine:
         seeds: np.ndarray,
         counts: np.ndarray,
         tokens_dev: Optional[jax.Array] = None,
+        mask: Optional[np.ndarray] = None,
     ) -> InFlightDecode:
         """Dispatch one decode step WITHOUT blocking on it: the overlap
         pipeline's front half. Returns an :class:`InFlightDecode` whose
@@ -1426,7 +1452,7 @@ class GenerationEngine:
         traces_before = self.trace_counts.get("decode", 0)
         args, context_lens = self._decode_args(
             positions, block_tables, active, temps, top_ks, seeds,
-            counts, bias,
+            counts, bias, mask,
         )
         tok_arg = tokens_dev if tokens_dev is not None else self._dev(masked)
         prev_k, prev_v = (None, None) if self.donate else (self.cache.k, self.cache.v)
@@ -1496,6 +1522,21 @@ class GenerationEngine:
             return self._zero_bias_dev
         return self._dev(np.asarray(bias, np.float32))
 
+    def _mask_arg(self, mask, name: str, shape: Tuple[int, ...]) -> jax.Array:
+        """Device-side grammar mask: with no constrained slot in the
+        batch (mask None — the overwhelmingly common case) every call
+        reuses one cached zeros array per shape, so unconstrained
+        serving uploads nothing and the jit signature stays fixed.
+        Built lazily: the [B, W, V] verify zeros never allocate unless
+        speculation actually runs."""
+        if mask is None:
+            cached = self._zero_masks.get(name)
+            if cached is None:
+                cached = self._dev(np.zeros(shape, np.float32))
+                self._zero_masks[name] = cached
+            return cached
+        return self._dev(np.asarray(mask, np.float32))
+
     def verify(
         self,
         window_tokens: np.ndarray,
@@ -1506,6 +1547,7 @@ class GenerationEngine:
         top_ks: np.ndarray,
         seeds: np.ndarray,
         counts: np.ndarray,
+        mask: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """One speculative verification step across all slots.
 
@@ -1551,6 +1593,10 @@ class GenerationEngine:
             self._bias_arg(bias),
             self._stage("verify.seeds", seeds.astype(np.uint32)),
             self._dev(counts.astype(np.int32)),
+            self._mask_arg(
+                mask, "verify_mask",
+                (self.max_batch_slots, self.spec_window, self.cfg.vocab_size),
+            ),
         )
         t_disp = time.perf_counter()
         jax.block_until_ready((out, n_emitted, ok, ck, cv))  # execution done

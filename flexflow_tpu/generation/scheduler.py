@@ -113,12 +113,14 @@ from ..serving.resilience import (
     ShuttingDownError,
 )
 from ..serving.stats import (
+    ConstrainedStats,
     GoodputStats,
     RecoveryStats,
     ServingStats,
     SpeculationStats,
     TokenRate,
 )
+from .constrained.errors import MaskDeadEndError
 from .engine import GenerationEngine, SamplingParams
 from .recovery import (
     EngineFailedError,
@@ -245,6 +247,8 @@ class Request:
         speculation: Optional[SpeculationConfig] = None,
         drafter=None,
         priority: str = Priority.STANDARD,
+        grammar=None,
+        response_format: Optional[Dict] = None,
     ):
         self.id = next_request_id()
         # overload control (serving/overload.py): the priority class
@@ -301,6 +305,20 @@ class Request:
         # blocks instead of recompute-prefilling; cleared on use (or on
         # rejection, which falls back to recompute)
         self.imported_kv = None
+        # constrained decoding (ISSUE 18): the compiled TokenDFA shared
+        # across requests under the same grammar, and the per-request
+        # automaton cursor. mask_state is rebuilt at admission by
+        # re-advancing over `generated` (the journal-replay discipline:
+        # preempt-recompute, restart, and failover all reconstruct the
+        # same state from the same tokens), so preemption/adopt just
+        # drop it. mask_error is a deferred PoisonedRequestError the
+        # step loop sweeps into a per-request quarantine — advance
+        # failures deep in emit paths must fail ONE stream, not the
+        # batch.
+        self.grammar = grammar
+        self.response_format = response_format
+        self.mask_state = None
+        self.mask_error: Optional[PoisonedRequestError] = None
 
     @property
     def n_generated(self) -> int:
@@ -673,6 +691,13 @@ class ContinuousBatchingScheduler:
         # flight — the watchdog's stall signal
         self.recovery_stats = RecoveryStats()
         self.recovery_stats.register_gauges(self.stats)
+        # constrained decoding (ISSUE 18): grammar-cache + mask-step
+        # telemetry (flexflow_serving_constrained_* on /metrics). The
+        # serving layer's GrammarCache shares this object so per-model
+        # compile hits/misses land next to the scheduler's masked-step
+        # and dead-end counters.
+        self.constrained_stats = ConstrainedStats()
+        self.constrained_stats.register_gauges(self.stats)
         self.journal = GenerationJournal()
         self.supervisor = EngineSupervisor(self, recovery)
         self.watchdog = StepWatchdog(self, watchdog)
@@ -695,6 +720,8 @@ class ContinuousBatchingScheduler:
         speculation: Optional[SpeculationConfig] = None,
         transport: Optional[str] = None,
         priority: Optional[str] = None,
+        grammar=None,
+        response_format: Optional[Dict] = None,
     ) -> GenerationHandle:
         """Enqueue one request (priority-ordered, FCFS within a class).
         Typed rejections mirror the batcher: OverloadedError (a
@@ -710,7 +737,10 @@ class ContinuousBatchingScheduler:
         (exact) speculative decoding for this request; None falls back
         to the scheduler-wide default. ``transport`` annotates the
         request's trace ("http"/"grpc"). ``priority`` is one of
-        Priority.ORDER (default standard)."""
+        Priority.ORDER (default standard). ``grammar`` is a compiled
+        constrained-decoding TokenDFA (see generation/constrained/);
+        ``response_format`` is the wire spec it came from, kept for
+        stream validation and replay provenance."""
         if self._draining:
             raise ShuttingDownError("generation scheduler draining")
         if self._stopped:
@@ -730,6 +760,11 @@ class ContinuousBatchingScheduler:
             > self.engine.allocator.num_total
         ):
             raise ValueError("prompt exceeds total cache capacity; can never be admitted")
+        if grammar is not None and grammar.vocab_size != self.engine.cfg.vocab_size:
+            raise ValueError(
+                f"grammar compiled against vocab {grammar.vocab_size}, "
+                f"engine vocab is {self.engine.cfg.vocab_size}"
+            )
         if deadline_s is not None and deadline_s <= 0:
             self.stats.incr("expired")
             raise DeadlineExceededError("deadline already expired at submit")
@@ -777,6 +812,7 @@ class ContinuousBatchingScheduler:
             req = Request(
                 list(prompt), sampling, deadline=deadline,
                 speculation=spec, drafter=drafter, priority=priority,
+                grammar=grammar, response_format=response_format,
             )
             req.submitted_at = self.clock()
             if self.obs_enabled:
@@ -1054,6 +1090,10 @@ class ContinuousBatchingScheduler:
                 self.stats.incr("completed")
                 continue
             req.prompt = req.original_prompt + list(req.generated)
+            # constrained streams rebuild their automaton cursor at
+            # re-admission by re-advancing over `generated` — the
+            # journal IS the mask state
+            req.mask_state = None
             req.replays += 1
             req.trace.note_replay()
             replayed += req.n_generated
@@ -1098,6 +1138,7 @@ class ContinuousBatchingScheduler:
         either way, so a handoff can degrade but never corrupt."""
         req.imported_kv = imported
         req.prompt = req.original_prompt + list(req.generated)
+        req.mask_state = None  # rebuilt from `generated` at admission
         # heterogeneous-adopter guards (unreachable for fleet-built
         # replicas, which share one factory): mirror submit()'s
         # can-never-be-admitted checks, or the adopted stream wedges
@@ -1191,6 +1232,20 @@ class ContinuousBatchingScheduler:
         if req.handle._fail(err):
             self.stats.incr("failed")
             self.recovery_stats.incr("quarantined")
+
+    def _sweep_mask_errors(self) -> None:
+        """Quarantine running slots whose constrained stream parked a
+        grammar error during token bookkeeping. _advance_mask never
+        raises mid-emit — a dead-ended automaton must not unwind the
+        scatter loop and take the batch's other slots with it — so the
+        error waits one iteration here, where quarantine is safe: the
+        slot is released, the typed error reaches the one caller, and
+        everyone else keeps streaming."""
+        for state in list(self._running.values()):
+            err = state.req.mask_error
+            if err is not None:
+                state.req.mask_error = None
+                self._quarantine(state, err)
 
     def ready(self) -> bool:
         return not self._draining and self.breaker.ready()
@@ -1437,6 +1492,7 @@ class ContinuousBatchingScheduler:
         self._release(victim)
         req = victim.req
         req.prompt = req.original_prompt + list(req.generated)
+        req.mask_state = None  # rebuilt from `generated` at re-admission
         req.preemptions += 1
         self.preemptions += 1
         req.trace.note_preempt()
@@ -1458,6 +1514,39 @@ class ContinuousBatchingScheduler:
             if not self.breaker.allow():
                 return False
             req = self._queue[0]
+        if req.grammar is not None and req.mask_state is None:
+            # constrained stream: rebuild the automaton cursor by
+            # re-advancing over every emitted token. First admission
+            # starts at the grammar's start state; preempt-recompute,
+            # engine restart, and cross-replica adoption all arrive
+            # here with mask_state dropped and `generated` intact, so
+            # the journal IS the mask state (byte-exact replay). A
+            # refused token (replay divergence or an injected
+            # generation.mask_advance fault) fails the ONE request
+            # typed — the queue and batch are untouched.
+            try:
+                req.mask_state = req.grammar.state_after(
+                    req.generated, req.sampling.eos_id
+                )
+            except Exception as e:
+                with self._lock:
+                    if self._queue and self._queue[0] is req:
+                        self._queue.popleft()
+                self.constrained_stats.incr("dead_end_failures")
+                err = PoisonedRequestError(
+                    f"request {req.id} could not rebuild its grammar "
+                    f"state: {e}",
+                    request_id=req.id, step="mask", reason="mask_advance",
+                )
+                req.trace.event("quarantine", step="mask", reason="mask_advance")
+                err.flight_snapshot = self.flight.incident(
+                    "quarantine", request_id=req.id, step="mask",
+                    reason="mask_advance",
+                )
+                if req.handle._fail(err):
+                    self.stats.incr("failed")
+                    self.recovery_stats.incr("quarantined")
+                return True
         if req.imported_kv is not None:
             # disaggregated decode pool: the prompt's KV arrived over
             # the handoff wire — import it instead of prefilling
@@ -1544,10 +1633,16 @@ class ContinuousBatchingScheduler:
         t_dev = time.perf_counter()
         self._span("admit", t_q1, t_dev)
         try:
+            pf_mask = None
+            if req.mask_state is not None:
+                # the prefill samples this stream's next token in-jit:
+                # mask it exactly like a decode step would
+                pf_mask = req.mask_state.mask_row(req.sampling.eos_id)
+                self.constrained_stats.incr("masked_steps")
             token = self._device(
                 lambda: self.engine.prefill_one(
                     req.prompt, table, req.sampling, req.sample_key(),
-                    prefix_len=prefix_len,
+                    prefix_len=prefix_len, mask=pf_mask,
                 )
             )
         except Exception as e:
@@ -1790,6 +1885,40 @@ class ContinuousBatchingScheduler:
     def _emit_token(self, state: _Running, token: int) -> None:
         state.req.generated.append(int(token))
         state.req.handle._emit(int(token))
+        if state.req.mask_state is not None:
+            self._advance_mask(state.req, int(token))
+
+    def _advance_mask(self, req: Request, token: int) -> None:
+        """Advance a constrained request's automaton over one emitted
+        token — host bookkeeping that the overlap pipeline hides under
+        device execution. NEVER raises: emit paths run deep inside
+        admission/scatter flows where an exception would take down the
+        batch, so a refused advance (injected generation.mask_advance
+        fault or replay divergence) parks a typed error on the request
+        for the step loop's quarantine sweep (_sweep_mask_errors) —
+        blast radius of ONE stream. A cleanly exhausted grammar
+        (accepting, no live continuation) instead clamps the budget so
+        the stream completes this step."""
+        ms = req.mask_state
+        try:
+            ms.advance(token, req.sampling.eos_id)
+        except Exception as e:
+            reason = (
+                "mask_dead_end" if isinstance(e, MaskDeadEndError)
+                else "mask_advance"
+            )
+            self.constrained_stats.incr("dead_end_failures")
+            req.mask_error = PoisonedRequestError(
+                f"request {req.id} grammar refused emitted token "
+                f"{token}: {e}",
+                request_id=req.id, step="mask", reason=reason,
+            )
+            return
+        if ms.exhausted() and not ms.done:
+            # the grammar has exactly one continuation left (EOS, when
+            # the request has one): end the stream deterministically
+            # instead of decoding against an everything-banned row
+            req.max_new = req.n_generated
 
     def _plan_speculation(self) -> None:
         """Decide each running sequence's draft count for THIS step:
@@ -1854,6 +1983,7 @@ class ContinuousBatchingScheduler:
         self._release(state)
         req = state.req
         req.prompt = req.original_prompt + list(req.generated)
+        req.mask_state = None  # rebuilt from `generated` at re-admission
         req.preemptions += 1
         self.preemptions += 1
         req.trace.note_preempt()
@@ -1889,6 +2019,26 @@ class ContinuousBatchingScheduler:
             seeds[i] = req.sampling.seed & 0xFFFFFFFF
             counts[i] = req.n_generated
         return last, start, tables, active, temps, top_ks, seeds, counts
+
+    def _decode_mask(self, order):
+        """[B, V] grammar-mask rows for one decode step, or None when
+        no live slot is constrained — the engine then stages its one
+        cached zeros array: no per-step upload, no new program, the
+        common case pays an any() over the batch."""
+        if not any(s.req.mask_state is not None for s in order):
+            return None
+        mask = np.zeros(
+            (self.engine.max_batch_slots, self.engine.cfg.vocab_size),
+            np.float32,
+        )
+        n = 0
+        for state in order:
+            ms = state.req.mask_state
+            if ms is not None:
+                mask[state.slot] = ms.mask_row(state.req.sampling.eos_id)
+                n += 1
+        self.constrained_stats.incr("masked_steps", n)
+        return mask
 
     def _quarantine_nan(self, kind: str, order) -> bool:
         """Act on the engine's per-slot NaN blame vector after a step
@@ -1932,23 +2082,26 @@ class ContinuousBatchingScheduler:
         b = self.engine.max_batch_slots
         (tokens, positions, tables, active, temps, top_ks, seeds,
          counts) = self._collect_slots(order)
+        mask = self._decode_mask(order)
 
         def step():
             return self.engine.decode(
                 tokens, positions, tables, active, temps, top_ks, seeds,
-                counts,
+                counts, mask,
             )
 
         def probe(subset):
             # blame-assignment probe: same step with only ``subset``
-            # active; outputs discarded, cache writes idempotent
+            # active; outputs discarded, cache writes idempotent (the
+            # SAME mask as the real step, so bisection re-runs are
+            # deterministic for constrained slots too)
             act = np.zeros((b,), bool)
             for s in subset:
                 act[s.slot] = True
             self._probe_call(
                 lambda: self.engine.decode(
                     tokens, positions, tables, act, temps, top_ks, seeds,
-                    counts,
+                    counts, mask,
                 )
             )
 
@@ -2040,6 +2193,14 @@ class ContinuousBatchingScheduler:
                 or req.finished()
                 or (req.deadline is not None and now >= req.deadline)
                 or req.drafter is not None
+                # constrained slots are non-steady by construction: the
+                # pipeline dispatches step N+1 with step N's token still
+                # device-resident, and the host cannot advance the
+                # automaton (= build N+1's mask row) over a token it has
+                # not seen. Sequential stepping keeps constrained
+                # streams byte-identical overlap on/off — the existing
+                # drafter clause rides the same reasoning.
+                or req.grammar is not None
             ):
                 return True
         return False
@@ -2371,6 +2532,41 @@ class ContinuousBatchingScheduler:
             self.engine.allocator.free(extra)
             self.capacity.note_trim(len(extra))
 
+    def _verify_mask(self, order, window, n_draft) -> Optional[np.ndarray]:
+        """(batch, window, vocab) additive grammar bias for ONE verify
+        step, or None when nothing running is constrained (the engine
+        stages its cached all-zeros array — no new program, no upload).
+
+        Position j of the window samples the token that FOLLOWS the
+        first j window tokens, so row 0 is the current automaton
+        state's mask and row j+1 is the mask at the state reached by
+        consuming draft tokens 0..j — exactly the states a masked
+        sequential decode would pass through if it accepted that
+        prefix. Masking draft scoring and target sampling with the
+        same rows is what keeps speculative acceptance byte-identical
+        to the unspeculated constrained stream."""
+        if not any(s.req.mask_state is not None for s in order):
+            return None
+        mask = np.zeros(
+            (self.engine.max_batch_slots, self.engine.spec_window,
+             self.engine.cfg.vocab_size),
+            np.float32,
+        )
+        n = 0
+        for state in order:
+            ms = state.req.mask_state
+            if ms is None:
+                continue
+            i = state.slot
+            eos = state.req.sampling.eos_id
+            mask[i, 0] = ms.mask_row(eos)
+            draft = [int(t) for t in window[i, 1 : 1 + max(0, int(n_draft[i]))]]
+            for j, st in enumerate(ms.states_along(draft, eos)):
+                mask[i, j + 1] = ms.dfa.mask_row(st, eos)
+            n += 1
+        self.constrained_stats.incr("masked_steps", n)
+        return mask
+
     def _verify_once(self) -> bool:
         """One speculative verification step across all running slots:
         draft (host), verify the batch × (k+1) window (ONE fixed-shape
@@ -2409,6 +2605,11 @@ class ContinuousBatchingScheduler:
                     # verification is exact with ANY draft, so a failed
                     # proposal degrades to a plain (zero-draft) step
                     self.stats.incr("drafter_errors")
+            if req.mask_state is not None and draft:
+                # grammar-banned draft tokens would be rejected by the
+                # masked target anyway; trimming to the longest legal
+                # prefix just stops them wasting verify positions
+                draft = req.mask_state.filter_draft(draft, req.sampling.eos_id)
             window[i, 1 : 1 + len(draft)] = draft
             n_draft[i] = len(draft)
         t_d1 = time.perf_counter()
@@ -2417,10 +2618,12 @@ class ContinuousBatchingScheduler:
         # the old host "sample" phase (vmapped fold_in + stack per
         # request) no longer exists
         info["drafted"] = int(np.maximum(n_draft, 0).sum())
+        wmask = self._verify_mask(order, window, n_draft)
 
         def step():
             return self.engine.verify(
-                window, start, n_draft, tables, temps, top_ks, seeds, counts
+                window, start, n_draft, tables, temps, top_ks, seeds, counts,
+                mask=wmask,
             )
 
         def probe(subset):
@@ -2429,7 +2632,8 @@ class ContinuousBatchingScheduler:
                 nd[s.slot] = n_draft[s.slot]
             self._probe_call(
                 lambda: self.engine.verify(
-                    window, start, nd, tables, temps, top_ks, seeds, counts
+                    window, start, nd, tables, temps, top_ks, seeds, counts,
+                    mask=wmask,
                 )
             )
 
@@ -2466,15 +2670,25 @@ class ContinuousBatchingScheduler:
             n_accepted += accepted
             req.update_speculation(proposed=int(max(0, n_draft[i])), accepted=accepted)
             req.trace.note_speculation(int(max(0, n_draft[i])), accepted)
-            self.spec_stats.record_window(
-                proposed=int(max(0, n_draft[i])), accepted=accepted, emitted=len(toks)
-            )
+            emitted = 0
             for t in toks:
                 self._emit_token(state, t)
-            req.trace.note_tokens(len(toks), "verify")
-            state.cached_len += len(toks)
+                emitted += 1
+                if req.mask_state is not None and (
+                    req.mask_error is not None or req.finished()
+                ):
+                    # constrained stream ended mid-window — a parked
+                    # advance error or the exhaustion clamp. The rest of
+                    # the accepted run was sampled at states past the
+                    # grammar's end: drop it, never surface or cache it.
+                    break
+            self.spec_stats.record_window(
+                proposed=int(max(0, n_draft[i])), accepted=accepted, emitted=emitted
+            )
+            req.trace.note_tokens(emitted, "verify")
+            state.cached_len += emitted
             self._trim_blocks(state)
-            n_live_tokens += len(toks)
+            n_live_tokens += emitted
             if req.finished():
                 self._finish(state)
         self._span("bookkeep", t_book, time.perf_counter())
@@ -2560,6 +2774,7 @@ class ContinuousBatchingScheduler:
                 self._overload_tick()
                 return r
         self._expire()
+        self._sweep_mask_errors()
         t1 = time.perf_counter()
         self._span("schedule", t0, t1)
         admitted = 0

@@ -24,6 +24,18 @@ engine's fixed-shape verify step:
   window the bonus token is drawn from ``p`` itself. The marginal of
   every emitted token is exactly ``p``.
 
+**Constrained decoding** (ISSUE 18) composes with both regimes without
+touching this module: the grammar mask is a pre-softmax additive bias
+applied IDENTICALLY to the draft logits that proposed each window
+position and to the target logits that verify it. Over the grammar's
+support the masked target distribution is still a distribution and the
+masked proposal is still its point-mass/q proposal, so the acceptance
+identities above hold verbatim and the emitted marginal is exactly the
+masked target's. Tokens outside the support have ``p(d) = 0`` — a
+grammar-banned draft is rejected with certainty and the residual/bonus
+draws renormalize over legal tokens only (the scheduler additionally
+trims banned drafts before verify so they never waste window slots).
+
 Key discipline mirrors the engine's per-token-count seeded streams: the
 token emitted at generated-count ``n`` consumes keys derived ONLY from
 ``fold_in(base_key, n)`` — the accept coin from ``fold_in(key, 1)``,

@@ -127,6 +127,11 @@ _HELP = {
     "degrade_transitions_total": "Degradation-ladder level transitions (cumulative).",
     "autoscale_signal": "Fleet autoscale signal: 1 want-more, -1 want-fewer, 0 steady.",
     "autoscale_want_replicas": "Replica count the fleet's sustained limiter state asks for.",
+    "constrained_grammar_cache_hits_total": "response_format grammars served from the per-model compile cache (cumulative).",
+    "constrained_grammar_cache_misses_total": "response_format grammars compiled from scratch (cumulative).",
+    "constrained_grammar_compile_seconds_total": "Wall seconds spent compiling response_format grammars (cumulative).",
+    "constrained_masked_steps_total": "Prefill/decode/verify rows stepped under a grammar mask (cumulative).",
+    "constrained_dead_end_failures_total": "Constrained streams failed by a grammar dead-end or refused advance (cumulative).",
     "kv_imports": "KV handoff payloads imported into decode slots (disaggregated serving).",
     "kv_imports_rejected": "KV handoff imports rejected at unpack (stream fell back to recompute-prefill).",
     "fleet_replicas": "Current fleet replicas per lifecycle state.",

@@ -80,6 +80,8 @@ FLEET_ROUTE = "fleet.route"
 FLEET_REPLICA_SPAWN = "fleet.replica_spawn"
 FLEET_KV_HANDOFF = "fleet.kv_handoff"
 GENERATION_KV_IMPORT = "generation.kv_import"
+GENERATION_MASK_BUILD = "generation.mask_build"
+GENERATION_MASK_ADVANCE = "generation.mask_advance"
 
 # site -> "where it fires" (read-only: registering a site means adding a
 # constant + an entry here + the inject() call, in one reviewed place)
@@ -152,6 +154,17 @@ SITES = MappingProxyType({
         "before the decode-side unpack of an imported KV payload (value: "
         "(request id, n_blocks)); an error rejects the import and the "
         "stream falls back to recompute-prefill on the decode replica"
+    ),
+    GENERATION_MASK_BUILD: (
+        "before a response_format grammar compiles into the per-model "
+        "cache (value: the canonical spec key); an error fails the ONE "
+        "submitting request with a typed 400, never the batch"
+    ),
+    GENERATION_MASK_ADVANCE: (
+        "before each constrained-stream automaton advance over an emitted "
+        "token — including journal-replay re-advances (value: (grammar "
+        "state, token)); an error quarantines the one constrained request "
+        "while the rest of the batch keeps streaming"
     ),
 })
 

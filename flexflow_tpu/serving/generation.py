@@ -27,6 +27,11 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
+from ..generation.constrained import (
+    GrammarCache,
+    GrammarError,
+    default_vocabulary,
+)
 from ..generation.engine import GenerationEngine, SamplingParams
 from ..generation.scheduler import ContinuousBatchingScheduler, GenerationHandle
 from ..generation.speculative import SpeculationConfig
@@ -39,11 +44,23 @@ class GenerationModel:
         self,
         engine: GenerationEngine,
         name: str = "generator",
+        vocabulary: Optional[Sequence[str]] = None,
         **scheduler_kwargs,
     ):
         self.engine = engine
         self.name = name
         self.scheduler = ContinuousBatchingScheduler(engine, **scheduler_kwargs)
+        # response_format grammars compile against THIS model's token
+        # texts; no tokenizer ships with the engine, so the synthetic
+        # default vocabulary stands in unless the deployment passes one
+        self.vocabulary: List[str] = list(
+            vocabulary
+            if vocabulary is not None
+            else default_vocabulary(engine.cfg.vocab_size)
+        )
+        self.grammar_cache = GrammarCache(
+            self.vocabulary, stats=self.scheduler.constrained_stats
+        )
 
     # --------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -149,10 +166,18 @@ class GenerationModel:
         speculation: Optional[SpeculationConfig] = None,
         transport: Optional[str] = None,
         priority: Optional[str] = None,
+        response_format: Optional[Dict] = None,
     ) -> GenerationHandle:
+        grammar = None
+        if response_format is not None:
+            # compiles (or cache-hits) BEFORE the request joins the
+            # queue: a malformed grammar is the submitter's 400, it
+            # never reaches the batch
+            grammar = self.grammar_cache.get(response_format)
         return self.scheduler.submit(
             prompt, sampling, deadline_s=deadline_s, speculation=speculation,
             transport=transport, priority=priority,
+            grammar=grammar, response_format=response_format,
         )
 
     def generate(
@@ -161,9 +186,13 @@ class GenerationModel:
         sampling: Optional[SamplingParams] = None,
         timeout: Optional[float] = None,
         speculation: Optional[SpeculationConfig] = None,
+        response_format: Optional[Dict] = None,
     ) -> List[int]:
         """Blocking single-request generation (deadline = timeout)."""
-        handle = self.submit(prompt, sampling, deadline_s=timeout, speculation=speculation)
+        handle = self.submit(
+            prompt, sampling, deadline_s=timeout, speculation=speculation,
+            response_format=response_format,
+        )
         return handle.result(timeout=timeout)
 
     @staticmethod
@@ -200,6 +229,21 @@ class GenerationModel:
             min_ngram=int(block.get("min_ngram", defaults.min_ngram)),
             adaptive=bool(block.get("adaptive", defaults.adaptive)),
         )
+
+    @staticmethod
+    def response_format_from(params: Dict) -> Optional[Dict]:
+        """Pull the request's ``response_format`` block (HTTP JSON body
+        / gRPC parameters map). Absent -> None (unconstrained). A
+        present-but-malformed block raises :class:`GrammarError` — a
+        ValueError, so both front ends map it to 400/INVALID_ARGUMENT."""
+        block = params.get("response_format")
+        if block is None:
+            return None
+        if not isinstance(block, dict):
+            raise GrammarError(
+                f"response_format must be an object, got {type(block).__name__}"
+            )
+        return block
 
     def metadata(self) -> Dict:
         cfg = self.engine.cfg
@@ -250,6 +294,11 @@ class GenerationModel:
             "prefix_cache": {
                 "enabled": self.engine.prefix_cache.enabled,
                 "host_budget_bytes": self.engine.prefix_cache.host_budget_bytes,
+            },
+            "constrained": {
+                "formats": ["json_schema", "regex"],
+                "grammar_cache_entries": len(self.grammar_cache),
+                "vocabulary_tokens": len(self.vocabulary),
             },
             "inputs": [{"name": "tokens", "shape": (-1,), "datatype": "INT32"}],
             "outputs": [{"name": "tokens", "shape": (-1,), "datatype": "INT32"}],

@@ -17,6 +17,7 @@ kserve_v2_pb2.py, regenerated from kserve_v2.proto with protoc).
 """
 from __future__ import annotations
 
+import json
 import threading
 from concurrent import futures
 from typing import Dict, Optional
@@ -400,8 +401,11 @@ class GrpcInferenceServer:
         """Streaming generation: request carries the prompt as an INT32
         "tokens" input; sampling rides the parameters map
         (max_new_tokens / top_k / eos_id / seed as int64_param,
-        temperature as string_param). Yields one response per generated
-        token, then a final summary response with the full sequence."""
+        temperature as string_param; a constrained request carries its
+        ``response_format`` spec JSON-encoded as a string_param — a
+        malformed grammar is INVALID_ARGUMENT for this call alone).
+        Yields one response per generated token, then a final summary
+        response with the full sequence."""
         grpc = self._grpc
         gen = self.generators.get(request.model_name)
         if gen is None:
@@ -430,10 +434,17 @@ class GrpcInferenceServer:
                 kind = p.WhichOneof("parameter_choice")
                 params[key] = getattr(p, kind) if kind else None
             sampling = gen.sampling_from(params)
+            rf = params.get("response_format")
+            if isinstance(rf, (str, bytes)):
+                rf = json.loads(rf)
+            response_format = gen.response_format_from(
+                {"response_format": rf} if rf is not None else {}
+            )
             remaining = context.time_remaining()
             handle = gen.submit(
                 prompt, sampling, deadline_s=remaining, transport="grpc",
                 priority=params.get("priority"),
+                response_format=response_format,
             )
         except ResilienceError as e:
             self._abort(context, grpc_code(e, grpc), str(e), err=e)

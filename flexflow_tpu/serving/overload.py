@@ -117,7 +117,14 @@ class OverloadConfig:
     max_limit: Optional[int] = None     # ceiling (default: slots + max_queue)
     target_queue_s: float = 0.5         # queue-time p95 target
     target_ttft_s: float = 2.5          # TTFT p95 target (matches the SLO)
-    min_queue_frac: float = 0.125       # occupancy floor before any cut
+    # occupancy floor before any cut. 0.25 is the simfleet-tuned value
+    # (SIM_TUNE.json, `python tools/simfleet.py tune`): on the canned
+    # storm at 0.5-1x traffic it completes more requests (lower shed)
+    # at the SAME worst-case TTFT p99 as the previous 0.125 — the
+    # deeper floor stops the limiter cutting on queues the engine was
+    # about to drain anyway. Guarded by the SIM_TUNE drift test: re-run
+    # the sweep before moving it.
+    min_queue_frac: float = 0.25
     hard_queue_frac: float = 0.5        # occupancy at/above which the cut
                                         # signal fires unconditionally
     # per-class admission headroom: fraction of the live limit each
@@ -131,7 +138,12 @@ class OverloadConfig:
         }
     )
     # ---- DegradeLadder
-    up_threshold: float = 0.8           # pressure >= this to climb...
+    # pressure >= this to climb. 0.9 is the simfleet-tuned value
+    # (SIM_TUNE.json): identical shed and TTFT p99 envelope to 0.8 on
+    # the storm sweep with fewer ladder transitions — the later trigger
+    # skips climbs the limiter alone was already absorbing, and every
+    # skipped transition is one less mid-stream behavior flip.
+    up_threshold: float = 0.9
     up_hold_s: float = 0.25             # ...sustained this long
     down_threshold: float = 0.3         # pressure <= this to descend...
     down_hold_s: float = 1.0            # ...sustained this long (hysteresis)

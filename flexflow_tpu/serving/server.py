@@ -656,8 +656,12 @@ class InferenceServer:
                 [ids], "max_new_tokens", "temperature", "top_k",
                 "eos_id", "seed", "stream", "parameters": {"timeout_ms"},
                 "speculation": {"enabled", "k", "method", "max_ngram",
-                "min_ngram", "adaptive"}}. The speculation block turns
-                on (exact) speculative decoding for this request.
+                "min_ngram", "adaptive"}, "response_format": {"type":
+                "json_schema"|"regex", ...}}. The speculation block
+                turns on (exact) speculative decoding for this request;
+                response_format constrains the stream to a grammar (a
+                malformed grammar is THIS request's 400, never the
+                batch's).
                 Non-streaming: one JSON object. "stream": true: SSE — one
                 ``data:`` event per token, then a final done event."""
                 gen = server.generators.get(name)
@@ -679,10 +683,11 @@ class InferenceServer:
                     priority = req.get(
                         "priority", self.headers.get("X-Request-Priority")
                     )
+                    response_format = gen.response_format_from(req)
                     handle = gen.submit(
                         prompt, sampling, deadline_s=deadline_s,
                         speculation=speculation, transport="http",
-                        priority=priority,
+                        priority=priority, response_format=response_format,
                     )
                 except ResilienceError as e:
                     return self._json(

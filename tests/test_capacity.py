@@ -385,6 +385,7 @@ def test_program_registry_records_and_blames_retrace(decoder_params):
         jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32),
         jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32),
         jnp.zeros((b,), jnp.uint32), jnp.zeros((b,), jnp.int32),
+        jnp.zeros((b, eng.cfg.vocab_size), jnp.float32),
     )
     assert eng.programs.total_retraces() == 1
     (retrace,) = eng.programs.recent_retraces()

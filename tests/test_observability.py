@@ -274,6 +274,12 @@ def _golden_stats():
     # ISSUE 16 disaggregated-serving KV import counters (binary-exact)
     s.add_gauge("kv_imports", lambda: 2)
     s.add_gauge("kv_imports_rejected", lambda: 1)
+    # ISSUE 18 constrained-decoding families (binary-exact values)
+    s.add_gauge("constrained_grammar_cache_hits_total", lambda: 3)
+    s.add_gauge("constrained_grammar_cache_misses_total", lambda: 1)
+    s.add_gauge("constrained_grammar_compile_seconds_total", lambda: 0.25)
+    s.add_gauge("constrained_masked_steps_total", lambda: 12)
+    s.add_gauge("constrained_dead_end_failures_total", lambda: 1)
     return s
 
 

@@ -395,6 +395,185 @@ def run_recovery_sweep() -> bool:
     return not failures
 
 
+def run_constrained_sweep() -> bool:
+    """Constrained-decoding sweep (ISSUE 18): a mixed constrained +
+    unconstrained batch against a live engine —
+
+      build failure   generation.mask_build fails the grammar compile ->
+                      the ONE submitting caller gets the injected error
+                      at submit time (nothing joined the queue), the
+                      retry compiles clean, and the re-run batch is
+                      byte-identical to the fault-free reference
+      advance failure generation.mask_advance refuses an emitted token
+                      mid-stream -> exactly that request quarantines
+                      with a typed PoisonedRequestError(step="mask");
+                      the unconstrained survivors match the reference
+                      byte-for-byte, zero engine restarts
+      crash replay    a decode step hard-fails twice mid-constrained-
+                      stream -> engine restart + journal replay
+                      re-advances the automaton over every emitted
+                      token; the constrained stream (and everyone else)
+                      comes out byte-identical and schema-valid
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO)
+
+    import jax
+
+    from flexflow_tpu.generation import (
+        ContinuousBatchingScheduler,
+        GenerationEngine,
+        PoisonedRequestError,
+        RecoveryPolicy,
+        SamplingParams,
+        init_decoder_params,
+    )
+    from flexflow_tpu.generation.constrained import (
+        GrammarCache,
+        decode_text,
+        default_vocabulary,
+        validate_json,
+    )
+    from flexflow_tpu.models.transformer import TransformerConfig
+    from flexflow_tpu.runtime.faults import FaultPlan
+
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=32, num_heads=4, ff_size=64,
+        seq_length=64, vocab_size=50, causal=True,
+    )
+    params = init_decoder_params(jax.random.key(0), cfg)
+    vocab = default_vocabulary(cfg.vocab_size)
+    schema = {"type": "object",
+              "properties": {"ok": {"type": "boolean"},
+                             "n": {"type": "integer"}}}
+    spec = {"type": "json_schema", "json_schema": schema}
+    prompts = [[1, 2, 3], [4, 5, 6, 7], [9, 8, 7, 6, 5]]  # [0] constrained
+    # enough budget for the grammar to COMPLETE (worst-case integer is
+    # 10 tokens): the exhaustion clamp ends the stream, not the budget
+    sampling = SamplingParams(max_new_tokens=40)
+    policy = RecoveryPolicy(sleep=lambda _s: None)
+
+    eng = GenerationEngine(params, cfg, max_batch_slots=3, block_size=8)
+    eng.generate([[1] * 12], SamplingParams(max_new_tokens=2))  # warm
+
+    def make():
+        return (ContinuousBatchingScheduler(eng, recovery=policy),
+                GrammarCache(vocab))
+
+    def submit_mix(sched, grammar):
+        return [sched.submit(prompts[0], sampling, grammar=grammar,
+                             response_format=spec)] + [
+            sched.submit(p, sampling) for p in prompts[1:]
+        ]
+
+    def drive(sched, handles, steps=800):
+        for _ in range(steps):
+            if all(h.done() for h in handles):
+                return
+            if not sched.step():
+                return
+
+    report, failures = {}, []
+
+    def check(scenario, cond, msg):
+        if not cond:
+            failures.append(f"{scenario}: {msg}")
+
+    # ----------------------------------------------------- reference run
+    sched, cache = make()
+    handles = submit_mix(sched, cache.get(spec))
+    drive(sched, handles)
+    ref = [h.result(timeout=0) for h in handles]
+    text = decode_text(vocab, ref[0], sampling.eos_id)
+    problems = validate_json(text, schema)
+    check("reference", not problems,
+          f"fault-free constrained stream not schema-valid: {text!r} {problems}")
+    check("reference", eng.resets == 0, "fault-free run restarted the engine")
+    report["reference"] = {"constrained_text": text,
+                           "tokens": sum(len(r) for r in ref)}
+
+    # ----------------------------------------------------- build failure
+    sched, cache = make()
+    plan = FaultPlan(seed=0)
+    plan.on("generation.mask_build", mode="error",
+            error=RuntimeError("injected grammar-compile failure"), nth=(0,))
+    typed = False
+    with plan.active():
+        try:
+            cache.get(spec)
+        except RuntimeError:
+            typed = True  # the submitting caller's error, pre-queue
+        check("build", typed, "injected build failure did not surface")
+        # the failure poisoned nothing: the retry compiles clean and the
+        # full mix replays byte-identically
+        handles = submit_mix(sched, cache.get(spec))
+        drive(sched, handles)
+    got = [h.result(timeout=0) for h in handles]
+    check("build", got == ref, "streams diverged after a failed grammar build")
+    check("build", plan.fired("generation.mask_build") == 1,
+          "build fault never fired")
+    check("build", eng.resets == 0, "a submit-time build failure restarted the engine")
+    report["build"] = {"typed": typed, "exact": got == ref}
+
+    # --------------------------------------------------- advance failure
+    sched, cache = make()
+    plan = FaultPlan(seed=0)
+    plan.on("generation.mask_advance", mode="error",
+            error=RuntimeError("injected advance failure"), nth=(5,))
+    with plan.active():
+        handles = submit_mix(sched, cache.get(spec))
+        drive(sched, handles)
+    rs = sched.recovery_stats
+    try:
+        handles[0].result(timeout=0)
+        check("advance", False, "constrained stream did not fail")
+    except PoisonedRequestError as e:
+        check("advance", e.step == "mask", f"wrong step {e.step!r}")
+    except Exception as e:
+        check("advance", False, f"constrained stream failed untyped: {e!r}")
+    for i in (1, 2):
+        check("advance", handles[i].result(timeout=0) == ref[i],
+              f"unconstrained survivor {i} diverged")
+    check("advance", rs.quarantined == 1,
+          f"expected 1 quarantine, got {rs.quarantined}")
+    check("advance", eng.resets == 0,
+          "a single refused advance restarted the engine")
+    check("advance", sched.constrained_stats.dead_end_failures == 1,
+          "dead_end_failures counter did not record the quarantine")
+    report["advance"] = {"quarantined": rs.quarantined}
+
+    # -------------------------------------- crash mid-constrained-stream
+    sched, cache = make()
+    plan = FaultPlan(seed=0)
+    plan.on("generation.decode_step", mode="error",
+            error=RuntimeError("injected device crash"), nth=(2, 3))
+    with plan.active():
+        handles = submit_mix(sched, cache.get(spec))
+        drive(sched, handles)
+    got = [h.result(timeout=0) for h in handles]
+    rs = sched.recovery_stats
+    text = decode_text(vocab, got[0], sampling.eos_id)
+    check("crash", got == ref,
+          f"streams diverged after crash replay: {got} != {ref}")
+    check("crash", not validate_json(text, schema),
+          f"replayed constrained stream not schema-valid: {text!r}")
+    check("crash", rs.recoveries == 1, f"expected 1 recovery, got {rs.recoveries}")
+    check("crash", no_leaked_blocks(eng), "leaked blocks")
+    report["crash"] = {"recoveries": rs.recoveries,
+                       "replayed_tokens": rs.replayed_tokens,
+                       "exact": got == ref}
+
+    report["ok"] = not failures
+    print(json.dumps({"constrained_sweep": report}, indent=2))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print("OK: constrained sweep — build failure typed pre-queue, "
+              "advance failure quarantined alone, crash replay "
+              "byte-identical and schema-valid")
+    return not failures
+
+
 def run_fleet_sweep() -> bool:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, REPO)
@@ -1149,6 +1328,11 @@ def main() -> int:
                     help="also run the disaggregated-serving sweep (KV "
                          "handoff retry/corrupt/stall/prefill-death + the "
                          "tp-mismatch resharded handoff, all byte-exact)")
+    ap.add_argument("--constrained", action="store_true",
+                    help="also run the constrained-decoding sweep "
+                         "(grammar build failure typed pre-queue, "
+                         "mid-stream advance failure quarantined alone, "
+                         "crash replay byte-exact + schema-valid)")
     ap.add_argument("--mesh", type=int, default=0, metavar="N",
                     help="run ONLY the sharded-generation sweep on a "
                          "forced N-device host mesh (failed/stalled "
@@ -1183,6 +1367,9 @@ def main() -> int:
             rc = 1
     if args.disagg and rc == 0:
         if not run_disagg_sweep():
+            rc = 1
+    if args.constrained and rc == 0:
+        if not run_constrained_sweep():
             rc = 1
     return rc
 

@@ -470,6 +470,41 @@ def show_disagg(base: str) -> int:
     return 0
 
 
+def show_constrained(base: str) -> int:
+    """Constrained-decoding view (GET /v2/stats + model metadata): the
+    grammar-cache hit economics, how many masked rows the engine
+    stepped, and the dead-end quarantine count — the "are response_format
+    requests healthy and cheap?" answer."""
+    stats = _get_json(f"{base}/v2/stats")
+    shown = 0
+    for name, snap in sorted(stats.get("generation", {}).items()):
+        hits = snap.get("constrained_grammar_cache_hits_total")
+        if hits is None:
+            continue
+        shown += 1
+        misses = snap.get("constrained_grammar_cache_misses_total", 0)
+        total = hits + misses
+        ratio = (hits / total) if total else 0.0
+        print(f"model {name!r} (constrained):")
+        print(f"    grammar cache: hits={hits} misses={misses} "
+              f"hit_ratio={ratio:.2f} "
+              f"compile_s={snap.get('constrained_grammar_compile_seconds_total', 0.0):.3f}")
+        print(f"    masked_steps={snap.get('constrained_masked_steps_total', 0)}  "
+              f"dead_end_failures={snap.get('constrained_dead_end_failures_total', 0)}")
+        try:
+            meta = _get_json(f"{base}/v2/models/{name}")
+        except Exception:
+            meta = {}
+        con = meta.get("constrained") or {}
+        if con:
+            print(f"    cache entries={con.get('grammar_cache_entries')}  "
+                  f"vocabulary_tokens={con.get('vocabulary_tokens')}  "
+                  f"formats={','.join(con.get('formats', []))}")
+    if not shown:
+        print("no generation models expose constrained counters")
+    return 0
+
+
 def dump_timeline(base: str, out: str) -> int:
     payload = _get_json(f"{base}/v2/debug/timeline")
     with open(out, "w") as f:
@@ -853,7 +888,7 @@ def main() -> int:
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("command", nargs="?", default="summary",
                     choices=("summary", "cache", "slo", "predict", "anatomy",
-                             "overload", "disagg"),
+                             "overload", "disagg", "constrained"),
                     help="view: summary (default), cache (block "
                          "residency), slo (burn rates), predict "
                          "(cost-model truth: error table + drift alarms), "
@@ -861,7 +896,8 @@ def main() -> int:
                          "headroom), overload (limiter state, ladder "
                          "history, shed table, autoscale signal), disagg "
                          "(pool states, KV handoff outcomes + latency, "
-                         "in-flight transfers)")
+                         "in-flight transfers), constrained (grammar-cache "
+                         "economics, masked steps, dead-end quarantines)")
     ap.add_argument("--url", default="", help="base URL of a running server")
     ap.add_argument("--request", type=int, default=None,
                     help="print one request's trace waterfall")
@@ -903,6 +939,8 @@ def main() -> int:
         return show_overload(base)
     if args.command == "disagg":
         return show_disagg(base)
+    if args.command == "constrained":
+        return show_constrained(base)
     return summarize(base)
 
 

@@ -96,6 +96,13 @@ METRICS: Dict[str, Tuple[str, str, float]] = {
     "disagg_ttft_p95_ratio": ("higher", "rel", 0.15),
     "disagg_tpot_p50_ratio": ("higher", "rel", 0.12),
     "disagg_ttft_p95_s": ("lower", "rel", 0.25),
+    # constrained decoding (ISSUE 18): the A/B ratio is a median of
+    # per-pair interleaved runs (machine drift cancels within a pair),
+    # so it gets a tight floor — a drop means the grammar mask's
+    # per-step host cost grew. The constrained-arm tok/s is a raw wall
+    # clock and gets the wide relative floor.
+    "constrained_tokens_per_s_ratio": ("higher", "rel", 0.08),
+    "constrained_decode_tokens_per_s": ("higher", "rel", 0.25),
 }
 
 

@@ -330,6 +330,137 @@ def _live_storm(schedule_path: str):
     return metrics, eng
 
 
+def cmd_tune(args) -> int:
+    """Sweep OverloadConfig's degrade/admission thresholds in the twin
+    and rank them on the canned storm replayed at several traffic
+    multipliers. The objective is baseline-relative: a candidate is
+    feasible only if its worst TTFT p99 across traffic levels does not
+    exceed the CURRENT serving defaults' worst p99 (tuning may not buy
+    shed by regressing the latency envelope operators already get);
+    feasible candidates rank by total shed, then degrade-ladder churn
+    (each transition flips serving behavior mid-stream), then distance
+    from the incumbent defaults — an exact metric tie must not move
+    the defaults. The ranked table is checked in as SIM_TUNE.json; a
+    drift-guard test pins the serving defaults in
+    flexflow_tpu/serving/overload.py to the winner, so the defaults
+    can only change together with re-run evidence."""
+    grid_up = [float(x) for x in args.up_thresholds.split(",")]
+    grid_down = [float(x) for x in args.down_thresholds.split(",")]
+    grid_mqf = [float(x) for x in args.min_queue_fracs.split(",")]
+    traffic = [float(x) for x in args.traffic.split(",")]
+    costs = SimCosts.fixed_tick(STORM_DT)
+    default = OverloadConfig()
+    defaults = {
+        "up_threshold": default.up_threshold,
+        "down_threshold": default.down_threshold,
+        "min_queue_frac": default.min_queue_frac,
+    }
+
+    def evaluate(up: float, down: float, mqf: float) -> dict:
+        # only the swept fields move; everything else stays at the
+        # serving defaults so the winner maps 1:1 onto them
+        cfg = OverloadConfig(
+            up_threshold=up, down_threshold=down, min_queue_frac=mqf,
+        )
+        shed_total = churn_total = 0.0
+        p99_max = 0.0
+        levels = {}
+        for tx in traffic:
+            rep = run_scenario(args.schedule, costs, Scenario(
+                name=f"tune-x{tx:g}", arm="unified", replicas=1,
+                slots=STORM_SLOTS, max_queue=STORM_MAX_QUEUE,
+                num_blocks=25, block_size=8, overload=cfg, traffic_x=tx,
+            )).render()
+            churn = rep["overload"]["total"]["degrade_transitions"]
+            shed_total += rep["shed_rate"]
+            churn_total += churn
+            p99_max = max(p99_max, rep.get("ttft_p99_s") or 0.0)
+            levels[f"x{tx:g}"] = {
+                "shed_rate": rep["shed_rate"],
+                "ttft_p50_s": rep.get("ttft_p50_s"),
+                "ttft_p99_s": rep.get("ttft_p99_s"),
+                "degrade_transitions": churn,
+                "completed": rep["completed"],
+                "submitted": rep["submitted"],
+            }
+        return {
+            "scenario": f"up{up:g}-down{down:g}-mqf{mqf:g}",
+            "up_threshold": up,
+            "down_threshold": down,
+            "min_queue_frac": mqf,
+            "shed_total": round(shed_total, 6),
+            "ttft_p99_max_s": round(p99_max, 6),
+            "degrade_transitions": int(churn_total),
+            "levels": levels,
+        }
+
+    baseline = evaluate(
+        defaults["up_threshold"], defaults["down_threshold"],
+        defaults["min_queue_frac"],
+    )
+    p99_budget = baseline["ttft_p99_max_s"] + 1e-9
+    rows = []
+    for up in grid_up:
+        for down in grid_down:
+            for mqf in grid_mqf:
+                r = evaluate(up, down, mqf)
+                r["feasible"] = r["ttft_p99_max_s"] <= p99_budget
+                r["distance_from_default"] = round(
+                    abs(up - defaults["up_threshold"])
+                    + abs(down - defaults["down_threshold"])
+                    + abs(mqf - defaults["min_queue_frac"]), 6)
+                rows.append(r)
+    rows.sort(key=lambda r: (
+        not r["feasible"],
+        r["shed_total"],
+        r["degrade_transitions"],
+        r["ttft_p99_max_s"],
+        r["distance_from_default"],
+        r["scenario"],
+    ))
+    for rank, r in enumerate(rows, 1):
+        r["rank"] = rank
+    winner = rows[0]
+    matches = all(
+        abs(winner[k] - defaults[k]) < 1e-12 for k in defaults
+    )
+    print(f"baseline (serving defaults): shed_total "
+          f"{baseline['shed_total']:.3f}  ttft_p99_max "
+          f"{baseline['ttft_p99_max_s'] * 1e3:.0f}ms")
+    print("rank scenario                 shed_total  p99_max  churn  ok")
+    for r in rows[:10]:
+        print(
+            f"{r['rank']:>4} {r['scenario']:<24} {r['shed_total']:9.3f} "
+            f"{r['ttft_p99_max_s'] * 1e3:7.0f}ms {r['degrade_transitions']:5d}"
+            f"  {'yes' if r['feasible'] else 'NO'}"
+        )
+    verdict = ("MATCH" if matches else
+               "DIFFER: fold winner into flexflow_tpu/serving/overload.py")
+    print(f"winner: {winner['scenario']}  (serving defaults {verdict})")
+    out = {
+        "schema": "flexflow-sim-tune-v1",
+        "schedule": os.path.basename(args.schedule),
+        "traffic": traffic,
+        "grid": {
+            "up_thresholds": grid_up,
+            "down_thresholds": grid_down,
+            "min_queue_fracs": grid_mqf,
+        },
+        "baseline": baseline,
+        "ttft_p99_budget_s": round(p99_budget, 6),
+        "ranked": rows,
+        "winner": {k: winner[k] for k in (
+            "scenario", "up_threshold", "down_threshold", "min_queue_frac",
+            "shed_total", "ttft_p99_max_s", "degrade_transitions",
+        )},
+        "serving_defaults": defaults,
+        "defaults_match_winner": matches,
+    }
+    if args.out:
+        _write(out, args.out)
+    return 0 if matches or args.allow_drift else 1
+
+
 def cmd_simcheck(args) -> int:
     failures = []
 
@@ -502,6 +633,21 @@ def main() -> int:
     t.add_argument("--traffic-x", type=float, default=1.0)
     t.add_argument("--out", default="")
     t.set_defaults(fn=cmd_tp)
+
+    u = sub.add_parser(
+        "tune", help="sweep OverloadConfig thresholds -> SIM_TUNE.json")
+    u.add_argument("--schedule", default=STORM_SCHEDULE)
+    u.add_argument("--traffic", default="0.5,0.75,1.0",
+                   help="comma-separated traffic multipliers; metrics "
+                        "aggregate across all of them")
+    u.add_argument("--up-thresholds", default="0.7,0.8,0.9")
+    u.add_argument("--down-thresholds", default="0.2,0.3,0.4")
+    u.add_argument("--min-queue-fracs", default="0.0625,0.125,0.25")
+    u.add_argument("--out", default="SIM_TUNE.json")
+    u.add_argument("--allow-drift", action="store_true",
+                   help="exit 0 even when the winner differs from the "
+                        "serving defaults (exploration runs)")
+    u.set_defaults(fn=cmd_tune)
 
     c = sub.add_parser("simcheck", help="sim-vs-live divergence gate (CI)")
     c.add_argument("--schedule", default=STORM_SCHEDULE)
