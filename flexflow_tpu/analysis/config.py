@@ -42,6 +42,11 @@ CLOCK_WHITELIST: Dict[str, Union[str, FrozenSet[str]]] = {
     # engine/scheduler perf_counter span stamps and must never mix in
     # the scheduler's injectable (possibly virtual) clock.
     "flexflow_tpu/obs/steptrace.py": frozenset({"perf_counter"}),
+    # Durable WAL (ISSUE 19): fsync DURATION is physical profiling data
+    # (perf_counter only). Journal-record wall stamps ride the
+    # injectable wall_clock passed to WriteAheadLog — time.time /
+    # monotonic calls in this file are still violations.
+    "flexflow_tpu/runtime/wal.py": frozenset({"perf_counter"}),
 }
 
 # Paths where clock-discipline runs in STRICT virtual-time mode: ANY

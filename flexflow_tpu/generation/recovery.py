@@ -185,6 +185,18 @@ class GenerationJournal:
             self._entries.clear()
             return out
 
+    # Durable-serving hooks (ISSUE 19): no-ops here, overridden by
+    # serving.durable.DurableJournal to mirror the journal into a
+    # crash-safe WAL. The scheduler calls note_token from every
+    # emitted-token bookkeeping path and flush_step once per scheduling
+    # iteration (the group-commit boundary) — keeping both on the base
+    # class means the scheduler never imports the serving tier.
+    def note_token(self, req: "Request", token: int) -> None:
+        pass
+
+    def flush_step(self) -> None:
+        pass
+
 
 class EngineSupervisor:
     """Catches engine-loop step failures and turns them into the

@@ -319,6 +319,13 @@ class Request:
         self.response_format = response_format
         self.mask_state = None
         self.mask_error: Optional[PoisonedRequestError] = None
+        # durable serving (ISSUE 19): the stream's identity in the WAL
+        # and on GET /v2/generate/resume/{id} — stable across process
+        # restarts (a warm restart pins the journaled id onto the
+        # re-admitted request, while self.id is process-local). Set by
+        # the DurableJournal at first admission; None when the stream
+        # is not durably journaled.
+        self.durable_id: Optional[str] = None
 
     @property
     def n_generated(self) -> int:
@@ -1885,6 +1892,12 @@ class ContinuousBatchingScheduler:
     def _emit_token(self, state: _Running, token: int) -> None:
         state.req.generated.append(int(token))
         state.req.handle._emit(int(token))
+        # durable serving: the journal mirrors the token delta into its
+        # WAL buffer (a no-op on the base journal) — host bookkeeping
+        # that the overlap pipeline hides under device execution, like
+        # the mask advance below; the write+fsync happens once per step
+        # in journal.flush_step()
+        self.journal.note_token(state.req, int(token))
         if state.req.mask_state is not None:
             self._advance_mask(state.req, int(token))
 
@@ -2770,6 +2783,10 @@ class ContinuousBatchingScheduler:
                         tokens=int(info.get("emitted", 0)),
                         hot=not info.get("handled_failure", False),
                     )
+                # durable group commit rides the pipeline's execute
+                # window like the other host bookkeeping (no-op on the
+                # base journal)
+                self.journal.flush_step()
                 self.capacity.tick()
                 self._overload_tick()
                 return r
@@ -2808,6 +2825,10 @@ class ContinuousBatchingScheduler:
                 tokens=int(info.get("emitted", 0)) + admitted,
                 hot=not info.get("handled_failure", False),
             )
+        # durable group commit: one write+fsync for every journal
+        # record this iteration buffered (admits, token deltas, ends) —
+        # off the device dispatch path, a no-op on the base journal
+        self.journal.flush_step()
         # integrate time-at-pressure AFTER the step's allocations, so
         # the pressure flag reflects the state the next interval runs in
         # (injectable clock: virtual-clock tests integrate exactly);

@@ -132,6 +132,15 @@ _HELP = {
     "constrained_grammar_compile_seconds_total": "Wall seconds spent compiling response_format grammars (cumulative).",
     "constrained_masked_steps_total": "Prefill/decode/verify rows stepped under a grammar mask (cumulative).",
     "constrained_dead_end_failures_total": "Constrained streams failed by a grammar dead-end or refused advance (cumulative).",
+    "durable_wal_appends_total": "Journal records framed into the durable-serving write-ahead log (cumulative).",
+    "durable_wal_bytes_total": "Bytes appended to the durable-serving write-ahead log, framing included (cumulative).",
+    "durable_fsyncs_total": "WAL group commits that reached fsync (cumulative).",
+    "durable_replayed_streams_total": "Unfinished streams re-admitted byte-exactly by a warm restart (cumulative).",
+    "durable_replayed_tokens_total": "Journaled tokens carried back by warm-restarted streams (cumulative).",
+    "durable_torn_records_total": "Torn WAL tails truncated on scan — expected crash-mid-append damage (cumulative).",
+    "durable_rolling_restarts_total": "Completed rolling-restart cycles this replica came up through (cumulative).",
+    "durable_wal_append_failures_total": "Streams degraded to non-durable by a failed journal append (cumulative).",
+    "durable_wal_segments": "WAL segment files currently on disk.",
     "kv_imports": "KV handoff payloads imported into decode slots (disaggregated serving).",
     "kv_imports_rejected": "KV handoff imports rejected at unpack (stream fell back to recompute-prefill).",
     "fleet_replicas": "Current fleet replicas per lifecycle state.",

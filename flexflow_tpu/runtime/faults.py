@@ -82,6 +82,9 @@ FLEET_KV_HANDOFF = "fleet.kv_handoff"
 GENERATION_KV_IMPORT = "generation.kv_import"
 GENERATION_MASK_BUILD = "generation.mask_build"
 GENERATION_MASK_ADVANCE = "generation.mask_advance"
+SERVING_WAL_APPEND = "serving.wal_append"
+SERVING_WAL_FSYNC = "serving.wal_fsync"
+SERVING_WAL_REPLAY = "serving.wal_replay"
 
 # site -> "where it fires" (read-only: registering a site means adding a
 # constant + an entry here + the inject() call, in one reviewed place)
@@ -165,6 +168,23 @@ SITES = MappingProxyType({
         "token — including journal-replay re-advances (value: (grammar "
         "state, token)); an error quarantines the one constrained request "
         "while the rest of the batch keeps streaming"
+    ),
+    SERVING_WAL_APPEND: (
+        "before a durable-journal record is framed into the WAL buffer "
+        "(value: the record type); an error degrades the ONE appending "
+        "stream to non-durable with a counted warning — the decode hot "
+        "path never blocks on the log"
+    ),
+    SERVING_WAL_FSYNC: (
+        "around every WAL fsync — a paced background commit cycle or a "
+        "blocking sync() (value: the commit frontier); an error is "
+        "absorbed and counted — the next commit cycle retries and no "
+        "caller ever sees it"
+    ),
+    SERVING_WAL_REPLAY: (
+        "top of a warm restart's WAL replay, after the fingerprint check "
+        "(value: unfinished streams found); an error fails the restart "
+        "typed before any stream is re-admitted"
     ),
 })
 

@@ -35,7 +35,10 @@ __all__ = [
     "CircuitOpenError",
     "DeadlineExceededError",
     "DegradeLadder",
+    "Durability",
+    "DurabilityConfig",
     "DynamicBatcher",
+    "FingerprintMismatchError",
     "Fleet",
     "FleetRouter",
     "FleetStats",
@@ -58,6 +61,7 @@ __all__ = [
     "ShuttingDownError",
     "TensorMeta",
     "TokenRate",
+    "WarmRestart",
     "load_model",
     "save_model",
 ]
@@ -78,4 +82,10 @@ def __getattr__(name):
         from . import fleet
 
         return getattr(fleet, name)
+    if name in ("Durability", "DurabilityConfig", "FingerprintMismatchError",
+                "WarmRestart"):
+        # durable serving rides on the generation package too
+        from . import durable
+
+        return getattr(durable, name)
     raise AttributeError(name)

@@ -65,6 +65,7 @@ deterministically), driven by tests/test_fleet.py on virtual clocks and
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -101,9 +102,17 @@ class ReplicaState:
 class Replica:
     """One fleet member: id + GenerationModel + lifecycle state."""
 
-    def __init__(self, rid: str, model: GenerationModel):
+    def __init__(self, rid: str, model: GenerationModel, slot: int = -1):
         self.id = rid
         self.model = model
+        # durable serving (ISSUE 19): the stable WAL-directory slot
+        # this replica occupies (its replacement inherits it, so the
+        # journal survives the swap); -1 when the fleet has no
+        # durability_root
+        self.slot = slot
+        # a rolling restart owns this replica's drain->replace cycle;
+        # the supervisor's DRAINING auto-replace must keep its hands off
+        self.restarting = False
         self.state = ReplicaState.ACTIVE
         self.since = 0.0  # last state-transition time (fleet clock)
         # health-signal edge detection for the fleet supervisor
@@ -428,6 +437,9 @@ class Fleet:
         scheduler_kwargs: Optional[dict] = None,
         rid_prefix: str = "r",
         handoff_sink: Optional[Callable] = None,
+        durability_root: Optional[str] = None,
+        durability_fsync: bool = True,
+        durability_wall_clock: Callable[[], float] = time.time,
     ):
         if n < 1:
             raise ValueError("a fleet needs at least one replica")
@@ -439,6 +451,13 @@ class Fleet:
         # replica keeps handing off
         self.rid_prefix = rid_prefix
         self.handoff_sink = handoff_sink
+        # durable serving (ISSUE 19): one WAL directory per replica
+        # SLOT under this root; a replacement replica inherits its
+        # predecessor's slot directory, so a fleet restarted after
+        # process death warm-restarts every slot's journal
+        self.durability_root = durability_root
+        self.durability_fsync = durability_fsync
+        self.durability_wall_clock = durability_wall_clock
         self.name = name
         self.clock = clock
         self.warmup = warmup
@@ -489,20 +508,33 @@ class Fleet:
         # stepping until their residents finish (or expire), then torn
         # down — a drain timeout must never abort live streams
         self._retiring: List[Replica] = []  # guarded-by: _lock
-        self.replicas: List[Replica] = [self._spawn() for _ in range(n)]  # guarded-by: _lock
+        # initial spawns warm-restart their slot journals: a fleet
+        # coming back after process death replays every unfinished
+        # stream the dead process journaled
+        self.replicas: List[Replica] = [  # guarded-by: _lock
+            self._spawn(slot=i, warm_restart=True) for i in range(n)
+        ]
 
     # ----------------------------------------------------------- replicas
     def _replicas_snapshot(self) -> List[Replica]:
         with self._lock:
             return list(self.replicas)
 
-    def _spawn(self) -> Replica:
+    def _spawn(self, slot: int = -1, warm_restart: bool = False) -> Replica:
         """Build + warm one replica. The ``fleet.replica_spawn`` fault
         site fires BEFORE the factory so chaos tests can fail a
         replacement; warmup compiles the steady-state programs (the
         fixed-shape decode jit, the warm prompt's prefill bucket, and —
         when the fleet speculates by default — the verify jit) so the
-        replica's first real request never pays a retrace."""
+        replica's first real request never pays a retrace.
+
+        With a ``durability_root``, the replica attaches a WAL under
+        its slot directory; ``warm_restart=True`` (initial fleet
+        bring-up, rolling restarts) additionally replays the slot's
+        journal. Auto-replacements skip the replay: their predecessor
+        is (or was) alive in-process — its streams failed over or are
+        still finishing on a retiring engine, and an END("migrated")
+        record retired each moved stream from the journal already."""
         rid = f"{self.rid_prefix}{next(self._rid)}"
         faults.inject(faults.FLEET_REPLICA_SPAWN, rid)
         engine = self.engine_factory()
@@ -522,7 +554,24 @@ class Fleet:
         model = GenerationModel(
             engine, name=self.name, fault_scope=rid, **kwargs
         )
-        rep = Replica(rid, model)
+        if self.durability_root is not None and slot >= 0:
+            from .durable import DurabilityConfig  # late: optional tier
+
+            model.enable_durability(DurabilityConfig(
+                wal_dir=os.path.join(self.durability_root, f"slot-{slot}"),
+                fsync=self.durability_fsync,
+                wall_clock=self.durability_wall_clock,
+            ))
+            if warm_restart:
+                restart = model.durable.warm_restart()
+                if restart["replayed_streams"] or restart["torn_records"]:
+                    self.fleet_flight.record_event(
+                        "warm_restart", replica=rid, slot=slot,
+                        replayed=restart["replayed_streams"],
+                        tokens=restart["replayed_tokens"],
+                        torn=restart["torn_records"],
+                    )
+        rep = Replica(rid, model, slot=slot)
         rep.since = self.clock()
         model.scheduler.failover_sink = (
             lambda reqs, cause, _rep=rep: self._on_replica_failed(_rep, reqs, cause)
@@ -606,7 +655,26 @@ class Fleet:
             "failover", replica=replica.id, streams=len(requests),
             error=repr(cause)[:200],
         )
+        # retire the moved streams from the dead replica's WAL first:
+        # their live state travels with the Request objects, and an
+        # END("migrated") keeps a later warm restart over this slot
+        # from replaying streams that finished elsewhere
+        self._durable_migrate(replica, requests)
         self._place(requests)
+
+    def _durable_migrate(self, replica: Replica, requests: List[Request]) -> None:
+        """Journal END("migrated") for streams leaving ``replica`` for
+        another owner, and commit. Best-effort: durability must never
+        make a failover worse."""
+        dur = getattr(replica.model, "durable", None)
+        if dur is None or not requests:
+            return
+        try:
+            for req in requests:
+                dur.journal.end_stream(req, "migrated")
+            dur.sync()
+        except Exception:
+            pass
 
     def _place(self, requests: List[Request]) -> None:
         """Admit journal-replayed requests onto eligible replicas.
@@ -664,6 +732,190 @@ class Fleet:
         self.fleet_stats.incr("drains")
         self.fleet_flight.record_event("drain", replica=replica.id, reason=reason)
 
+    def rolling_restart(
+        self,
+        *,
+        drain_wait_s: Optional[float] = None,
+        pump: Optional[Callable[[], None]] = None,
+    ) -> Dict:
+        """Zero-downtime rolling restart (durable serving, ISSUE 19):
+        one replica at a time, drain -> checkpoint the WAL watermark ->
+        respawn on the same slot -> warm-restart the slot journal ->
+        warm gate -> swap. The router never sees a gap: every other
+        replica stays ACTIVE throughout, the victim only leaves the
+        routing set after its successor passed the gate, and no stream
+        is ever aborted — drained streams finish in the wait window,
+        queued leftovers re-place onto peers (END("migrated")
+        journaled), and rare still-resident streams keep finishing on
+        the RETIRING old engine.
+
+        ``pump`` drives progress on virtual-clock fleets (called in
+        place of sleeping — typically ``fleet.step`` plus a clock
+        advance); live fleets poll at ``poll_s``. The warm gate
+        re-runs the warmup probe on the successor and requires ZERO new
+        jit traces (skipped when the fleet itself runs ``warmup=False``);
+        a gate or spawn failure restores the old replica to ACTIVE and
+        aborts the remaining rotation — never a capacity dip."""
+        budget = self.drain_timeout_s if drain_wait_s is None else drain_wait_s
+        report: Dict = {"ok": True, "replicas": []}
+        for rep in self._replicas_snapshot():
+            if rep.state == ReplicaState.DEAD:
+                continue  # the auto-replace path owns dead replicas
+            entry: Dict = {"replica": rep.id, "slot": rep.slot}
+            rep.restarting = True
+            try:
+                self.drain(rep, reason="rolling_restart")
+                waited = 0.0
+                while rep.scheduler.has_work() and waited < budget:
+                    if pump is not None:
+                        pump()
+                    else:
+                        time.sleep(self.poll_s)
+                    waited += self.poll_s
+                stolen = rep.scheduler.steal_queue()
+                if stolen:
+                    self._durable_migrate(rep, stolen)
+                    self._place(stolen)
+                residents = rep.scheduler.has_work()
+                dur = getattr(rep.model, "durable", None)
+                if dur is not None:
+                    # commit every END before the successor scans the
+                    # slot journal, and checkpoint the commit frontier
+                    dur.sync()
+                    self.fleet_flight.record_event(
+                        "wal_watermark", replica=rep.id,
+                        **dur.wal.watermark(),
+                    )
+                entry["drained"] = not residents
+                entry["migrated"] = len(stolen)
+                try:
+                    # replay the slot journal only when the old replica
+                    # is fully idle — a retiring replica still OWNS its
+                    # residents, and two schedulers must never emit
+                    # into one stream
+                    new = self._spawn(slot=rep.slot,
+                                      warm_restart=not residents)
+                except Exception as e:
+                    self.fleet_stats.incr("spawn_failures")
+                    self.fleet_flight.record_event(
+                        "rolling_restart_abort", replica=rep.id,
+                        error=repr(e)[:200],
+                    )
+                    entry["error"] = f"spawn failed: {e!r}"[:200]
+                    self._restore_active(rep)
+                    report["ok"] = False
+                    report["replicas"].append(entry)
+                    break
+                if self.warmup and not self._warm_gate(new, entry):
+                    self._teardown(new)
+                    self._restore_active(rep)
+                    self.fleet_flight.record_event(
+                        "rolling_restart_abort", replica=rep.id,
+                        new=new.id, reason="warm_gate",
+                    )
+                    report["ok"] = False
+                    report["replicas"].append(entry)
+                    break
+                ndur = getattr(new.model, "durable", None)
+                if ndur is not None:
+                    ndur.stats.incr("rolling_restarts")
+                    entry["replayed_streams"] = (
+                        ndur.stats.counts()["replayed_streams"]
+                    )
+                with self._lock:
+                    try:
+                        idx = self.replicas.index(rep)
+                    except ValueError:
+                        idx = None
+                    if idx is None:
+                        self.replicas.append(new)
+                    else:
+                        self.replicas[idx] = new
+                self.fleet_stats.incr("replaced")
+                self.fleet_flight.record_event(
+                    "rolling_restart", old=rep.id, new=new.id,
+                    slot=rep.slot, drained=not residents,
+                )
+                if residents:
+                    rep.state = ReplicaState.RETIRING
+                    rep.since = self.clock()
+                    with self._lock:
+                        self._retiring.append(rep)
+                else:
+                    self._teardown(rep)
+                self._drain_pending()
+            finally:
+                rep.restarting = False
+            report["replicas"].append(entry)
+        return report
+
+    def _restore_active(self, rep: Replica) -> None:
+        """Rolling-restart abort: put the drained victim back into the
+        routing set — a failed rotation must degrade to the status quo,
+        never to lost capacity."""
+        with self._lock:
+            if rep.state == ReplicaState.DRAINING:
+                rep.state = ReplicaState.ACTIVE
+                rep.since = self.clock()
+                rep.drain_started = None
+
+    def _warm_gate(self, rep: Replica, entry: Dict) -> bool:
+        """The respawned replica must hold the zero-steady-state-
+        retrace invariant: re-run the warmup probe and require zero new
+        jit traces before the router may see it."""
+        base = sum(rep.engine.trace_counts.values())
+        try:
+            rep.engine.generate(
+                [list(self.warm_prompt)],
+                SamplingParams(max_new_tokens=self.warm_tokens),
+                speculation=self._scheduler_kwargs.get("speculation"),
+                draft_params=self._scheduler_kwargs.get("draft_params"),
+            )
+        except Exception as e:
+            entry["gate"] = f"probe failed: {e!r}"[:200]
+            return False
+        retraces = sum(rep.engine.trace_counts.values()) - base
+        entry["gate"] = "passed" if retraces == 0 else f"{retraces} retraces"
+        return retraces == 0
+
+    # ----------------------------------------------------------- durable
+    def durable_report(self) -> Optional[Dict]:
+        """Per-replica durable state for GET /v2/durable (None when the
+        fleet has no durability_root)."""
+        if self.durability_root is None:
+            return None
+        with self._lock:
+            members = list(self.replicas) + list(self._retiring)
+        out: Dict = {"root": self.durability_root, "replicas": {}}
+        for rep in members:
+            dur = getattr(rep.model, "durable", None)
+            if dur is not None:
+                out["replicas"][rep.id] = dict(
+                    dur.report(), slot=rep.slot, state=rep.state
+                )
+        return out
+
+    def durable_lookup(self, durable_id: str):
+        """Resume-endpoint lookup across every replica (retiring ones
+        included). A live hit wins over any terminal record, and a real
+        terminal outcome wins over "migrated" — the stream's truth
+        lives wherever it actually ran last."""
+        best = None
+        with self._lock:
+            members = list(self.replicas) + list(self._retiring)
+        for rep in members:
+            dur = getattr(rep.model, "durable", None)
+            if dur is None:
+                continue
+            hit = dur.lookup(durable_id)
+            if hit is None:
+                continue
+            if hit[0] == "live":
+                return hit
+            if best is None or best[1].get("outcome") == "migrated":
+                best = hit
+        return best
+
     def check(self) -> None:
         """One fleet-supervisor inspection (manual on virtual clocks in
         tests; polled by the monitor thread under start()): edge-detect
@@ -707,7 +959,7 @@ class Fleet:
                     rep.seen_quarantined = quarantined
                     if rep.quarantine_streak >= self.quarantine_streak_limit:
                         self.drain(rep, reason="quarantine_storm")
-            if rep.state == ReplicaState.DRAINING:
+            if rep.state == ReplicaState.DRAINING and not rep.restarting:
                 if not sched.has_work():
                     self._replace(rep, reason="drained")
                 elif (
@@ -722,6 +974,7 @@ class Fleet:
                     # watchdog, never aborted by the replacement
                     stolen = sched.steal_queue()
                     if stolen:
+                        self._durable_migrate(rep, stolen)
                         self._place(stolen)
                     self._replace(rep, reason="drain_timeout", retire=True)
             elif rep.state == ReplicaState.DEAD and self.auto_replace:
@@ -778,7 +1031,7 @@ class Fleet:
         finish — used by the drain timeout, where teardown would abort
         live streams."""
         try:
-            new = self._spawn()
+            new = self._spawn(slot=old.slot)
         except Exception as e:
             self.fleet_stats.incr("spawn_failures")
             self._spawn_fail_streak += 1
@@ -838,6 +1091,12 @@ class Fleet:
             replica.model.scheduler.stop(drain=False, timeout=5.0)
         except Exception:
             pass  # a wedged replica's teardown must not take the fleet down
+        dur = getattr(replica.model, "durable", None)
+        if dur is not None:
+            try:
+                dur.close()  # final flush; successor segments unaffected
+            except Exception:
+                pass
 
     def _drain_pending(self) -> None:
         with self._lock:

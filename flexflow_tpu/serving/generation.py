@@ -61,6 +61,10 @@ class GenerationModel:
         self.grammar_cache = GrammarCache(
             self.vocabulary, stats=self.scheduler.constrained_stats
         )
+        # durable serving (ISSUE 19): set by enable_durability(); when
+        # attached, every admission journals into the WAL and
+        # GET /v2/generate/resume/{id} can re-attach clients
+        self.durable = None
 
     # --------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -68,6 +72,20 @@ class GenerationModel:
 
     def stop(self, drain: bool = True) -> None:
         self.scheduler.stop(drain=drain)
+        if self.durable is not None:
+            self.durable.close()
+
+    def enable_durability(self, config) -> "Durability":
+        """Attach a crash-safe WAL journal to this model's scheduler
+        (serving/durable.py). Call before traffic; follow with
+        ``self.durable.warm_restart()`` to replay a predecessor's
+        journal from the same directory."""
+        from .durable import Durability  # late: keeps the tier optional
+
+        self.durable = Durability(
+            self.scheduler, config, grammar_cache=self.grammar_cache
+        )
+        return self.durable
 
     def ready(self) -> bool:
         return self.scheduler.ready()
@@ -174,11 +192,17 @@ class GenerationModel:
             # queue: a malformed grammar is the submitter's 400, it
             # never reaches the batch
             grammar = self.grammar_cache.get(response_format)
-        return self.scheduler.submit(
+        handle = self.scheduler.submit(
             prompt, sampling, deadline_s=deadline_s, speculation=speculation,
             transport=transport, priority=priority,
             grammar=grammar, response_format=response_format,
         )
+        if self.durable is not None:
+            # pre-assign the durable id at submit (admission journals
+            # later) so the HTTP response can carry the resume handle
+            # from its very first byte
+            self.durable.track(handle._request)
+        return handle
 
     def generate(
         self,
@@ -299,6 +323,17 @@ class GenerationModel:
                 "formats": ["json_schema", "regex"],
                 "grammar_cache_entries": len(self.grammar_cache),
                 "vocabulary_tokens": len(self.vocabulary),
+            },
+            "durable": {
+                "enabled": self.durable is not None,
+                "fingerprint": (
+                    self.durable.fingerprint if self.durable is not None else None
+                ),
+                "wal_segments": (
+                    self.durable.wal.segment_count()
+                    if self.durable is not None
+                    else 0
+                ),
             },
             "inputs": [{"name": "tokens", "shape": (-1,), "datatype": "INT32"}],
             "outputs": [{"name": "tokens", "shape": (-1,), "datatype": "INT32"}],

@@ -469,6 +469,12 @@ class GrpcInferenceServer:
             toks = handle.result(timeout=wait)
             t.shape.extend([len(toks)])
             t.contents.int_contents.extend(int(x) for x in toks)
+            # durable serving (ISSUE 19): the stream's WAL identity —
+            # a disconnected client resumes byte-exactly via
+            # GET /v2/generate/resume/{durable_id}
+            durable_id = handle._request.durable_id
+            if durable_id is not None:
+                final.parameters["durable_id"].string_param = durable_id
             yield final
         except ResilienceError as e:
             handle.cancel()

@@ -517,6 +517,37 @@ class InferenceServer:
             }
         }
 
+    def durable_report(self) -> Dict:
+        """GET /v2/durable: per-model WAL/journal/warm-restart state —
+        commit watermark, counters, degraded streams, resume-index
+        sizes (durable serving, ISSUE 19)."""
+        out: Dict = {"models": {}}
+        for name, g in sorted(self.generators.items()):
+            dur = getattr(g, "durable", None)
+            if dur is not None:
+                out["models"][name] = dur.report()
+            elif hasattr(g, "durable_report"):  # fleet: per-replica view
+                rep = g.durable_report()
+                if rep is not None:
+                    out["models"][name] = rep
+        return out
+
+    def durable_lookup(self, durable_id: str):
+        """Find the generator + resume state owning a durable stream
+        id, across plain models and fleets. Returns ``(model_name,
+        ("live", Request) | ("done", dict))`` or None."""
+        for name, g in sorted(self.generators.items()):
+            dur = getattr(g, "durable", None)
+            if dur is not None:
+                hit = dur.lookup(durable_id)
+                if hit is not None:
+                    return name, hit
+            elif hasattr(g, "durable_lookup"):
+                hit = g.durable_lookup(durable_id)
+                if hit is not None:
+                    return name, hit
+        return None
+
     # ------------------------------------------------------------ control
     def start(self):
         server = self
@@ -626,6 +657,12 @@ class InferenceServer:
                     return self._json(200, server.overload_report(
                         model=(query.get("model") or [None])[0]
                     ))
+                if path == "/v2/durable":
+                    return self._json(200, server.durable_report())
+                if path.startswith("/v2/generate/resume/"):
+                    return self._resume(
+                        path[len("/v2/generate/resume/"):], query
+                    )
                 if path == "/v2/fleet":
                     return self._json(200, server.fleet_report())
                 if path == "/v2/fleet/autoscale":
@@ -729,28 +766,129 @@ class InferenceServer:
                     )
                 # SSE stream: status/headers are already committed once the
                 # first token flushes, so mid-stream failures surface as an
-                # error event, not a status code
+                # error event, not a status code. With durability
+                # attached, X-Durable-Id names the stream for
+                # GET /v2/generate/resume/{id}, and each token event
+                # carries a monotonic SSE id (= token index) so a
+                # reconnecting client's Last-Event-ID pins exactly
+                # where replay resumes.
+                durable_id = handle._request.durable_id
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
+                if durable_id is not None:
+                    self.send_header("X-Durable-Id", durable_id)
                 self.end_headers()
 
-                def event(payload: dict):
+                def event(payload: dict, eid=None):
+                    if eid is not None:
+                        self.wfile.write(f"id: {eid}\n".encode())
                     self.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
                     self.wfile.flush()
 
                 count = 0
                 try:
                     for tok in handle.tokens(timeout=wait):
-                        event({"token": int(tok), "index": count})
+                        event({"token": int(tok), "index": count}, eid=count)
                         count += 1
-                    event({"done": True, "tokens": handle.result(timeout=wait)})
+                    done = {"done": True, "tokens": handle.result(timeout=wait)}
+                    if durable_id is not None:
+                        done["durable_id"] = durable_id
+                    event(done)
                 except Exception as e:
                     handle.cancel()
                     try:
                         event({**error_payload(e), "done": True})
                     except OSError:
                         pass  # client went away mid-stream
+
+            def _resume(self, durable_id: str, query):
+                """GET /v2/generate/resume/{durable_id} — SSE replay +
+                re-attach (durable serving, ISSUE 19). Journaled tokens
+                replay from the resume index (event ids pick up the
+                original stream's numbering); if the stream is still
+                live the response then follows it to completion
+                byte-identically. ``Last-Event-ID`` (header, SSE
+                reconnect convention) or ``?last_event_id=`` skips
+                events the client already holds."""
+                last = self.headers.get("Last-Event-ID")
+                if last is None:
+                    last = (query.get("last_event_id") or [None])[0]
+                try:
+                    sent = int(last) + 1 if last is not None else 0
+                except ValueError:
+                    return self._json(400, {"error": f"bad Last-Event-ID {last!r}"})
+                found = server.durable_lookup(durable_id)
+                if found is None:
+                    return self._json(
+                        404, {"error": f"unknown durable stream {durable_id}"}
+                    )
+                name, (state, obj) = found
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("X-Durable-Id", durable_id)
+                self.end_headers()
+
+                def event(payload: dict, eid=None):
+                    if eid is not None:
+                        self.wfile.write(f"id: {eid}\n".encode())
+                    self.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
+                    self.wfile.flush()
+
+                def drain(tokens):
+                    nonlocal sent
+                    while sent < len(tokens):
+                        event(
+                            {"token": int(tokens[sent]), "index": sent,
+                             "model_name": name},
+                            eid=sent,
+                        )
+                        sent += 1
+
+                try:
+                    if state == "done":
+                        tokens = obj["tokens"]
+                        drain(tokens)
+                        event({"done": True, "tokens": list(tokens),
+                               "outcome": obj["outcome"],
+                               "durable_id": durable_id})
+                        return
+                    # live stream: poll the request's generated list —
+                    # the handle's token queue belongs to (and was
+                    # consumed by) the original connection. List
+                    # appends are atomic under the GIL; we only ever
+                    # read a prefix the scheduler already extended.
+                    req = obj
+                    handle = req.handle
+                    # ~300 s ceiling without a wall-clock read: each
+                    # poll blocks up to 50 ms on the settle future
+                    for _ in range(6000):
+                        drain(req.generated)
+                        if handle.done():
+                            break
+                        try:
+                            handle.future.exception(timeout=0.05)
+                        except _FuturesTimeout:
+                            pass
+                        except Exception:
+                            break  # settled (cancelled counts); drain below
+                    drain(req.generated)
+                    if not handle.done():
+                        event({"done": True, "error": "resume timed out",
+                               "durable_id": durable_id})
+                        return
+                    try:
+                        tokens = handle.result(timeout=0)
+                        event({"done": True, "tokens": tokens,
+                               "outcome": "completed",
+                               "durable_id": durable_id})
+                    except Exception as e:
+                        event({**_reject_payload(e), "done": True,
+                               "outcome": type(e).__name__,
+                               "durable_id": durable_id})
+                except OSError:
+                    pass  # client went away mid-replay
 
             def do_POST(self):
                 parts = self.path.split("/")

@@ -348,6 +348,77 @@ class ConstrainedStats:
             )
 
 
+class DurableStats:
+    """Durable-serving counters for one generation engine
+    (serving/durable.py + runtime/wal.py), surfaced as /v2/stats gauges
+    and the ``flexflow_serving_durable_*`` Prometheus families:
+
+      wal_appends          journal records framed into the WAL buffer
+      wal_bytes            framed bytes appended (headers included)
+      fsyncs               group commits that reached fsync
+      replayed_streams     unfinished streams a warm restart re-admitted
+      replayed_tokens      journaled tokens those streams carried back
+      torn_records         torn tails truncated off the newest segment
+                           on open (crash mid-append — expected)
+      rolling_restarts     completed rolling-restart cycles this replica
+                           came up through
+      wal_append_failures  streams degraded to non-durable by a failed
+                           journal append (the counted warning — the
+                           decode hot path never blocks on the log)
+
+    The wal_* write/commit counters live inside the WriteAheadLog (its
+    appends are lock-protected already); set :attr:`wal` and the gauge
+    read path merges them live. ``wal_segments`` is a level gauge over
+    the segment directory. Writers: the scheduler loop thread (via the
+    DurableJournal) and warm-restart/rolling-restart callers; the lock
+    keeps replay counters exact so chaoscheck can assert them.
+    """
+
+    FIELDS = (
+        "replayed_streams", "replayed_tokens", "torn_records",
+        "rolling_restarts", "wal_append_failures",
+    )
+    WAL_FIELDS = ("wal_appends", "wal_bytes", "fsyncs")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+        # the attached WriteAheadLog (duck-typed: counters() +
+        # segment_count()); None until a Durability wires one in
+        self.wal = None
+
+    def incr(self, field: str, n: int = 1) -> None:
+        if field not in self.FIELDS:
+            raise ValueError(f"unknown durable counter {field!r}")
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def counts(self) -> Dict[str, int]:
+        """Locked snapshot merged with the live WAL write counters —
+        the gauge read path (scrape threads race the loop thread)."""
+        with self._lock:
+            out = {f: getattr(self, f) for f in self.FIELDS}
+        wal = self.wal
+        wc = wal.counters() if wal is not None else {}
+        out["wal_appends"] = wc.get("appends", 0)
+        out["wal_bytes"] = wc.get("bytes", 0)
+        out["fsyncs"] = wc.get("fsyncs", 0)
+        return out
+
+    def segments(self) -> int:
+        wal = self.wal
+        return wal.segment_count() if wal is not None else 0
+
+    def register_gauges(self, stats: "ServingStats") -> None:
+        # cumulative counters -> prometheus-conventional _total names
+        # (flexflow_serving_durable_* once prom.py prefixes them), plus
+        # the one level gauge (segments on disk right now)
+        for f in self.WAL_FIELDS + self.FIELDS:
+            stats.add_gauge(f"durable_{f}_total", lambda f=f: self.counts()[f])
+        stats.add_gauge("durable_wal_segments", self.segments)
+
+
 class FleetStats:
     """Fleet-lifecycle counters for one replicated generation service
     (serving/fleet.py), surfaced on ``GET /v2/fleet`` and as the

@@ -469,7 +469,8 @@ def test_http_response_format_sse(server):
          "response_format": SPEC},
     )
     assert r.headers["Content-Type"] == "text/event-stream"
-    events = [json.loads(ln[6:])
+    # each SSE chunk is an `id: N` line (durable resume cursor) + a data line
+    events = [json.loads(ln.split("data: ", 1)[1])
               for ln in r.read().decode().strip().split("\n\n")]
     assert events[-1]["done"] is True
     toks = events[-1]["tokens"]

@@ -426,7 +426,9 @@ def test_http_generate_sse_stream(gen_server, decoder_params):
     base = f"http://127.0.0.1:{gen_server.port}"
     r = _post(f"{base}/v2/models/lm/generate", {"prompt": [4, 5], "max_new_tokens": 4, "stream": True})
     assert r.headers["Content-Type"] == "text/event-stream"
-    events = [json.loads(l[6:]) for l in r.read().decode().strip().split("\n\n")]
+    # each SSE chunk is an `id: N` line (durable resume cursor) + a data line
+    events = [json.loads(l.split("data: ", 1)[1])
+              for l in r.read().decode().strip().split("\n\n")]
     ref = naive_greedy(decoder_params, [4, 5], 4)
     assert [e["token"] for e in events[:-1]] == ref
     assert events[-1] == {"done": True, "tokens": ref}

@@ -280,6 +280,16 @@ def _golden_stats():
     s.add_gauge("constrained_grammar_compile_seconds_total", lambda: 0.25)
     s.add_gauge("constrained_masked_steps_total", lambda: 12)
     s.add_gauge("constrained_dead_end_failures_total", lambda: 1)
+    # ISSUE 19 durable-serving families (binary-exact values)
+    s.add_gauge("durable_wal_appends_total", lambda: 9)
+    s.add_gauge("durable_wal_bytes_total", lambda: 2048)
+    s.add_gauge("durable_fsyncs_total", lambda: 4)
+    s.add_gauge("durable_wal_append_failures_total", lambda: 1)
+    s.add_gauge("durable_replayed_streams_total", lambda: 2)
+    s.add_gauge("durable_replayed_tokens_total", lambda: 6)
+    s.add_gauge("durable_torn_records_total", lambda: 1)
+    s.add_gauge("durable_rolling_restarts_total", lambda: 1)
+    s.add_gauge("durable_wal_segments", lambda: 2)
     return s
 
 

@@ -724,6 +724,355 @@ def run_fleet_sweep() -> bool:
     return not failures
 
 
+def run_durable_sweep() -> bool:
+    """Durable-serving sweep (ISSUE 19): process death is the fault —
+
+      sigkill      a REAL child process (tools/_durable_child.py) decodes
+                   the four-way mix (greedy, seeded-temp, speculative,
+                   constrained) with a fsync'ing WAL and is SIGKILLed
+                   mid-decode -> a fresh in-process attach warm-restarts
+                   the journal and every stream completes byte-identical
+                   to an uninterrupted reference
+      torn tail    the dead writer's active segment ends mid-record ->
+                   the warm-restart scan truncates the tear (counted),
+                   and the stream still replays byte-exactly from the
+                   shorter journaled prefix
+      fsync fault  serving.wal_fsync fails -> absorbed + counted; the
+                   scheduler loop never sees it, streams byte-exact
+      append fault serving.wal_append fails -> exactly ONE stream
+                   degrades to non-durable (counted warning); decode
+                   never blocks, every stream byte-exact
+      fingerprint  a journal written under a DIFFERENT engine config ->
+                   warm restart refuses with the typed
+                   FingerprintMismatchError before adopting anything
+      rolling      a 3-replica fleet under live traffic rolls one
+                   replica at a time -> zero stream loss, every stream
+                   byte-exact, 3 rotations recorded, fleet whole
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO)
+
+    import glob
+    import shutil
+    import tempfile
+
+    import _durable_child as mix
+
+    from flexflow_tpu.generation import (
+        ContinuousBatchingScheduler,
+        GenerationEngine,
+        RecoveryPolicy,
+        SamplingParams,
+        init_decoder_params,
+    )
+    from flexflow_tpu.generation.constrained import (
+        GrammarCache,
+        default_vocabulary,
+    )
+    from flexflow_tpu.models.transformer import TransformerConfig
+    from flexflow_tpu.runtime.faults import FaultPlan
+    from flexflow_tpu.serving.durable import (
+        Durability,
+        DurabilityConfig,
+        FingerprintMismatchError,
+    )
+
+    import jax
+
+    cfg = mix.build_cfg()
+    eng = mix.build_engine(cfg)
+    eng.generate([[1] * 12], SamplingParams(max_new_tokens=2))  # warm
+    vocab = default_vocabulary(cfg.vocab_size)
+    policy = RecoveryPolicy(sleep=lambda _s: None)
+    tmp = tempfile.mkdtemp(prefix="chaoscheck-durable-")
+
+    def drive(sched, done, steps=800):
+        for _ in range(steps):
+            if done():
+                return
+            if not sched.step():
+                return
+
+    report, failures = {}, []
+
+    def check(scenario, cond, msg):
+        if not cond:
+            failures.append(f"{scenario}: {msg}")
+
+    # --------------------------------------------------- reference run
+    # the same four-way mix, uninterrupted, on a plain (non-durable)
+    # scheduler: per-request tokens are batch-composition independent,
+    # so this is THE byte-exactness target for every scenario below
+    sched = ContinuousBatchingScheduler(eng, recovery=policy)
+    handles = mix.submit_mix(sched, GrammarCache(vocab))
+    drive(sched, lambda: all(h.done() for h in handles))
+    ref = {
+        tuple(mix.PROMPTS[kind]): handles[i].result(timeout=0)
+        for i, kind in enumerate(("greedy", "seeded", "speculative", "constrained"))
+    }
+    report["reference"] = {"tokens": sum(len(r) for r in ref.values())}
+
+    # --------------------------------------- SIGKILL -> warm restart
+    # the victim is a REAL process: only what its group commits made
+    # durable survives; the parent re-attaches over the orphaned WAL
+    sigkill_dir = os.path.join(tmp, "sigkill")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "_durable_child.py"),
+         sigkill_dir],
+        stdout=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    killed, child_done, deadline = False, False, time.monotonic() + 300
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("DONE"):
+            child_done = True
+            break
+        if line.startswith("TOK") and int(line.split()[1]) >= 6:
+            proc.kill()  # SIGKILL: no atexit, no flush, no goodbye
+            killed = True
+            break
+    proc.wait(timeout=60)
+    proc.stdout.close()
+    check("sigkill", killed and not child_done,
+          "child finished (or died) before the kill landed mid-decode")
+    check("sigkill", proc.returncode == -9,
+          f"child exit {proc.returncode}, want -9 (SIGKILL)")
+
+    sched = ContinuousBatchingScheduler(eng, recovery=policy)
+    dur = Durability(
+        sched, DurabilityConfig(wal_dir=sigkill_dir),
+        grammar_cache=GrammarCache(vocab),
+    )
+    restart = dur.warm_restart()
+    adopted = [e.req for e in sched.journal.entries()]
+    drive(sched, lambda: all(r.handle.done() for r in adopted))
+    check("sigkill", restart["replayed_streams"] == 4,
+          f"replayed {restart['replayed_streams']} streams, want all 4")
+    check("sigkill", restart["replayed_tokens"] >= 1,
+          "no journaled progress survived the kill")
+    for req in adopted:
+        want = ref.get(tuple(req.original_prompt))
+        check("sigkill", want is not None and list(req.generated) == want,
+              f"stream {req.original_prompt} diverged after process death: "
+              f"{list(req.generated)} != {want}")
+    check("sigkill", no_leaked_blocks(eng), "leaked blocks")
+    report["sigkill"] = {
+        "replayed_streams": restart["replayed_streams"],
+        "replayed_tokens": restart["replayed_tokens"],
+        "torn_records": restart["torn_records"],
+        "exact": all(list(r.generated) == ref.get(tuple(r.original_prompt))
+                     for r in adopted),
+    }
+    dur.close()
+
+    # ------------------------------------------------------- torn tail
+    torn_dir = os.path.join(tmp, "torn")
+    prompt = [3, 1, 4, 1, 5]
+    sched = ContinuousBatchingScheduler(eng, recovery=policy)
+    Durability(sched, DurabilityConfig(wal_dir=torn_dir))
+    h = sched.submit(prompt, SamplingParams(max_new_tokens=10))
+    for _ in range(4):
+        sched.step()
+    # abandon the scheduler (simulated death) and tear the tail: a
+    # frame that claims 64 payload bytes but ends after 8 — exactly
+    # what a kill mid-write leaves
+    seg = sorted(glob.glob(os.path.join(torn_dir, "wal-*.seg")))[-1]
+    with open(seg, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\x00\x00\x00\x00" + b'{"t":"to')
+    sched = ContinuousBatchingScheduler(eng, recovery=policy)
+    dur = Durability(sched, DurabilityConfig(wal_dir=torn_dir))
+    restart = dur.warm_restart()
+    adopted = [e.req for e in sched.journal.entries()]
+    drive(sched, lambda: all(r.handle.done() for r in adopted))
+    ref_torn = eng.generate([prompt], SamplingParams(max_new_tokens=10))[0]
+    check("torn", restart["torn_records"] >= 1,
+          f"torn tail not detected: {restart['torn_records']}")
+    check("torn", len(adopted) == 1 and list(adopted[0].generated) == ref_torn,
+          "stream did not replay byte-exactly past the torn tail")
+    report["torn"] = {"torn_records": restart["torn_records"],
+                      "exact": [list(r.generated) for r in adopted] == [ref_torn]}
+
+    # ------------------------------------------------------ fsync fault
+    # let prior scenarios' paced committers drain first: an abandoned
+    # WAL's pending commit wakes up to one pacing interval later and
+    # would consume the nth call slots of the plan below (an idle
+    # committer never reaches the fsync site again)
+    time.sleep(0.12)
+    sched = ContinuousBatchingScheduler(eng, recovery=policy)
+    # commit_interval_s=0: unpaced per-request commit cycles, so the
+    # nth slots below land deterministically inside the short drive
+    # (the scenario tests fault absorption, not fsync pacing)
+    dur = Durability(
+        sched, DurabilityConfig(wal_dir=os.path.join(tmp, "fsync"),
+                                commit_interval_s=0.0),
+        grammar_cache=GrammarCache(vocab),
+    )
+    plan = FaultPlan(seed=0)
+    plan.on("serving.wal_fsync", mode="error",
+            error=OSError("injected fsync failure"), nth=(1, 2))
+    with plan.active():
+        handles = mix.submit_mix(sched, GrammarCache(vocab))
+        drive(sched, lambda: all(h.done() for h in handles))
+    got = [h.result(timeout=0) for h in handles]
+    counters = dur.wal.counters()
+    check("fsync", plan.fired("serving.wal_fsync") >= 2, "fsync fault never fired")
+    check("fsync", counters["fsync_failures"] >= 2,
+          f"fsync failures not counted: {counters['fsync_failures']}")
+    check("fsync", dur.journal.degraded_count() == 0,
+          "an absorbed fsync failure degraded a stream")
+    for i, kind in enumerate(("greedy", "seeded", "speculative", "constrained")):
+        check("fsync", got[i] == ref[tuple(mix.PROMPTS[kind])],
+              f"{kind} stream diverged under fsync faults")
+    report["fsync"] = {"fsync_failures": counters["fsync_failures"],
+                       "exact": all(
+                           got[i] == ref[tuple(mix.PROMPTS[k])]
+                           for i, k in enumerate(
+                               ("greedy", "seeded", "speculative", "constrained")))}
+    dur.close()
+
+    # ----------------------------------------------------- append fault
+    sched = ContinuousBatchingScheduler(eng, recovery=policy)
+    dur = Durability(
+        sched, DurabilityConfig(wal_dir=os.path.join(tmp, "append")),
+        grammar_cache=GrammarCache(vocab),
+    )
+    plan = FaultPlan(seed=0)
+    plan.on("serving.wal_append", mode="error",
+            error=OSError("injected append failure"), nth=(1,))
+    with plan.active():
+        handles = mix.submit_mix(sched, GrammarCache(vocab))
+        drive(sched, lambda: all(h.done() for h in handles))
+    got = [h.result(timeout=0) for h in handles]
+    check("append", dur.journal.degraded_count() == 1,
+          f"degraded {dur.journal.degraded_count()} streams, want exactly 1")
+    check("append", dur.stats.counts()["wal_append_failures"] == 1,
+          "append failure not counted")
+    for i, kind in enumerate(("greedy", "seeded", "speculative", "constrained")):
+        check("append", got[i] == ref[tuple(mix.PROMPTS[kind])],
+              f"{kind} stream diverged after the degraded append")
+    report["append"] = {"degraded": dur.journal.degraded_count(),
+                        "exact": all(
+                            got[i] == ref[tuple(mix.PROMPTS[k])]
+                            for i, k in enumerate(
+                                ("greedy", "seeded", "speculative", "constrained")))}
+    dur.close()
+
+    # ---------------------------------------------- fingerprint refusal
+    fp_dir = os.path.join(tmp, "fingerprint")
+    sched = ContinuousBatchingScheduler(eng, recovery=policy)
+    Durability(sched, DurabilityConfig(wal_dir=fp_dir))
+    sched.submit([7, 7, 7], SamplingParams(max_new_tokens=10))
+    for _ in range(3):
+        sched.step()
+    other_cfg = TransformerConfig(
+        num_layers=1, hidden_size=32, num_heads=4, ff_size=64,
+        seq_length=64, vocab_size=50, causal=True,
+    )
+    other = GenerationEngine(
+        init_decoder_params(jax.random.key(0), other_cfg), other_cfg,
+        max_batch_slots=4, block_size=8,
+    )
+    sched_b = ContinuousBatchingScheduler(other, recovery=policy)
+    dur_b = Durability(sched_b, DurabilityConfig(wal_dir=fp_dir))
+    typed = False
+    try:
+        dur_b.warm_restart()
+    except FingerprintMismatchError as e:
+        typed = e.expected != e.found
+    except Exception as e:
+        check("fingerprint", False, f"untyped refusal: {e!r}")
+    check("fingerprint", typed,
+          "config drift did not raise the typed FingerprintMismatchError")
+    check("fingerprint", not sched_b.journal.entries(),
+          "a refused restart still adopted streams")
+    report["fingerprint"] = {"typed": typed}
+
+    # ------------------------------- rolling restart under live traffic
+    def factory():
+        return mix.build_engine(cfg)
+
+    from flexflow_tpu.serving.fleet import Fleet, ReplicaState
+
+    roll_root = os.path.join(tmp, "rolling")
+    fleet = Fleet(
+        factory, 3, poll_s=0.05, durability_root=roll_root,
+        scheduler_kwargs=dict(recovery=policy),
+    )
+    fleet.start()
+    sampling = SamplingParams(max_new_tokens=10)
+    prompts = [[1, 2, 3], [4, 5, 6, 7], [9, 8, 7, 6, 5],
+               [2, 4, 6], [3, 1, 4, 1, 5], [8, 8, 8]]
+    ref_eng = factory()
+    roll_ref = {tuple(p): ref_eng.generate([p], sampling)[0] for p in prompts}
+    live, live_lock = [], threading.Lock()
+    stop_feed = threading.Event()
+
+    def feeder():
+        # live traffic THROUGH the rotation: keep submitting until the
+        # restart completes — the router must always find a home
+        i = 0
+        while not stop_feed.is_set():
+            h = fleet.submit(prompts[i % len(prompts)], sampling)
+            with live_lock:
+                live.append(h)
+            i += 1
+            time.sleep(0.05)
+
+    handles = [fleet.submit(p, sampling) for p in prompts]
+    feed = threading.Thread(target=feeder, daemon=True)
+    feed.start()
+    roll = fleet.rolling_restart(drain_wait_s=15)
+    stop_feed.set()
+    feed.join(timeout=10)
+    with live_lock:
+        everyone = handles + list(live)
+    results, lost = [], 0
+    for h in everyone:
+        try:
+            results.append((h, h.result(timeout=60)))
+        except Exception:
+            lost += 1
+    dr = fleet.durable_report()
+    rotations = sum(
+        rep["counters"].get("rolling_restarts", 0)
+        for rep in dr["replicas"].values()
+    )
+    states = fleet.states()
+    fleet.stop()
+    check("rolling", roll["ok"], f"rolling restart aborted: {roll}")
+    check("rolling", len(roll["replicas"]) == 3,
+          f"rotated {len(roll['replicas'])} replicas, want 3")
+    check("rolling", lost == 0,
+          f"{lost}/{len(everyone)} streams lost across the rotation")
+    for h, got_toks in results:
+        want = roll_ref[tuple(h._request.original_prompt)]
+        check("rolling", got_toks == want,
+              f"stream {h._request.original_prompt} diverged across the "
+              f"rotation: {got_toks} != {want}")
+    check("rolling", rotations == 3,
+          f"rolling_restarts counters sum to {rotations}, want 3")
+    check("rolling", states.get(ReplicaState.ACTIVE, 0) == 3,
+          f"fleet not whole after the rotation: {states}")
+    report["rolling"] = {"rotations": rotations, "streams": len(everyone),
+                         "lost": lost, "ok": roll["ok"]}
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    report["ok"] = not failures
+    print(json.dumps({"durable_sweep": report}, indent=2))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print("OK: durable sweep — SIGKILL'd child warm-restarted "
+              "byte-exactly (greedy/seeded/speculative/constrained), torn "
+              "tail truncated, fsync + append faults degraded gracefully, "
+              "fingerprint drift refused typed, and the 3-replica rolling "
+              "restart lost zero streams")
+    return not failures
+
+
 def run_overload_sweep() -> bool:
     """Overload storm (ISSUE 14): a loadgen-driven ~3x saturation burst
     against one scheduler on a virtual clock. Certifies the overload
@@ -1333,6 +1682,12 @@ def main() -> int:
                          "(grammar build failure typed pre-queue, "
                          "mid-stream advance failure quarantined alone, "
                          "crash replay byte-exact + schema-valid)")
+    ap.add_argument("--durable", action="store_true",
+                    help="also run the durable-serving sweep (SIGKILL'd "
+                         "child warm-restarts byte-exactly, torn-tail "
+                         "truncation, fsync/append fault degradation, "
+                         "fingerprint-drift refusal, 3-replica rolling "
+                         "restart with zero stream loss)")
     ap.add_argument("--mesh", type=int, default=0, metavar="N",
                     help="run ONLY the sharded-generation sweep on a "
                          "forced N-device host mesh (failed/stalled "
@@ -1370,6 +1725,9 @@ def main() -> int:
             rc = 1
     if args.constrained and rc == 0:
         if not run_constrained_sweep():
+            rc = 1
+    if args.durable and rc == 0:
+        if not run_durable_sweep():
             rc = 1
     return rc
 

@@ -505,6 +505,53 @@ def show_constrained(base: str) -> int:
     return 0
 
 
+def _print_durable_report(rep: dict, indent: str = "    ") -> None:
+    wm = rep.get("watermark", {})
+    wal = rep.get("wal", {})
+    counts = rep.get("counters", {})
+    ri = rep.get("resume_index", {})
+    print(f"{indent}wal: dir={rep.get('wal_dir')!r} fsync={rep.get('fsync')} "
+          f"segments={rep.get('segments', 0)}")
+    print(f"{indent}watermark: segment={wm.get('segment')} "
+          f"bytes={wm.get('segment_bytes')} appends={wm.get('appends')} "
+          f"unflushed={wm.get('unflushed')} commit_lag={wm.get('commit_lag')} "
+          f"open_streams={wm.get('open_streams')}")
+    print(f"{indent}writes: appends={wal.get('appends', 0)} "
+          f"bytes={wal.get('bytes', 0)} fsyncs={wal.get('fsyncs', 0)} "
+          f"fsync_failures={wal.get('fsync_failures', 0)} "
+          f"fsync_p50={wal.get('fsync_p50_s', 0.0) * 1e3:.2f}ms "
+          f"reaped_segments={wal.get('reaped_segments', 0)}")
+    print(f"{indent}replay: streams={counts.get('replayed_streams', 0)} "
+          f"tokens={counts.get('replayed_tokens', 0)} "
+          f"torn_records={counts.get('torn_records', 0)} "
+          f"rolling_restarts={counts.get('rolling_restarts', 0)}")
+    print(f"{indent}degraded_streams={rep.get('degraded_streams', 0)}  "
+          f"resume_index: live={ri.get('live', 0)} "
+          f"terminal={ri.get('terminal', 0)}")
+
+
+def show_durable(base: str) -> int:
+    """Durable-serving view (GET /v2/durable): WAL watermark + write
+    counters, warm-restart replay totals, degraded streams, and the
+    resume index — the "would a crash right now lose anything, and did
+    the last restart replay cleanly?" answer."""
+    payload = _get_json(f"{base}/v2/durable")
+    shown = 0
+    for name, rep in sorted(payload.get("models", {}).items()):
+        shown += 1
+        if "replicas" in rep:  # fleet: per-replica durability
+            print(f"model {name!r} (durable fleet, root={rep.get('root')!r}):")
+            for rid, rrep in sorted(rep.get("replicas", {}).items()):
+                print(f"  replica {rid}:")
+                _print_durable_report(rrep, indent="      ")
+        else:
+            print(f"model {name!r} (durable):")
+            _print_durable_report(rep)
+    if not shown:
+        print("no models have durability attached")
+    return 0
+
+
 def dump_timeline(base: str, out: str) -> int:
     payload = _get_json(f"{base}/v2/debug/timeline")
     with open(out, "w") as f:
@@ -721,6 +768,7 @@ def selfcheck() -> int:
             jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32),
             jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.float32),
             jnp.zeros((b,), jnp.uint32), jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b, eng.cfg.vocab_size), jnp.float32),
         )
         retraces = _get_json(f"{base}/v2/debug/programs")["models"]["lm"]["retraces"]
         check(retraces, "forced retrace produced no registry record")
@@ -864,6 +912,49 @@ def selfcheck() -> int:
               and "calibration table entry" in blame
               and "opcosts_cpu.json" in blame,
               f"drift blame wrong: {blame!r}")
+
+        # ---------------- durable serving: kill + warm restart replays
+        # in-process "process death": journal a stream mid-decode, then
+        # abandon the scheduler without ENDing it — exactly the journal
+        # a SIGKILL leaves behind (minus the torn tail, which chaoscheck
+        # --durable covers with a real kill). A fresh attachment on the
+        # same WAL directory must warm-restart with a NON-EMPTY replay
+        # report and count it on the durable gauges. The abandoned
+        # scheduler's blocks leak by design (its owner is "dead"); this
+        # is the last leg, the engine is torn down right after.
+        import shutil
+        import tempfile
+
+        from flexflow_tpu.generation import ContinuousBatchingScheduler
+        from flexflow_tpu.serving.durable import Durability, DurabilityConfig
+
+        wal_root = tempfile.mkdtemp(prefix="obsreport-durable-")
+        try:
+            dead = ContinuousBatchingScheduler(eng)
+            Durability(dead, DurabilityConfig(wal_dir=wal_root))
+            dead.submit([2, 7, 1, 8, 2, 8], SamplingParams(max_new_tokens=10))
+            for _ in range(4):
+                dead.step()
+            sched2 = ContinuousBatchingScheduler(eng)
+            dur2 = Durability(sched2, DurabilityConfig(wal_dir=wal_root))
+            replay = dur2.warm_restart()
+            check(replay["replayed_streams"] >= 1
+                  and replay["replayed_tokens"] >= 1,
+                  f"warm restart replayed nothing: {replay}")
+            adopted = [e.req for e in sched2.journal.entries()]
+            for _ in range(200):
+                if all(r.handle.done() for r in adopted):
+                    break
+                if not sched2.step():
+                    break
+            check(adopted and all(r.handle.done() for r in adopted),
+                  "adopted stream did not finish after the warm restart")
+            rep = dur2.report()
+            check(rep["counters"]["replayed_streams"] >= 1,
+                  f"durable report did not count the replay: {rep['counters']}")
+            dur2.close()
+        finally:
+            shutil.rmtree(wal_root, ignore_errors=True)
     finally:
         srv.stop()
 
@@ -877,9 +968,10 @@ def selfcheck() -> int:
           "retrace produced a correct blame string, SLO + readiness "
           "rationale live, truth ledger joined prefill/decode/verify + an "
           "executor program, a scaled calibration entry tripped the "
-          "drift alarm with correct blame, and the step-anatomy profiler "
+          "drift alarm with correct blame, the step-anatomy profiler "
           "reported a finite bubble ratio + overlap headroom with a "
-          "successful forced two-lane capture")
+          "successful forced two-lane capture, and an abandoned durable "
+          "journal warm-restarted with a non-empty replay report")
     return 0
 
 
@@ -888,7 +980,7 @@ def main() -> int:
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("command", nargs="?", default="summary",
                     choices=("summary", "cache", "slo", "predict", "anatomy",
-                             "overload", "disagg", "constrained"),
+                             "overload", "disagg", "constrained", "durable"),
                     help="view: summary (default), cache (block "
                          "residency), slo (burn rates), predict "
                          "(cost-model truth: error table + drift alarms), "
@@ -897,7 +989,9 @@ def main() -> int:
                          "history, shed table, autoscale signal), disagg "
                          "(pool states, KV handoff outcomes + latency, "
                          "in-flight transfers), constrained (grammar-cache "
-                         "economics, masked steps, dead-end quarantines)")
+                         "economics, masked steps, dead-end quarantines), "
+                         "durable (WAL watermark, replay totals, resume "
+                         "index)")
     ap.add_argument("--url", default="", help="base URL of a running server")
     ap.add_argument("--request", type=int, default=None,
                     help="print one request's trace waterfall")
@@ -941,6 +1035,8 @@ def main() -> int:
         return show_disagg(base)
     if args.command == "constrained":
         return show_constrained(base)
+    if args.command == "durable":
+        return show_durable(base)
     return summarize(base)
 
 

@@ -103,6 +103,15 @@ METRICS: Dict[str, Tuple[str, str, float]] = {
     # clock and gets the wide relative floor.
     "constrained_tokens_per_s_ratio": ("higher", "rel", 0.08),
     "constrained_decode_tokens_per_s": ("higher", "rel", 0.25),
+    # durable serving (ISSUE 19): the WAL-on/WAL-off ratio is a median
+    # of per-pair interleaved runs (machine drift cancels within a
+    # pair), so it gets a tight floor — a drop means the group commit's
+    # per-step host cost grew. fsync p50 is a physical disk latency:
+    # noisy across CI boxes, wide relative floor — a rise past it means
+    # commits started waiting on storage (or someone snuck extra fsyncs
+    # into the step).
+    "durable_tokens_per_s_ratio": ("higher", "rel", 0.08),
+    "durable_fsync_p50_s": ("lower", "rel", 0.50),
 }
 
 
