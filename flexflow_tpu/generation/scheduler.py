@@ -93,9 +93,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs import (
+    NULL_JOURNEY,
     NULL_TRACE,
     CacheTelemetry,
     FlightRecorder,
+    JourneyContext,
+    JourneyRecorder,
+    JourneyStats,
     RequestTrace,
     SLOMonitor,
     StepAnatomy,
@@ -263,6 +267,12 @@ class Request:
         # destination ring) at submit when tracing is enabled
         self.trace = NULL_TRACE
         self.trace_ring = None
+        # fleet-wide journey (ISSUE 20): the cross-replica trace context
+        # travels ON the request, exactly like the trace — minted (or
+        # joined from a remote traceparent) at submit, retargeted at the
+        # adopting scheduler on failover/handoff, restored from the WAL
+        # admission snapshot on warm restart
+        self.journey = NULL_JOURNEY
         self.original_prompt = list(prompt)
         self.prompt = list(prompt)  # prompt + recomputed prefix
         self.sampling = sampling
@@ -342,16 +352,36 @@ class Request:
                 release()
             except Exception:
                 pass  # limiter accounting must never poison a settle path
-        if self.trace is NULL_TRACE:
-            return
-        self.trace.mark_finish(outcome, err)
-        if self.trace_ring is not None:
-            self.trace_ring.add(self.trace)
-        if self.slo_sink is not None:
+        if self.trace is not NULL_TRACE:
+            self.trace.mark_finish(outcome, err)
+            if self.trace_ring is not None:
+                self.trace_ring.add(self.trace)
+            if self.slo_sink is not None:
+                try:
+                    self.slo_sink(self)
+                except Exception:
+                    pass  # SLO accounting must never poison a settle path
+        if self.journey is not NULL_JOURNEY:
+            # terminal hop: the span carries the full RequestTrace
+            # decomposition + event log, so the stitched journey holds
+            # the per-replica story without a second lookup. Recorded
+            # even when the trace is NULL (a warm-restored stream has a
+            # journey but no trace) — the journey must still end.
             try:
-                self.slo_sink(self)
+                tr = {} if self.trace is NULL_TRACE else self.trace.to_dict()
+                self.journey.hop(
+                    "finish", outcome=outcome,
+                    n_generated=len(self.generated),
+                    queue_time_s=tr.get("queue_time_s"),
+                    ttft_s=tr.get("ttft_s"), tpot_s=tr.get("tpot_s"),
+                    total_s=tr.get("total_s"),
+                    preemptions=tr.get("preemptions"),
+                    replays=tr.get("replays"),
+                    error=None if err is None else str(err),
+                    trace_events=tr.get("events"),
+                )
             except Exception:
-                pass  # SLO accounting must never poison a settle path
+                pass  # journeys must never poison a settle path
 
     def sample_key(self) -> jax.Array:
         """Key for the NEXT token: indexed by generated count, so a
@@ -457,6 +487,7 @@ class ContinuousBatchingScheduler:
         recovery: Optional[RecoveryPolicy] = None,
         watchdog: Optional[WatchdogPolicy] = None,
         observability: bool = True,
+        journeys: Optional[bool] = None,
         trace_ring_size: int = 256,
         flight_capacity: int = 512,
         trace_progress_every: int = 8,
@@ -547,6 +578,22 @@ class ContinuousBatchingScheduler:
         self.obs_enabled = observability
         self.trace_progress_every = trace_progress_every
         self.trace_ring = TraceRing(trace_ring_size)
+        # fleet-wide journeys (ISSUE 20): one span ring per replica,
+        # stitched across the fleet by JourneyIndex at query time. Rides
+        # observability by default; ``journeys=False`` keeps tracing on
+        # with journeys off (genbench's journey-overhead baseline). The
+        # lane label starts as the fault scope (the replica id in fleet
+        # mode) and the fleet renames it at spawn.
+        self.journey_stats = JourneyStats()
+        self.journey_stats.register_gauges(self.stats)
+        journeys_on = observability and (journeys is None or bool(journeys))
+        self.journeys: Optional[JourneyRecorder] = (
+            JourneyRecorder(
+                lane=fault_scope or "local", clock=self.clock,
+                stats=self.journey_stats,
+            )
+            if journeys_on else None
+        )
         # dual-clock stamps: records carry t (perf_counter, the
         # timeline's single rendering clock) AND t_sched (this
         # scheduler's possibly-virtual clock) for trace correlation
@@ -729,6 +776,7 @@ class ContinuousBatchingScheduler:
         priority: Optional[str] = None,
         grammar=None,
         response_format: Optional[Dict] = None,
+        journey: Optional[JourneyContext] = None,
     ) -> GenerationHandle:
         """Enqueue one request (priority-ordered, FCFS within a class).
         Typed rejections mirror the batcher: OverloadedError (a
@@ -836,6 +884,19 @@ class ContinuousBatchingScheduler:
                 )
                 if transport is not None:
                     req.trace.mark_transport(transport)
+                if self.journeys is not None:
+                    # a context handed in from ingress (HTTP/gRPC/fleet)
+                    # keeps its id and parents onto the ingress span;
+                    # otherwise the journey roots here
+                    ctx = journey if journey is not None else self.journeys.mint()
+                    ctx.recorder = self.journeys
+                    req.journey = ctx
+                    req.trace.journey_id = ctx.journey_id
+                    ctx.hop(
+                        "submit", request_id=req.id,
+                        prompt_len=len(prompt), priority=priority,
+                        transport=transport,
+                    )
             # the sequence can never outgrow max_seq_len (its last token
             # would need a cache position past the block table) NOR the
             # TOTAL cache: a sequence needing more blocks than exist
@@ -1103,6 +1164,10 @@ class ContinuousBatchingScheduler:
             req.mask_state = None
             req.replays += 1
             req.trace.note_replay()
+            req.journey.hop(
+                "replay", n_generated=req.n_generated,
+                reason="engine_restart",
+            )
             replayed += req.n_generated
             requeue.append(req)
         with self._lock:
@@ -1189,6 +1254,17 @@ class ContinuousBatchingScheduler:
             req.trace_ring = self.trace_ring
         if req.slo_sink is not None:
             req.slo_sink = self._slo_record
+        if req.journey is not NULL_JOURNEY:
+            # retarget the journey at the adopting replica's span ring:
+            # from here on, hops land in THIS lane (or nowhere, if this
+            # scheduler runs with journeys off — the context stays
+            # intact so a later adopter can pick it back up)
+            req.journey.recorder = self.journeys
+            req.journey.hop(
+                "adopt", replica=self.fault_scope,
+                imported=imported is not None, front=front,
+                n_generated=req.n_generated,
+            )
         # retarget overload accounting too: release the dead replica's
         # limiter slot and count the stream against THIS limiter —
         # forced past the limit (a migrated stream was already admitted
@@ -1337,7 +1413,7 @@ class ContinuousBatchingScheduler:
         self.stats.latency.record(max(0.0, self.clock() - req.submitted_at))
         tpot = req.trace.tpot_s
         if tpot is not None:
-            self.stats.observe("tpot", tpot)
+            self.stats.observe("tpot", tpot, exemplar=req.journey.journey_id)
         req.handle._finish(list(req.generated))
         self.stats.incr("completed")
 
@@ -1736,17 +1812,32 @@ class ContinuousBatchingScheduler:
             slot=slot, prompt_len=len(req.prompt),
             preemptions=req.preemptions, replays=req.replays,
         )
+        req.journey.hop(
+            "admit", slot=slot, prompt_len=len(req.prompt),
+            replica=self.fault_scope, preemptions=req.preemptions,
+            replays=req.replays,
+        )
         if self.obs_enabled and was_first and req.preemptions == 0 and req.replays == 0:
             # first-life admission only: a recompute re-admission is a
             # scheduling event, not client-visible queueing
-            self.stats.observe("queue_time", max(0.0, now - req.submitted_at))
+            self.stats.observe(
+                "queue_time", max(0.0, now - req.submitted_at),
+                exemplar=req.journey.journey_id,
+            )
         self._emit_token(state, token)
         req.trace.note_tokens(1, "prefill")
+        req.journey.hop(
+            "prefill", prompt_len=len(req.prompt),
+            prefix_reused=prefix_len, replica=self.fault_scope,
+        )
         if self.obs_enabled and was_first:
             # gated like tpot (trace-derived in _finish) so disabling
             # observability drops all three SLO windows together, not
             # a confusing two of three
-            self.stats.observe("ttft", max(0.0, now - req.submitted_at))
+            self.stats.observe(
+                "ttft", max(0.0, now - req.submitted_at),
+                exemplar=req.journey.journey_id,
+            )
         self.flight.record_step(
             "prefill",
             phases={"prefix_plan": (t_p1 - t_p0) + (t_q1 - t_q0),
@@ -1774,6 +1865,10 @@ class ContinuousBatchingScheduler:
             req.trace.event(
                 "kv_handoff_pack", n_blocks=len(payload.blocks),
                 payload_bytes=payload.nbytes,
+            )
+            req.journey.hop(
+                "kv_handoff_pack", n_blocks=len(payload.blocks),
+                payload_bytes=payload.nbytes, replica=self.fault_scope,
             )
             sink = self.handoff_sink
             try:
@@ -1879,6 +1974,11 @@ class ContinuousBatchingScheduler:
         req.trace.event(
             "kv_import", n_blocks=len(payload.blocks),
             n_positions=payload.n_positions, payload_bytes=payload.nbytes,
+        )
+        req.journey.hop(
+            "admit", slot=slot, prompt_len=len(req.prompt),
+            replica=self.fault_scope, imported=True,
+            n_blocks=len(payload.blocks),
         )
         self.flight.record_step(
             "kv_import", phases={"admit": time.perf_counter() - t0},

@@ -49,8 +49,22 @@ PR 12 adds the *step-anatomy* dimension:
   projection gating ROADMAP item 4
   (``GET /v2/debug/anatomy?capture=K``).
 
+PR 20 adds the *fleet* dimension:
+
+* :mod:`journey` — Dapper-style cross-replica request journeys: a
+  stable journey id minted (or joined from a W3C ``traceparent``) at
+  HTTP/gRPC ingress rides the Request through routing, admission,
+  prefill, KV handoff, failover adoption, WAL warm restart, and SSE
+  resume, each hop a parent-linked :class:`JourneySpan` in the owning
+  replica's :class:`JourneyRecorder` lane (mirrored to a bounded
+  on-disk :class:`JourneySpool` next to the WAL so pre-crash spans
+  survive process death). :class:`JourneyIndex` stitches the lanes
+  into one causal timeline (``GET /v2/debug/journey/{id}``), rendered
+  as chrome://tracing JSON or an OTLP-compatible shape.
+
 See tools/obsreport.py for the CLI (summaries, trace waterfalls,
-timeline dumps, cache/SLO/anatomy views, and the CI ``--selfcheck``).
+timeline dumps, cache/SLO/anatomy/journey views, and the CI
+``--selfcheck``).
 """
 from .capacity import (
     GLOBAL_PROGRAMS,
@@ -59,6 +73,22 @@ from .capacity import (
     ServingFlops,
 )
 from .flight import FlightRecorder
+from .journey import (
+    NULL_JOURNEY,
+    JourneyContext,
+    JourneyIndex,
+    JourneyRecorder,
+    JourneySpan,
+    JourneySpool,
+    JourneyStats,
+    format_traceparent,
+    new_journey_id,
+    new_span_id,
+    parse_traceparent,
+    stitch,
+)
+from .journey import to_chrome_trace as journey_to_chrome_trace
+from .journey import to_otlp as journey_to_otlp
 from .prom import (
     escape_label_value,
     format_value,
@@ -84,6 +114,20 @@ __all__ = [
     "StepAnatomy",
     "ServingFlops",
     "NULL_TRACE",
+    "NULL_JOURNEY",
+    "JourneyContext",
+    "JourneyIndex",
+    "JourneyRecorder",
+    "JourneySpan",
+    "JourneySpool",
+    "JourneyStats",
+    "format_traceparent",
+    "journey_to_chrome_trace",
+    "journey_to_otlp",
+    "new_journey_id",
+    "new_span_id",
+    "parse_traceparent",
+    "stitch",
     "RequestTrace",
     "TraceRing",
     "next_request_id",

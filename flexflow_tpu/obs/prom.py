@@ -153,6 +153,11 @@ _HELP = {
     "fleet_migrated_streams_total": "Streams journal-replayed onto a surviving or replacement replica.",
     "fleet_replaced_total": "Replicas retired and swapped for a fresh warmed replica.",
     "router_decisions_total": "Fleet router placements by decision reason.",
+    "journey_journeys_total": "Request journeys (fleet-wide traces) minted by this unit (cumulative).",
+    "journey_spans_total": "Journey spans recorded across all hops (cumulative).",
+    "journey_spooled_spans_total": "Journey spans mirrored to the on-disk spool next to the WAL (cumulative).",
+    "journey_spool_truncated_total": "Torn journey-spool tails truncated on scan — expected crash-mid-append damage (cumulative).",
+    "journey_remote_parents_total": "Journeys joined from a remote W3C traceparent rather than minted fresh (cumulative).",
 }
 
 

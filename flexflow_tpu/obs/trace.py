@@ -55,7 +55,7 @@ class RequestTrace:
         "t_accept", "t_admit", "t_first_token", "t_last_token", "t_finish",
         "n_generated", "outcome", "error", "preemptions", "replays",
         "spec_windows", "spec_proposed", "spec_accepted", "transport",
-        "progress_every", "_steps_since_progress",
+        "progress_every", "_steps_since_progress", "journey_id",
     )
 
     def __init__(
@@ -88,6 +88,10 @@ class RequestTrace:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.transport: Optional[str] = None
+        # fleet-wide journey (trace) id this request rides, if any —
+        # the join key between a replica-local trace and the stitched
+        # cross-replica journey (obs/journey.py)
+        self.journey_id: Optional[str] = None
         self.progress_every = max(1, progress_every)
         self._steps_since_progress = 0
 
@@ -215,6 +219,7 @@ class RequestTrace:
             "request_id": self.request_id,
             "model": self.model,
             "transport": self.transport,
+            "journey_id": self.journey_id,
             "t_accept": self.t_accept,
             "t_finish": self.t_finish,
             "prompt_len": self.prompt_len,
@@ -255,6 +260,7 @@ class _NullTrace:
     queue_time_s = ttft_s = tpot_s = total_s = None
     n_generated = 0
     t_accept = None
+    journey_id = None
 
 
 NULL_TRACE = _NullTrace()

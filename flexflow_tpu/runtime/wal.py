@@ -77,28 +77,34 @@ class WalCorruptionError(WalError):
     expected torn tail of the newest segment."""
 
 
-def _segment_name(index: int) -> str:
-    return f"{_SEG_PREFIX}{index:08d}{_SEG_SUFFIX}"
+def _segment_name(index: int, prefix: str = _SEG_PREFIX) -> str:
+    return f"{prefix}{index:08d}{_SEG_SUFFIX}"
 
 
-def _segment_index(name: str) -> Optional[int]:
-    if not (name.startswith(_SEG_PREFIX) and name.endswith(_SEG_SUFFIX)):
+def _segment_index(name: str, prefix: str = _SEG_PREFIX) -> Optional[int]:
+    if not (name.startswith(prefix) and name.endswith(_SEG_SUFFIX)):
         return None
     try:
-        return int(name[len(_SEG_PREFIX):-len(_SEG_SUFFIX)])
+        return int(name[len(prefix):-len(_SEG_SUFFIX)])
     except ValueError:
         return None
 
 
-def list_segments(dirpath: str) -> List[Tuple[int, str]]:
-    """(index, absolute path) for every segment file, index-ascending."""
+def list_segments(
+    dirpath: str, prefix: str = _SEG_PREFIX
+) -> List[Tuple[int, str]]:
+    """(index, absolute path) for every segment file, index-ascending.
+
+    ``prefix`` selects the segment family sharing this directory tree:
+    the default ``wal-`` journal, or sidecar rings framed the same way
+    (the journey span spool uses ``journey-``)."""
     out = []
     try:
         names = os.listdir(dirpath)
     except FileNotFoundError:
         return []
     for name in names:
-        idx = _segment_index(name)
+        idx = _segment_index(name, prefix)
         if idx is not None:
             out.append((idx, os.path.join(dirpath, name)))
     return sorted(out)

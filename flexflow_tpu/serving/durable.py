@@ -62,6 +62,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from ..generation.engine import SamplingParams
 from ..generation.recovery import GenerationJournal
 from ..generation.scheduler import Request
+from ..obs import JourneyContext, JourneySpool
 from ..generation.speculative.drafter import SpeculationConfig, build_drafter
 from ..runtime import faults
 from ..runtime.wal import (
@@ -141,6 +142,9 @@ class DurabilityConfig:
     commit_interval_s: float = 0.05
     wall_clock: Callable[[], float] = time.time
     resume_cache: int = 256  # terminal outcomes kept for late resumers
+    # journey-span spool budget (obs/journey.py): the bounded on-disk
+    # ring of pre-crash spans kept next to the WAL segments
+    journey_spool_bytes: int = 1 << 20
 
 
 class DurableJournal(GenerationJournal):
@@ -249,6 +253,10 @@ class DurableJournal(GenerationJournal):
             "response_format": req.response_format,
             "speculation": spec,
             "max_new": req.max_new,
+            # the stream's fleet-wide identity: a warm restart restores
+            # (journey id, chain tip, hop count) so post-crash spans
+            # parent onto the pre-crash chain (None when journeys off)
+            "journey": req.journey.snapshot(),
         }
 
     # ------------------------------------------------------ token deltas
@@ -370,6 +378,20 @@ class Durability:
             on_admit=self._note_live,
             on_terminal=self._note_terminal,
         )
+        # journeys (ISSUE 20): spool this replica's spans into a
+        # bounded on-disk ring next to the WAL segments so pre-crash
+        # hops stay joinable after SIGKILL (same directory across
+        # restarts: the successor's spool scans the predecessor's
+        # sealed segments)
+        self.journey_spool = None
+        journeys = getattr(scheduler, "journeys", None)
+        if journeys is not None:
+            self.journey_spool = JourneySpool(
+                os.path.join(config.wal_dir, "journeys"),
+                max_bytes=config.journey_spool_bytes,
+                stats=scheduler.journey_stats,
+            )
+            journeys.spool = self.journey_spool
         for entry in scheduler.journal.entries():
             self.journal.record(entry.req, entry.admitted_seq)
         scheduler.journal = self.journal
@@ -443,6 +465,8 @@ class Durability:
         """Flush and release the WAL (replica teardown). The journal
         keeps serving the in-memory recovery paths; further appends
         are dropped as degraded."""
+        if self.journey_spool is not None:
+            self.journey_spool.close()
         self.wal.close()
 
 
@@ -487,6 +511,14 @@ class WarmRestart:
             d.stats.incr("replayed_streams")
             d.stats.incr("replayed_tokens", len(stream.tokens))
             sched.adopt(req, front=req.n_generated > 0)
+            # the adopt hop (recorded inside adopt()) parented onto the
+            # pre-crash chain tip restored from the WAL snapshot; the
+            # restart itself is its own hop so the stitched timeline
+            # shows the down-window explicitly
+            req.journey.hop(
+                "warm_restart", durable_id=req.durable_id,
+                n_tokens=len(stream.tokens), torn_records=torn,
+            )
             adopted.append(req)
         # re-journal into the NEW active segment and make it durable
         # BEFORE releasing the predecessor segments for reaping — a
@@ -551,6 +583,12 @@ class WarmRestart:
         req.generated = [int(t) for t in stream.tokens]
         req.max_new = int(admit.get("max_new", sampling.max_new_tokens))
         req.durable_id = admit["id"]
+        snap = admit.get("journey")
+        if snap and sched.journeys is not None:
+            # identity survives the process: same journey id, next hop
+            # parents onto the pre-crash tip (adopt() binds the
+            # recorder when it retargets observability at this replica)
+            req.journey = JourneyContext.restore(snap)
         req.submitted_at = sched.clock()
         if remaining is not None:
             req.deadline = sched.clock() + remaining

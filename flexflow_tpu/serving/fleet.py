@@ -77,7 +77,7 @@ from ..generation.engine import GenerationEngine, SamplingParams
 from ..generation.prefix import KVHandoffPayload, PackedBlock
 from ..generation.recovery import EngineFailedError
 from ..generation.scheduler import GenerationHandle, Request
-from ..obs import FlightRecorder
+from ..obs import FlightRecorder, JourneyRecorder
 from ..runtime import faults
 from .generation import GenerationModel
 from .overload import AutoscaleAdvisor, OverloadConfig, Priority
@@ -492,6 +492,17 @@ class Fleet:
         self._monitor: Optional[threading.Thread] = None
         self._monitor_stop = threading.Event()
         self.router = FleetRouter(self, self.fleet_stats)
+        # fleet-wide journeys (ISSUE 20): the router's own span lane —
+        # a journey minted here (no HTTP/gRPC ingress in front, e.g.
+        # chaoscheck driving the fleet directly) still records its
+        # routing decision before the replica's submit hop. Gated
+        # exactly like each replica's recorder so journeys-off fleets
+        # stay inert.
+        _j = self._scheduler_kwargs.get("journeys")
+        self.journeys = (
+            JourneyRecorder(lane=f"{rid_prefix}router", clock=clock)
+            if observability and (_j is None or bool(_j)) else None
+        )
         # autoscaling signal (ISSUE 14 / ROADMAP item 3 remainder):
         # sustained limiter saturation across every eligible replica ->
         # want-more; sustained fleet-wide idleness -> want-fewer.
@@ -508,6 +519,12 @@ class Fleet:
         # stepping until their residents finish (or expire), then torn
         # down — a drain timeout must never abort live streams
         self._retiring: List[Replica] = []  # guarded-by: _lock
+        # journey lanes of torn-down replicas (bounded): a failed-over
+        # stream's pre-crash hops live ONLY in the dead replica's span
+        # ring — dropping it with the replica would leave a gap in
+        # every stitched journey that crossed the failover
+        self._dead_journeys: deque = deque(maxlen=8)  # guarded-by: _lock
+        self._dead_spools: deque = deque(maxlen=8)    # guarded-by: _lock
         # initial spawns warm-restart their slot journals: a fleet
         # coming back after process death replays every unfinished
         # stream the dead process journaled
@@ -593,6 +610,39 @@ class Fleet:
             out[r.state] = out.get(r.state, 0) + 1
         return out
 
+    # ------------------------------------------------------------ journeys
+    def journey_recorders(self) -> List:
+        """Every live span lane this fleet owns — the router's plus one
+        per replica (retiring included: their spans are still the only
+        live copy of hops on streams that finished there). The debug
+        endpoints hand these to a JourneyIndex at query time."""
+        out = [self.journeys] if self.journeys is not None else []
+        with self._lock:
+            members = list(self.replicas) + list(self._retiring)
+            dead = list(self._dead_journeys)
+        for r in members:
+            rec = getattr(r.model, "journeys", None)
+            if rec is not None:
+                out.append(rec)
+        out.extend(dead)
+        return out
+
+    def journey_spools(self) -> List:
+        """Every replica's on-disk span spool (durable fleets only) —
+        the joinable record of pre-crash hops."""
+        out = []
+        with self._lock:
+            members = list(self.replicas) + list(self._retiring)
+            dead = list(self._dead_spools)
+        for r in members:
+            spool = getattr(r.model, "journey_spool", None)
+            if spool is not None:
+                out.append(spool)
+        # same slot directory across restarts: the successor's spool
+        # covers the same segments, and _collect dedups by span id
+        out.extend(s for s in dead if s not in out)
+        return out
+
     # ------------------------------------------------------------- submit
     def submit(
         self,
@@ -602,6 +652,7 @@ class Fleet:
         speculation=None,
         transport: Optional[str] = None,
         priority: Optional[str] = None,
+        journey=None,
     ) -> GenerationHandle:
         """Route + enqueue one request. Typed rejections mirror the
         single-model path (OverloadedError / QueueFullError /
@@ -614,9 +665,16 @@ class Fleet:
             raise ShuttingDownError("fleet draining")
         priority = Priority.parse(priority)
         replica, reason = self.router.route(prompt, priority)
+        if journey is None and self.journeys is not None:
+            # no ingress in front of this fleet: the journey roots at
+            # the router so the routing decision is still a hop
+            journey = self.journeys.mint()
+        if journey is not None:
+            journey.hop("route", replica=replica.id, reason=reason)
         handle = replica.model.submit(
             prompt, sampling, deadline_s=deadline_s,
             speculation=speculation, transport=transport, priority=priority,
+            journey=journey,
         )
         handle.trace.event("route", replica=replica.id, reason=reason)
         self.fleet_flight.record_event(
@@ -704,6 +762,10 @@ class Fleet:
                 continue
             self.fleet_stats.incr("migrated_streams")
             try:
+                req.journey.hop(
+                    "failover", to_replica=survivor.id,
+                    mid_stream=req.n_generated > 0,
+                )
                 req.trace.event("failover", to_replica=survivor.id)
                 self.fleet_flight.record_event(
                     "migrate", request_id=req.id, to_replica=survivor.id,
@@ -1084,6 +1146,15 @@ class Fleet:
             self._fold_counters(replica.model.stats.counters())
         except Exception:
             pass
+        # keep the dead lane's span ring (and spool) stitchable: its
+        # spans are the only copy of hops on streams that failed over
+        rec = getattr(replica.model, "journeys", None)
+        spool = getattr(replica.model, "journey_spool", None)
+        with self._lock:
+            if rec is not None:
+                self._dead_journeys.append(rec)
+            if spool is not None:
+                self._dead_spools.append(spool)
         try:
             # bounded join: teardown runs on the monitor thread, and a
             # replica that somehow still wedges must not stall the
@@ -1694,6 +1765,11 @@ class HandoffManager:
             source=h.source, target=target.id, attempts=h.attempts + 1,
         )
         try:
+            req.journey.hop(
+                "kv_handoff", source=h.source, target=target.id,
+                n_blocks=len(wire), attempts=h.attempts + 1,
+                payload_bytes=arrived.nbytes,
+            )
             req.trace.event(
                 "kv_handoff", source=h.source, target=target.id,
                 n_blocks=len(wire),
@@ -1716,6 +1792,9 @@ class HandoffManager:
             **({"error": repr(cause)[:200]} if cause is not None else {}),
         )
         try:
+            h.req.journey.hop(
+                "kv_handoff_replay", outcome=outcome, source=h.source,
+            )
             h.req.trace.event("kv_handoff_replay", outcome=outcome)
         except Exception:
             pass
@@ -1857,6 +1936,7 @@ class DisaggregatedFleet:
         speculation=None,
         transport: Optional[str] = None,
         priority: Optional[str] = None,
+        journey=None,
     ) -> GenerationHandle:
         """Admission is the prefill pool's: its router places the
         request (affinity/least-loaded/spill) and its overload
@@ -1866,7 +1946,7 @@ class DisaggregatedFleet:
             raise ShuttingDownError("fleet stopped")
         return self.prefill.submit(
             prompt, sampling, deadline_s=deadline_s, speculation=speculation,
-            transport=transport, priority=priority,
+            transport=transport, priority=priority, journey=journey,
         )
 
     def generate(
@@ -1998,6 +2078,12 @@ class DisaggregatedFleet:
     def _replicas_snapshot(self) -> List[Replica]:
         return self.replicas
 
+    @property
+    def journeys(self):
+        """Journeys-on gate for the ingress layer: requests enter via the
+        prefill pool, so its router recorder answers for both pools."""
+        return self.prefill.journeys
+
     def states(self) -> Dict[str, int]:
         out = self.prefill.states()
         for k, v in self.decode.states().items():
@@ -2065,6 +2151,17 @@ class DisaggregatedFleet:
             "handoff_timeout_s": self.handoff.timeout_s,
         }
         return md
+
+    # ---------------------------------------------------------- journeys
+    def journey_recorders(self) -> List:
+        """Both pools' span lanes (routers + every replica) — one
+        stitched timeline covers prefill, handoff, and decode hops."""
+        return (
+            self.prefill.journey_recorders() + self.decode.journey_recorders()
+        )
+
+    def journey_spools(self) -> List:
+        return self.prefill.journey_spools() + self.decode.journey_spools()
 
     # ----------------------------------------------------------- reports
     def report(self) -> Dict:

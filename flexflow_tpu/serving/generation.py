@@ -108,6 +108,31 @@ class GenerationModel:
         return self.scheduler.trace_ring
 
     @property
+    def journeys(self):
+        """This replica's journey span recorder (None when journeys
+        are off) — one lane in the fleet's stitched timeline
+        (GET /v2/debug/journey/{id})."""
+        return self.scheduler.journeys
+
+    @property
+    def journey_spool(self):
+        """The on-disk journey span ring (set by enable_durability)
+        keeping pre-crash spans joinable after process death."""
+        sched = self.scheduler
+        rec = sched.journeys
+        return rec.spool if rec is not None else None
+
+    def journey_recorders(self):
+        """Uniform shape with Fleet/DisaggregatedFleet so the server's
+        journey index builds the same way over any generation unit."""
+        rec = self.scheduler.journeys
+        return [rec] if rec is not None else []
+
+    def journey_spools(self):
+        spool = self.journey_spool
+        return [spool] if spool is not None else []
+
+    @property
     def flight(self):
         """The engine flight recorder (GET /v2/debug/timeline)."""
         return self.scheduler.flight
@@ -185,6 +210,7 @@ class GenerationModel:
         transport: Optional[str] = None,
         priority: Optional[str] = None,
         response_format: Optional[Dict] = None,
+        journey=None,
     ) -> GenerationHandle:
         grammar = None
         if response_format is not None:
@@ -196,6 +222,7 @@ class GenerationModel:
             prompt, sampling, deadline_s=deadline_s, speculation=speculation,
             transport=transport, priority=priority,
             grammar=grammar, response_format=response_format,
+            journey=journey,
         )
         if self.durable is not None:
             # pre-assign the durable id at submit (admission journals
@@ -290,6 +317,7 @@ class GenerationModel:
                 "flight_capacity": self.scheduler.flight.capacity,
                 "progress_every": self.scheduler.trace_progress_every,
                 "anatomy": self.scheduler.anatomy.enabled,
+                "journeys": self.scheduler.journeys is not None,
             },
             "compute": {
                 "chip": self.engine.flops_model.chip.name,

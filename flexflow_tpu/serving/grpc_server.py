@@ -24,6 +24,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..obs import JourneyRecorder, parse_traceparent
 from .batcher import DynamicBatcher, make_batcher
 from .model import InferenceModel
 from .resilience import ResilienceError, grpc_code, retry_after_s
@@ -141,6 +142,13 @@ class GrpcInferenceServer:
             self.batchers: Dict[str, DynamicBatcher] = {}
             self.generators: Dict = {}
             self.repository = repository
+        # journey ingress recorder (fleet tracing, ISSUE 20). Shared
+        # deployments reuse the HTTP server's "http" lane so one
+        # JourneyIndex covers both transports; standalone gets its own.
+        if http_server is not None:
+            self.journeys = http_server.journeys
+        else:
+            self.journeys = JourneyRecorder(lane="grpc")
         self._server = None
         self._started = False
         self._lock = threading.Lock()
@@ -440,11 +448,30 @@ class GrpcInferenceServer:
             response_format = gen.response_format_from(
                 {"response_format": rf} if rf is not None else {}
             )
+            # journey ingress: join the client's W3C traceparent from
+            # invocation metadata, or mint fresh (only when the target
+            # unit records journeys — journeys-off stays inert)
+            journey = None
+            if getattr(gen, "journeys", None) is not None:
+                tp = None
+                try:
+                    for k, v in context.invocation_metadata() or ():
+                        if k.lower() == "traceparent":
+                            tp = v
+                            break
+                except Exception:
+                    pass  # metadata access must never fail the RPC
+                journey = self.journeys.mint(parent=parse_traceparent(tp))
+                journey.hop(
+                    "ingress", transport="grpc",
+                    model=request.model_name, prompt_len=len(prompt),
+                )
             remaining = context.time_remaining()
             handle = gen.submit(
                 prompt, sampling, deadline_s=remaining, transport="grpc",
                 priority=params.get("priority"),
                 response_format=response_format,
+                journey=journey,
             )
         except ResilienceError as e:
             self._abort(context, grpc_code(e, grpc), str(e), err=e)
@@ -475,6 +502,18 @@ class GrpcInferenceServer:
             durable_id = handle._request.durable_id
             if durable_id is not None:
                 final.parameters["durable_id"].string_param = durable_id
+            # journey identity rides the final response + trailing
+            # metadata (the gRPC analog of the HTTP traceparent header)
+            if journey is not None:
+                final.parameters["journey_id"].string_param = (
+                    journey.journey_id
+                )
+                try:
+                    context.set_trailing_metadata(
+                        (("traceparent", journey.traceparent()),)
+                    )
+                except Exception:
+                    pass  # metadata must never mask the stream payload
             yield final
         except ResilienceError as e:
             handle.cancel()

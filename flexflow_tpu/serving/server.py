@@ -117,7 +117,16 @@ import numpy as np
 
 import math
 
-from ..obs import GLOBAL_LEDGER, GLOBAL_PROGRAMS, render_prometheus
+from ..obs import (
+    GLOBAL_LEDGER,
+    GLOBAL_PROGRAMS,
+    JourneyIndex,
+    JourneyRecorder,
+    journey_to_chrome_trace,
+    journey_to_otlp,
+    parse_traceparent,
+    render_prometheus,
+)
 from ..runtime import faults
 from .batcher import DynamicBatcher, make_batcher
 from .model import InferenceModel
@@ -192,6 +201,11 @@ class InferenceServer:
         self._draining = False
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # fleet-wide journeys (ISSUE 20): the HTTP ingress span lane.
+        # Contexts are minted here (or joined from an inbound W3C
+        # traceparent) only for generators whose journeys are on, so a
+        # journeys-off deployment stays inert.
+        self.journeys = JourneyRecorder(lane="http")
 
     def register(self, model: InferenceModel):
         self.models[model.name] = model
@@ -548,6 +562,70 @@ class InferenceServer:
                     return name, hit
         return None
 
+    # ------------------------------------------------------------ journeys
+    def journey_index(self) -> JourneyIndex:
+        """A fresh fleet-wide stitcher over the CURRENT topology: the
+        HTTP ingress lane, every generator's router + replica lanes
+        (retiring replicas included), and every on-disk spool — built
+        per query so replica churn can never leave the index stale."""
+        idx = JourneyIndex().add(self.journeys)
+        for g in list(self.generators.values()):
+            recs = getattr(g, "journey_recorders", None)
+            if recs is not None:
+                for rec in recs():
+                    idx.add(rec)
+            spools = getattr(g, "journey_spools", None)
+            if spools is not None:
+                for spool in spools():
+                    idx.add_spool(spool)
+        return idx
+
+    def debug_journey(self, journey_id: str) -> Optional[Dict]:
+        """GET /v2/debug/journey/{id}: the stitched causal timeline —
+        spans in parent-chain order with the connectivity verdict, plus
+        chrome://tracing (one lane per replica/pool) and OTLP-shaped
+        renderings of the same journey."""
+        journey = self.journey_index().get(journey_id)
+        if journey is None:
+            return None
+        return {
+            "journey": journey,
+            "chrome_trace": journey_to_chrome_trace(journey),
+            "otlp": journey_to_otlp(journey),
+        }
+
+    def debug_journeys(self, slow: Optional[str] = None, n: int = 32) -> Dict:
+        """GET /v2/debug/journey[?slow=p99]: known journey ids (newest
+        first); with ``slow=``, only the ids the latency windows
+        retained as worst-decile exemplars — a bad percentile links
+        straight to a stitchable journey."""
+        if slow:
+            rows = self.debug_slow()
+            ids: list = []
+            for windows in rows["models"].values():
+                for entries in windows.values():
+                    for e in entries:
+                        if e["journey_id"] not in ids:
+                            ids.append(e["journey_id"])
+            return {"journeys": ids[:n], "slow": rows["models"]}
+        return {"journeys": self.journey_index().journey_ids()[:n]}
+
+    def debug_slow(self, model: Optional[str] = None) -> Dict:
+        """GET /v2/debug/slow: per generation unit, each latency
+        window's worst-decile samples with their journey ids — the
+        tail-latency exemplar table."""
+        out: Dict = {"models": {}}
+        for label, unit in self._generation_units():
+            if not self._unit_matches(label, model):
+                continue
+            try:
+                rows = unit.stats.slow_exemplars()
+            except AttributeError:
+                continue
+            if rows:
+                out["models"][label] = rows
+        return out
+
     # ------------------------------------------------------------ control
     def start(self):
         server = self
@@ -651,6 +729,23 @@ class InferenceServer:
                         model=(query.get("model") or [None])[0],
                         capture=qint("capture"),
                     ))
+                if path.startswith("/v2/debug/journey/"):
+                    jid = path[len("/v2/debug/journey/"):]
+                    payload = server.debug_journey(jid)
+                    if payload is None:
+                        return self._json(
+                            404, {"error": f"unknown journey {jid}"}
+                        )
+                    return self._json(200, payload)
+                if path == "/v2/debug/journey":
+                    return self._json(200, server.debug_journeys(
+                        slow=(query.get("slow") or [None])[0],
+                        n=qint("n") or 32,
+                    ))
+                if path == "/v2/debug/slow":
+                    return self._json(200, server.debug_slow(
+                        model=(query.get("model") or [None])[0]
+                    ))
                 if path == "/v2/slo":
                     return self._json(200, server.slo_report())
                 if path == "/v2/overload":
@@ -721,10 +816,25 @@ class InferenceServer:
                         "priority", self.headers.get("X-Request-Priority")
                     )
                     response_format = gen.response_format_from(req)
+                    # journey ingress: mint (or join the client's W3C
+                    # traceparent) only when the target unit records
+                    # journeys — journeys-off deployments stay inert
+                    journey = None
+                    if getattr(gen, "journeys", None) is not None:
+                        journey = server.journeys.mint(
+                            parent=parse_traceparent(
+                                self.headers.get("traceparent")
+                            )
+                        )
+                        journey.hop(
+                            "ingress", transport="http", model=name,
+                            stream=stream, prompt_len=len(prompt),
+                        )
                     handle = gen.submit(
                         prompt, sampling, deadline_s=deadline_s,
                         speculation=speculation, transport="http",
                         priority=priority, response_format=response_format,
+                        journey=journey,
                     )
                 except ResilienceError as e:
                     return self._json(
@@ -761,9 +871,15 @@ class InferenceServer:
                         return self._json(504, {"error": "generation timed out"})
                     except Exception as e:
                         return self._json(500, error_payload(e))
-                    return self._json(
-                        200, {"model_name": name, "tokens": tokens, "num_generated": len(tokens)}
-                    )
+                    body = {"model_name": name, "tokens": tokens,
+                            "num_generated": len(tokens)}
+                    if journey is not None:
+                        body["journey_id"] = journey.journey_id
+                        return self._json(
+                            200, body,
+                            headers={"traceparent": journey.traceparent()},
+                        )
+                    return self._json(200, body)
                 # SSE stream: status/headers are already committed once the
                 # first token flushes, so mid-stream failures surface as an
                 # error event, not a status code. With durability
@@ -778,6 +894,8 @@ class InferenceServer:
                 self.send_header("Cache-Control", "no-cache")
                 if durable_id is not None:
                     self.send_header("X-Durable-Id", durable_id)
+                if journey is not None:
+                    self.send_header("traceparent", journey.traceparent())
                 self.end_headers()
 
                 def event(payload: dict, eid=None):
@@ -794,6 +912,8 @@ class InferenceServer:
                     done = {"done": True, "tokens": handle.result(timeout=wait)}
                     if durable_id is not None:
                         done["durable_id"] = durable_id
+                    if journey is not None:
+                        done["journey_id"] = journey.journey_id
                     event(done)
                 except Exception as e:
                     handle.cancel()
@@ -824,10 +944,24 @@ class InferenceServer:
                         404, {"error": f"unknown durable stream {durable_id}"}
                     )
                 name, (state, obj) = found
+                # journey: a resumed live stream keeps its identity — the
+                # WAL admission snapshot restored the pre-crash journey id,
+                # so the sse_resume hop parent-links into the same trace.
+                journey_id = None
+                if state == "live" and obj.journey.journey_id is not None:
+                    journey_id = obj.journey.journey_id
+                    obj.journey.hop(
+                        "sse_resume", durable_id=durable_id,
+                        last_event_id=last, from_index=sent,
+                    )
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("X-Durable-Id", durable_id)
+                if journey_id is not None:
+                    self.send_header(
+                        "traceparent", obj.journey.traceparent()
+                    )
                 self.end_headers()
 
                 def event(payload: dict, eid=None):

@@ -24,21 +24,48 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 class LatencyWindow:
     """Rolling window of the last ``maxlen`` request latencies with
     cheap summary stats (count is cumulative; percentiles are over the
-    window)."""
+    window). Observations may carry an *exemplar* — an opaque id (the
+    fleet journey id) retained alongside the sample so a bad percentile
+    links back to one concrete, stitchable request journey."""
 
     def __init__(self, maxlen: int = 512):
         self._lock = threading.Lock()
         self._window: deque = deque(maxlen=maxlen)  # guarded-by: _lock
+        # (seconds, exemplar-id) pairs, same horizon as the window —
+        # only samples that arrived WITH an id (journeys on)
+        self._exemplars: deque = deque(maxlen=maxlen)  # guarded-by: _lock
         self.count = 0  # guarded-by: _lock
         self.total_s = 0.0  # guarded-by: _lock
         self.max_s = 0.0  # guarded-by: _lock
 
-    def record(self, seconds: float) -> None:
+    def record(self, seconds: float, exemplar: Optional[str] = None) -> None:
         with self._lock:
             self.count += 1
             self.total_s += seconds
             self.max_s = max(self.max_s, seconds)
             self._window.append(seconds)
+            if exemplar is not None:
+                self._exemplars.append((seconds, exemplar))
+
+    def slow_exemplars(self, k: int = 8) -> list:
+        """Up to ``k`` worst-decile samples (>= the window p90, ties
+        included) that carried an exemplar id, slowest first, deduped by
+        id — the ``/v2/debug/slow`` rows for this window."""
+        with self._lock:
+            window = sorted(self._window)
+            pairs = list(self._exemplars)
+        if not window or not pairs:
+            return []
+        p90 = window[min(len(window) - 1, math.ceil(0.90 * len(window)) - 1)]
+        out, seen = [], set()
+        for seconds, ex in sorted(pairs, key=lambda p: -p[0]):
+            if seconds < p90 or ex in seen:
+                continue
+            seen.add(ex)
+            out.append({"seconds": seconds, "journey_id": ex})
+            if len(out) >= k:
+                break
+        return out
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
@@ -139,17 +166,32 @@ class ServingStats:
         with self._lock:
             self.gauges[name] = fn
 
-    def observe(self, name: str, seconds: float) -> None:
+    def observe(self, name: str, seconds: float,
+                exemplar: Optional[str] = None) -> None:
         """Record one observation into the named window + histogram
-        (created on first use)."""
+        (created on first use). ``exemplar`` — a journey id, retained
+        for worst-decile samples so tail latency links to a stitched
+        journey — is None whenever journeys are off."""
         with self._lock:
             w = self._windows.get(name)
             if w is None:
                 w = self._windows[name] = LatencyWindow(self._window_len)
                 self._histograms[name] = Histogram()
             h = self._histograms[name]
-        w.record(seconds)
+        w.record(seconds, exemplar=exemplar)
         h.observe(seconds)
+
+    def slow_exemplars(self, k: int = 8) -> Dict[str, list]:
+        """Worst-decile exemplars per named window (ttft / tpot /
+        queue_time ...), windows with none omitted."""
+        with self._lock:
+            windows = dict(self._windows)
+        out: Dict[str, list] = {}
+        for name, w in windows.items():
+            rows = w.slow_exemplars(k)
+            if rows:
+                out[name] = rows
+        return out
 
     def window_p95(self, name: str) -> float:
         """One named window's rolling p95 (0.0 before any observation)

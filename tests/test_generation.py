@@ -431,6 +431,9 @@ def test_http_generate_sse_stream(gen_server, decoder_params):
               for l in r.read().decode().strip().split("\n\n")]
     ref = naive_greedy(decoder_params, [4, 5], 4)
     assert [e["token"] for e in events[:-1]] == ref
+    # the done event carries the journey id so clients can fetch the stitched trace
+    jid = events[-1].pop("journey_id")
+    assert len(jid) == 32 and all(c in "0123456789abcdef" for c in jid)
     assert events[-1] == {"done": True, "tokens": ref}
 
 

@@ -290,6 +290,12 @@ def _golden_stats():
     s.add_gauge("durable_torn_records_total", lambda: 1)
     s.add_gauge("durable_rolling_restarts_total", lambda: 1)
     s.add_gauge("durable_wal_segments", lambda: 2)
+    # ISSUE 20 request-journey families (binary-exact values)
+    s.add_gauge("journey_journeys_total", lambda: 3)
+    s.add_gauge("journey_spans_total", lambda: 12)
+    s.add_gauge("journey_spooled_spans_total", lambda: 6)
+    s.add_gauge("journey_spool_truncated_total", lambda: 1)
+    s.add_gauge("journey_remote_parents_total", lambda: 1)
     return s
 
 

@@ -647,9 +647,43 @@ def run_fleet_sweep() -> bool:
     for r in fleet.replicas:
         check("crash", no_leaked_blocks(r.engine),
               f"leaked blocks on {r.id}")
+    # journey completeness (ISSUE 20): every request must end with ONE
+    # connected journey whose stitched span count equals the context's
+    # attempted-hop count — a silently dropped span is a CI failure,
+    # and the failover must appear as a hop crossing replica lanes
+    from flexflow_tpu.obs import JourneyIndex
+
+    jidx = JourneyIndex()
+    for rec in fleet.journey_recorders():
+        jidx.add(rec)
+    failover_hops = 0
+    for h in handles:
+        req = h._request
+        jid = req.journey.journey_id
+        check("crash", jid is not None, f"request {req.id} has no journey")
+        jj = jidx.get(jid) if jid else None
+        check("crash", jj is not None and jj["complete"]
+              and jj["n_roots"] == 1,
+              f"request {req.id} journey did not stitch into one "
+              f"connected trace: {jj and (jj['n_roots'], jj['n_spans'])}")
+        if jj is None:
+            continue
+        check("crash", jj["n_spans"] == req.journey.hops,
+              f"request {req.id} journey dropped spans: {jj['n_spans']} "
+              f"stitched vs {req.journey.hops} attempted hops")
+        names = [s["name"] for s in jj["spans"]]
+        if "failover" in names:
+            failover_hops += 1
+            check("crash", len(set(s["lane"] for s in jj["spans"])) >= 2,
+                  f"failover journey never crossed lanes: {names}")
+    check("crash", failover_hops >= 1,
+          "the failover left no failover hop on any journey")
     report["crash"] = {"failovers": fs["failovers"],
                        "migrated_streams": fs["migrated_streams"],
-                       "replaced": fs["replaced"], "exact": got == ref}
+                       "replaced": fs["replaced"], "exact": got == ref,
+                       "journeys_complete": not any(
+                           "journey" in f for f in failures),
+                       "failover_hops": failover_hops}
 
     # ----------------------------- wedged replica -> watchdog drain -> replace
     # real clocks: replica loop threads + watchdog threads + the fleet
@@ -718,9 +752,11 @@ def run_fleet_sweep() -> bool:
     for f in failures:
         print(f"FAIL: {f}", file=sys.stderr)
     if not failures:
-        print("OK: fleet sweep — replica crash failed over byte-exactly, the "
-              "wedged replica drained + got replaced, and the brownout routed "
-              "around the open breaker")
+        print("OK: fleet sweep — replica crash failed over byte-exactly "
+              "with every journey stitching into one connected trace "
+              "(span count == attempted hops, failover hop crossing "
+              "lanes), the wedged replica drained + got replaced, and "
+              "the brownout routed around the open breaker")
     return not failures
 
 
@@ -859,12 +895,42 @@ def run_durable_sweep() -> bool:
               f"stream {req.original_prompt} diverged after process death: "
               f"{list(req.generated)} != {want}")
     check("sigkill", no_leaked_blocks(eng), "leaked blocks")
+    # journey completeness (ISSUE 20): the SIGKILLed child's pre-death
+    # spans live ONLY in the on-disk spool it left behind — each
+    # replayed stream must stitch into one connected journey joining
+    # those spans to the post-restart chain through the warm_restart
+    # hop, with no dangling parent links
+    from flexflow_tpu.obs import JourneyIndex
+
+    jidx = JourneyIndex().add(sched.journeys)
+    jidx.add_spool(dur.journey_spool)
+    for req in adopted:
+        jid = req.journey.journey_id
+        check("sigkill", jid is not None,
+              f"replayed stream {req.original_prompt} lost its journey "
+              f"identity across process death")
+        jj = jidx.get(jid) if jid else None
+        check("sigkill", jj is not None and jj["complete"]
+              and jj["n_roots"] == 1,
+              f"stream {req.original_prompt} journey did not survive the "
+              f"SIGKILL as one connected trace: "
+              f"{jj and (jj['n_roots'], jj['n_spans'])}")
+        if jj is None:
+            continue
+        names = [s["name"] for s in jj["spans"]]
+        check("sigkill", "submit" in names and "warm_restart" in names,
+              f"journey missing pre-death or bridge hops: {names}")
+        ids = {s["span_id"] for s in jj["spans"]}
+        check("sigkill", not [s for s in jj["spans"]
+                              if s["parent_id"] and s["parent_id"] not in ids],
+              f"journey has dangling parent links after the kill: {names}")
     report["sigkill"] = {
         "replayed_streams": restart["replayed_streams"],
         "replayed_tokens": restart["replayed_tokens"],
         "torn_records": restart["torn_records"],
         "exact": all(list(r.generated) == ref.get(tuple(r.original_prompt))
                      for r in adopted),
+        "journeys_stitched": not any("journey" in f for f in failures),
     }
     dur.close()
 
@@ -1066,10 +1132,11 @@ def run_durable_sweep() -> bool:
         print(f"FAIL: {f}", file=sys.stderr)
     if not failures:
         print("OK: durable sweep — SIGKILL'd child warm-restarted "
-              "byte-exactly (greedy/seeded/speculative/constrained), torn "
-              "tail truncated, fsync + append faults degraded gracefully, "
-              "fingerprint drift refused typed, and the 3-replica rolling "
-              "restart lost zero streams")
+              "byte-exactly (greedy/seeded/speculative/constrained) with "
+              "every journey stitching pre-death spool spans to the "
+              "post-restart chain, torn tail truncated, fsync + append "
+              "faults degraded gracefully, fingerprint drift refused "
+              "typed, and the 3-replica rolling restart lost zero streams")
     return not failures
 
 
@@ -1314,9 +1381,41 @@ def run_disagg_sweep() -> bool:
         for r in pool._replicas_snapshot():
             check("baseline", no_leaked_blocks(r.engine),
                   f"leaked blocks on {r.id}")
+    # journey completeness (ISSUE 20): every handed-off request must
+    # stitch into ONE connected journey (span count == attempted hops —
+    # a dropped span fails CI) that crosses from the prefill lane into
+    # the decode lane via the kv_handoff hop
+    from flexflow_tpu.obs import JourneyIndex
+
+    jidx = JourneyIndex()
+    for rec in dfleet.journey_recorders():
+        jidx.add(rec)
+    for h in handles:
+        req = h._request
+        jid = req.journey.journey_id
+        check("baseline", jid is not None, f"request {req.id} has no journey")
+        jj = jidx.get(jid) if jid else None
+        check("baseline", jj is not None and jj["complete"]
+              and jj["n_roots"] == 1,
+              f"request {req.id} journey did not stitch into one "
+              f"connected trace: {jj and (jj['n_roots'], jj['n_spans'])}")
+        if jj is None:
+            continue
+        check("baseline", jj["n_spans"] == req.journey.hops,
+              f"request {req.id} journey dropped spans: {jj['n_spans']} "
+              f"stitched vs {req.journey.hops} attempted hops")
+        names = [s["name"] for s in jj["spans"]]
+        check("baseline", "kv_handoff" in names,
+              f"handed-off journey missing the kv_handoff hop: {names}")
+        lanes = set(s["lane"] for s in jj["spans"])
+        check("baseline", any(l.startswith("p") for l in lanes)
+              and any(l.startswith("d") for l in lanes),
+              f"journey never crossed prefill->decode lanes: {lanes}")
     report["baseline"] = {"transfers": ho["transfers"],
                           "bytes_total": ho["bytes_total"],
-                          "kv_imports": kv_imports, "exact": got == ref}
+                          "kv_imports": kv_imports, "exact": got == ref,
+                          "journeys_complete": not any(
+                              "journey" in f for f in failures)}
 
     # ----------------------------------- transfer error -> bounded retry
     dfleet = make_disagg()
@@ -1356,9 +1455,30 @@ def run_disagg_sweep() -> bool:
           f"replay_fallbacks = {ho['replay_fallbacks_total']}, want 1")
     check("corrupt", ho["transfers"]["ok"] - base["ok"] == len(prompts) - 1,
           "clean handoffs were disturbed by the corrupted one")
+    # the replayed stream's journey must stay connected and record the
+    # fallback as a kv_handoff_replay hop
+    jidx = JourneyIndex()
+    for rec in dfleet.journey_recorders():
+        jidx.add(rec)
+    replay_hops = 0
+    for h in handles:
+        req = h._request
+        jj = jidx.get(req.journey.journey_id)
+        check("corrupt", jj is not None and jj["complete"],
+              f"request {req.id} journey broke across the corrupt handoff")
+        if jj is None:
+            continue
+        check("corrupt", jj["n_spans"] == req.journey.hops,
+              f"request {req.id} journey dropped spans: {jj['n_spans']} "
+              f"vs {req.journey.hops}")
+        if any(s["name"] == "kv_handoff_replay" for s in jj["spans"]):
+            replay_hops += 1
+    check("corrupt", replay_hops == 1,
+          f"{replay_hops} journeys carry the kv_handoff_replay hop, want 1")
     report["corrupt"] = {"transfers": ho["transfers"],
                          "replay_fallbacks": ho["replay_fallbacks_total"],
-                         "exact": got == ref}
+                         "exact": got == ref,
+                         "replay_hops": replay_hops}
 
     # --------------------- prefill replica death AFTER blocks shipped
     # stream A hands off, then its origin replica starts dying on every
@@ -1506,10 +1626,13 @@ def run_disagg_sweep() -> bool:
     for f in failures:
         print(f"FAIL: {f}", file=sys.stderr)
     if not failures:
-        print("OK: disagg sweep — handoffs delivered byte-exactly; transfer "
-              "error retried, corruption CRC-caught, prefill death isolated, "
-              "and a stalled handoff expired into decode-pool journal "
-              "replay, all byte-identical to the unified run; tp=1 -> tp=2 "
+        print("OK: disagg sweep — handoffs delivered byte-exactly with "
+              "every journey stitching prefill->decode lanes as one "
+              "connected trace (span count == attempted hops); transfer "
+              "error retried, corruption CRC-caught (replay recorded as a "
+              "kv_handoff_replay hop), prefill death isolated, and a "
+              "stalled handoff expired into decode-pool journal replay, "
+              "all byte-identical to the unified run; tp=1 -> tp=2 "
               "resharded handoff exact")
     return not failures
 

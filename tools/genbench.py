@@ -53,7 +53,12 @@ flight-recorder chrome://tracing dump, and a sample request trace.
 the measurement and writes the step-anatomy report (phase breakdown,
 device_bubble_ratio, overlap-headroom projection) plus the captured
 two-lane timeline — the artifact tpu-ci uploads; the run FAILS if the
-anatomy report is empty or the bubble ratio is not finite.
+anatomy report is empty or the bubble ratio is not finite. PR 20 adds
+a third interleaved arm (tracing on, journeys gated off) so
+``journey_overhead_pct`` isolates the request-journey layer alone,
+gated at ``--max-journey-overhead`` (default 3%) with byte-identical
+streams; ``--journey-out FILE`` writes the stitched-journey artifact
+and FAILS if any journey stitches incomplete.
 
 Every mode also merges its report into a machine-readable
 ``--bench-out`` artifact (default ``BENCH_GEN.json``) keyed by mode —
@@ -183,6 +188,7 @@ def _history_metrics(mode: str, report: dict) -> dict:
         an = report.get("anatomy") or {}
         return {
             "tracing_overhead": report.get("tracing_overhead"),
+            "journey_overhead_pct": report.get("journey_overhead_pct"),
             # bubble ratio for humans; the gated metric is the unclamped
             # hidden-host seconds per hot step (see perfwatch.METRICS)
             "device_bubble_ratio": an.get("device_bubble_ratio"),
@@ -1224,8 +1230,10 @@ def trace_overhead_bench(args, cfg, params) -> tuple:
         engine.generate([[1] * min(b, args.seq_len - 2)], SamplingParams(max_new_tokens=1))
     traces_after_warmup = dict(engine.trace_counts)
 
-    def one_run(observability: bool):
-        sched = ContinuousBatchingScheduler(engine, observability=observability)
+    def one_run(observability: bool, journeys=None):
+        sched = ContinuousBatchingScheduler(
+            engine, observability=observability, journeys=journeys,
+        )
         t0 = time.perf_counter()
         handles = [sched.submit(p, sampling) for p in prompts]
         while any(not h.done() for h in handles):
@@ -1235,27 +1243,37 @@ def trace_overhead_bench(args, cfg, params) -> tuple:
         outs = [h.result(timeout=0) for h in handles]
         return elapsed, outs, sched
 
-    # interleave so drift (thermal, other load) hits both arms equally;
+    # interleave so drift (thermal, other load) hits all arms equally;
     # best-of-N is the standard noise-robust wall-clock estimator. A
     # reading over budget escalates once with doubled repeats before
-    # failing: the overhead under test is ~2%, well inside one noisy
-    # scheduler quantum on a loaded host
-    plain_s, traced_s = [], []
-    outs_plain = outs_traced = None
+    # failing: the overheads under test are ~2-3%, well inside one
+    # noisy scheduler quantum on a loaded host. Three arms: plain
+    # (observability off), nojourney (tracing on, journeys gated off),
+    # traced (tracing + journeys on — the full PR 20 surface);
+    # journey_overhead_pct isolates the journey layer alone
+    plain_s, nojourney_s, traced_s = [], [], []
+    outs_plain = outs_nojourney = outs_traced = None
     traced_sched = None
 
     def measure(repeats):
-        nonlocal outs_plain, outs_traced, traced_sched
+        nonlocal outs_plain, outs_nojourney, outs_traced, traced_sched
         for _ in range(repeats):
             e, outs_plain, _s = one_run(observability=False)
             plain_s.append(e)
+            e, outs_nojourney, _s = one_run(observability=True,
+                                            journeys=False)
+            nojourney_s.append(e)
             e, outs_traced, traced_sched = one_run(observability=True)
             traced_s.append(e)
-        return min(traced_s) / max(min(plain_s), 1e-9) - 1.0
+        return (
+            min(traced_s) / max(min(plain_s), 1e-9) - 1.0,
+            min(traced_s) / max(min(nojourney_s), 1e-9) - 1.0,
+        )
 
-    overhead = measure(args.trace_repeats)
-    if overhead > args.max_trace_overhead:
-        overhead = measure(args.trace_repeats * 2)
+    overhead, journey_overhead = measure(args.trace_repeats)
+    if (overhead > args.max_trace_overhead
+            or journey_overhead > args.max_journey_overhead):
+        overhead, journey_overhead = measure(args.trace_repeats * 2)
     anatomy_trace = None
     if args.anatomy_out:
         # one extra (untimed) stream on a fresh traced scheduler with a
@@ -1299,6 +1317,12 @@ def trace_overhead_bench(args, cfg, params) -> tuple:
         "traced_runs_s": [round(x, 4) for x in traced_s],
         "tracing_overhead": round(overhead, 4),
         "max_trace_overhead": args.max_trace_overhead,
+        "nojourney_best_s": round(min(nojourney_s), 4),
+        "nojourney_runs_s": [round(x, 4) for x in nojourney_s],
+        "journey_overhead_pct": round(journey_overhead, 4),
+        "max_journey_overhead": args.max_journey_overhead,
+        "journey_spans": traced_sched.journey_stats.spans,
+        "journey_count": traced_sched.journey_stats.journeys,
         "steady_state_retraces": steady_retraces,
         "flight_records": len(traced_sched.flight.snapshot()),
         "anatomy": anatomy_report,
@@ -1308,6 +1332,20 @@ def trace_overhead_bench(args, cfg, params) -> tuple:
     ok = True
     if outs_plain != outs_traced:
         print("FAIL: tracing changed the generated streams", file=sys.stderr)
+        ok = False
+    if outs_nojourney != outs_traced:
+        print("FAIL: journeys changed the generated streams", file=sys.stderr)
+        ok = False
+    if traced_sched.journeys is None or traced_sched.journey_stats.spans == 0:
+        print("FAIL: journeys-on arm recorded no spans", file=sys.stderr)
+        ok = False
+    if journey_overhead > args.max_journey_overhead:
+        print(
+            f"FAIL: journey overhead {journey_overhead * 100:.2f}% > "
+            f"{args.max_journey_overhead * 100:.1f}% budget "
+            f"(vs tracing-on/journeys-off)",
+            file=sys.stderr,
+        )
         ok = False
     if steady_retraces:
         # the guard covers the anatomy-on arms AND the armed-capture
@@ -1344,6 +1382,31 @@ def trace_overhead_bench(args, cfg, params) -> tuple:
         with open(args.anatomy_out, "w") as f:
             json.dump({"report": anatomy_report, "timeline": anatomy_trace}, f,
                       indent=2)
+    if args.journey_out:
+        # the stitched-journey artifact tpu-ci uploads: every journey
+        # from the measured journeys-on arm, stitched, plus one
+        # chrome://tracing lanes view — and a completeness gate (an
+        # incomplete stitch under pure steady-state load means spans
+        # were dropped)
+        from flexflow_tpu.obs import JourneyIndex, journey_to_chrome_trace
+
+        jidx = JourneyIndex().add(traced_sched.journeys)
+        stitched = [j for j in
+                    (jidx.get(i) for i in traced_sched.journeys.journey_ids())
+                    if j is not None]
+        all_complete = bool(stitched) and all(j["complete"] for j in stitched)
+        with open(args.journey_out, "w") as f:
+            json.dump({
+                "journeys": stitched,
+                "chrome_trace": (journey_to_chrome_trace(stitched[0])
+                                 if stitched else None),
+                "complete": all_complete,
+                "journey_overhead_pct": round(journey_overhead, 4),
+            }, f, indent=2)
+        if not all_complete:
+            print("FAIL: journeys-on arm produced incomplete stitched "
+                  "journeys", file=sys.stderr)
+            ok = False
     print(json.dumps(report, indent=2))
     return report, ok
 
@@ -1442,6 +1505,14 @@ def main() -> int:
                     help="with --trace-out: write the step-anatomy "
                          "report + captured two-lane timeline to this "
                          "file (runs one extra armed-capture stream)")
+    ap.add_argument("--max-journey-overhead", type=float, default=0.03,
+                    help="budget for the journeys-on arm vs the "
+                         "tracing-on/journeys-off arm (ISSUE 20)")
+    ap.add_argument("--journey-out", default="",
+                    help="with --trace-out: write the journeys-on arm's "
+                         "stitched journeys + one chrome://tracing lanes "
+                         "view to this file (the tpu-ci artifact); FAILS "
+                         "if any journey stitches incomplete")
     ap.add_argument("--bench-out", default="BENCH_GEN.json",
                     help="cumulative machine-readable bench artifact "
                          "(merged per mode; '' disables)")

@@ -77,13 +77,33 @@ CI self-check (no server needed; used by .github/workflows/tpu-ci.yml):
       (predicted, measured) pairs for prefill/decode/verify plus an
       executor program after real runs, and that a deliberately scaled
       calibration entry trips the calibration-drift alarm with the
-      correct op-level blame string. Exit 1 on any miss.
+      correct op-level blame string. PR 20: additionally drives request
+      journeys end to end — a client traceparent joined at ingress and
+      returned on the response, GET /v2/debug/journey/{id} stitching a
+      complete parent-linked hop chain, tail-latency exemplars linking
+      to stitchable ids, a forced replica failover whose journey
+      crosses lanes gap-free with span count == attempted hops, and a
+      warm restart whose pre-crash spans stitch from the on-disk spool
+      alone. Exit 1 on any miss.
+
+  python tools/obsreport.py --url ... journey [<id>] [--slow p99]
+      [--timeline-out journey.json]
+      Fleet-wide request journeys (GET /v2/debug/journey[/{id}]): one
+      journey's cross-replica hop table with per-hop deltas and
+      handoff/failover/restart annotations (--timeline-out dumps the
+      chrome://tracing lanes view), or the stitchable-id listing
+      (--slow p99 narrows to tail-latency exemplar journeys).
+
+  python tools/obsreport.py --url ... slow
+      Tail-latency exemplar table (GET /v2/debug/slow): each latency
+      window's worst-decile samples with their journey ids.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, ".")
@@ -552,6 +572,111 @@ def show_durable(base: str) -> int:
     return 0
 
 
+# hop names that mark a journey crossing a process/replica boundary —
+# the annotations the hop table calls out loudly
+_JOURNEY_ANNOTATIONS = {
+    "kv_handoff_pack": "<< HANDOFF (KV packed for the decode pool)",
+    "kv_handoff": "<< HANDOFF (KV delivered cross-pool)",
+    "kv_handoff_replay": "<< HANDOFF FALLBACK (journal replay)",
+    "failover": "<< FAILOVER (replica died mid-stream)",
+    "warm_restart": "<< WARM RESTART (WAL replay after process death)",
+    "sse_resume": "<< RESUME (client re-attached)",
+    "replay": "<< REPLAY (engine restart)",
+}
+
+
+def show_slow(base: str, model=None) -> int:
+    """Tail-latency exemplar table (GET /v2/debug/slow): each latency
+    window's worst-decile samples with the journey ids they retained —
+    a bad percentile links straight to a stitchable journey."""
+    url = f"{base}/v2/debug/slow"
+    if model:
+        url += f"?model={model}"
+    payload = _get_json(url)
+    shown = 0
+    for label, windows in sorted(payload.get("models", {}).items()):
+        print(f"model {label!r}:")
+        for window, rows in sorted(windows.items()):
+            print(f"    {window} worst-decile exemplars:")
+            for r in rows:
+                shown += 1
+                print(f"        {r['seconds'] * 1e3:9.3f}ms  "
+                      f"journey {r['journey_id']}")
+    if not shown:
+        print("no slow exemplars retained (journeys off, or no traffic)")
+    return 0
+
+
+def _exemplar_windows(base: str, journey_id: str) -> list:
+    """Which (model, window) latency exemplars retained this journey."""
+    try:
+        payload = _get_json(f"{base}/v2/debug/slow")
+    except Exception:
+        return []
+    return sorted(
+        f"{label}:{window}"
+        for label, windows in payload.get("models", {}).items()
+        for window, rows in windows.items()
+        if any(r.get("journey_id") == journey_id for r in rows)
+    )
+
+
+def show_journey(base: str, journey_id=None, slow=None,
+                 timeline_out: str = "") -> int:
+    """One journey's cross-replica hop table (or, without an id, the
+    listing of stitchable journeys — ``--slow p99`` narrows to the
+    tail-latency exemplars)."""
+    if not journey_id:
+        url = f"{base}/v2/debug/journey"
+        if slow:
+            url += f"?slow={slow}"
+        payload = _get_json(url)
+        ids = payload.get("journeys", [])
+        if not ids:
+            print("no journeys retained" + (" as slow exemplars" if slow else ""))
+            return 1
+        label = "slow-exemplar journeys" if slow else "journeys (newest first)"
+        print(f"{len(ids)} {label}:")
+        for jid in ids:
+            print(f"    {jid}")
+        print(f"inspect one: obsreport.py --url {base} journey <id>")
+        return 0
+    try:
+        payload = _get_json(f"{base}/v2/debug/journey/{journey_id}")
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            print(f"unknown journey {journey_id} (spool evicted, or never "
+                  f"minted)", file=sys.stderr)
+            return 1
+        raise
+    j = payload["journey"]
+    spans = j["spans"]
+    verdict = "complete" if j["complete"] else (
+        f"INCOMPLETE ({j['n_roots']} root(s); orphaned spans present)"
+    )
+    print(f"journey {j['journey_id']}: {j['n_spans']} hop(s) across "
+          f"lanes {', '.join(j['lanes'])} — {verdict}")
+    for w in _exemplar_windows(base, journey_id):
+        print(f"    # EXEMPLAR: retained as a worst-decile {w} sample")
+    t0 = spans[0]["t0"] if spans else 0.0
+    prev = t0
+    print("    hop table (causal order):")
+    for s in spans:
+        extra = {k: v for k, v in (s.get("attrs") or {}).items()}
+        note = _JOURNEY_ANNOTATIONS.get(s["name"], "")
+        print(f"      +{(s['t0'] - t0) * 1e3:9.3f}ms "
+              f"(Δ{(s['t0'] - prev) * 1e3:8.3f}ms) "
+              f"[{s['lane']:<10}] {s['name']:<16} "
+              f"{extra if extra else ''}{'  ' + note if note else ''}")
+        prev = s["t0"]
+    if timeline_out:
+        with open(timeline_out, "w") as f:
+            json.dump(payload["chrome_trace"], f)
+        print(f"wrote {len(payload['chrome_trace'].get('traceEvents', []))} "
+              f"trace events to {timeline_out} — open in chrome://tracing")
+    return 0 if j["complete"] else 1
+
+
 def dump_timeline(base: str, out: str) -> int:
     payload = _get_json(f"{base}/v2/debug/timeline")
     with open(out, "w") as f:
@@ -602,16 +727,17 @@ def selfcheck() -> int:
     srv.start()
     base = f"http://127.0.0.1:{srv.port}"
 
-    def post(path, payload, expect_error=False):
+    def post(path, payload, headers=None, return_headers=False):
         req = urllib.request.Request(
             base + path, data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json", **(headers or {})},
         )
         try:
             with urllib.request.urlopen(req, timeout=120) as r:
-                return r.status, json.loads(r.read())
+                out = r.status, json.loads(r.read()), dict(r.headers)
         except urllib.error.HTTPError as e:
-            return e.code, json.loads(e.read())
+            out = e.code, json.loads(e.read()), dict(e.headers)
+        return out if return_headers else out[:2]
 
     import urllib.error
 
@@ -913,6 +1039,122 @@ def selfcheck() -> int:
               and "opcosts_cpu.json" in blame,
               f"drift blame wrong: {blame!r}")
 
+        # -------------- journeys: ingress joins traceparent, stitches
+        # (ISSUE 20) a W3C traceparent sent at ingress must come back as
+        # the stream's journey id, and GET /v2/debug/journey/{id} must
+        # stitch a complete, single-root, parent-linked hop chain
+        client_trace = "0af7651916cd43dd8448eb211c80319c"
+        code, resp, hdrs = post(
+            "/v2/models/lm/generate",
+            {"prompt": [6, 5, 4, 3], "max_new_tokens": 6},
+            headers={"traceparent": f"00-{client_trace}-b7ad6b7169203331-01"},
+            return_headers=True,
+        )
+        check(code == 200 and resp.get("journey_id") == client_trace,
+              f"ingress did not join the client traceparent: "
+              f"{resp.get('journey_id')}")
+        check(client_trace in (hdrs.get("traceparent") or ""),
+              f"response traceparent missing the journey id: {hdrs}")
+        jpayload = _get_json(f"{base}/v2/debug/journey/{client_trace}")
+        j = jpayload["journey"]
+        names = [s["name"] for s in j["spans"]]
+        check(j["complete"] and j["n_roots"] == 1,
+              f"HTTP journey did not stitch complete: {j['n_roots']} "
+              f"root(s), {names}")
+        for needed in ("ingress", "submit", "admit", "prefill", "finish"):
+            check(needed in names, f"journey missing the {needed} hop: {names}")
+        check({"http", "local"} <= set(j["lanes"]),
+              f"journey lanes missing ingress or replica: {j['lanes']}")
+        check(jpayload["chrome_trace"]["traceEvents"]
+              and jpayload["otlp"]["resourceSpans"],
+              "journey renderings empty")
+        # tail exemplars: the latency windows must have retained journey
+        # ids, and ?slow= must list only retained ids
+        slow_tbl = _get_json(f"{base}/v2/debug/slow")["models"]
+        check(any(rows for rows in slow_tbl.values()),
+              "latency windows retained no journey exemplars")
+        slow_ids = _get_json(f"{base}/v2/debug/journey?slow=p99")["journeys"]
+        check(slow_ids, "?slow=p99 listed no exemplar journeys")
+        check("flexflow_serving_journey_spans_total"
+              in _get(f"{base}/metrics"),
+              "/metrics missing the journey span counter")
+
+        # ------------- journeys: forced failover stitches cross-replica
+        # a two-replica fleet, r0 murdered mid-flight: every migrated
+        # stream's journey must stitch complete WITH the failover hop,
+        # crossing from the r0 lane into the survivor's — and span count
+        # must equal the context's attempted-hop count (a dropped span
+        # is a gap, not a diagnostic judgment call)
+        from flexflow_tpu.generation import RecoveryPolicy
+        from flexflow_tpu.obs import JourneyIndex
+        from flexflow_tpu.runtime.faults import replica_kill
+        from flexflow_tpu.serving.fleet import Fleet
+
+        tiny = TransformerConfig(
+            num_layers=1, hidden_size=16, num_heads=2, ff_size=32,
+            seq_length=64, vocab_size=40, causal=True,
+        )
+        tiny_params = init_decoder_params(jax.random.key(1), tiny)
+
+        def factory():
+            return GenerationEngine(
+                tiny_params, tiny, max_batch_slots=3, block_size=8,
+            )
+
+        fleet = Fleet(
+            factory, 2,
+            scheduler_kwargs={
+                "recovery": RecoveryPolicy(max_restarts=1,
+                                           sleep=lambda _s: None),
+            },
+        )
+        plan = FaultPlan(seed=0)
+        replica_kill(plan, "r0", every=1)
+        with plan.active():
+            fprompts = [[1, 2, 3], [4, 5, 6, 7], [9, 8, 7, 6], [1, 2, 3, 4]]
+            fhandles = [
+                fleet.submit(p, SamplingParams(max_new_tokens=8))
+                for p in fprompts
+            ]
+            for _ in range(500):
+                if all(h.done() for h in fhandles):
+                    break
+                fleet.step()
+        check(all(h.done() for h in fhandles),
+              "fleet failover leg did not finish")
+        check(fleet.fleet_stats.snapshot()["failovers"] >= 1,
+              "replica murder produced no failover")
+        idx = JourneyIndex()
+        for rec in fleet.journey_recorders():
+            idx.add(rec)
+        migrated = [h._request for h in fhandles
+                    if h._request.journey.hops and any(
+                        s.name == "failover" for rec in
+                        fleet.journey_recorders() for s in
+                        rec.spans(h._request.journey.journey_id))]
+        check(migrated, "no journey recorded a failover hop")
+        for req in migrated:
+            fj = idx.get(req.journey.journey_id)
+            check(fj is not None and fj["complete"],
+                  f"failover journey did not stitch gap-free: "
+                  f"{fj and fj['n_roots']}")
+            check(fj["n_spans"] == req.journey.hops,
+                  f"failover journey dropped spans: {fj['n_spans']} "
+                  f"stitched vs {req.journey.hops} attempted")
+            fnames = [s["name"] for s in fj["spans"]]
+            check("failover" in fnames and "adopt" in fnames,
+                  f"failover journey missing the handover hops: {fnames}")
+            check(len(set(s["lane"] for s in fj["spans"])) >= 2,
+                  f"failover journey never crossed lanes: {fnames}")
+        # parent links are REAL: every non-root span's parent is another
+        # span of the same journey (not just "some id present")
+        for req in migrated:
+            fj = idx.get(req.journey.journey_id)
+            ids = {s["span_id"] for s in fj["spans"]}
+            dangling = [s for s in fj["spans"]
+                        if s["parent_id"] and s["parent_id"] not in ids]
+            check(not dangling, f"dangling parent links: {dangling}")
+
         # ---------------- durable serving: kill + warm restart replays
         # in-process "process death": journal a stream mid-decode, then
         # abandon the scheduler without ENDing it — exactly the journal
@@ -952,6 +1194,31 @@ def selfcheck() -> int:
             rep = dur2.report()
             check(rep["counters"]["replayed_streams"] >= 1,
                   f"durable report did not count the replay: {rep['counters']}")
+            # journeys survive process death: stitch ONLY from the new
+            # scheduler's ring + the shared on-disk spool (the dead
+            # scheduler's ring is intentionally NOT consulted — exactly
+            # what a real SIGKILL leaves behind). The pre-crash spans
+            # must join the post-restart chain gap-free, with the
+            # warm_restart hop bridging them.
+            jreq = adopted[0]
+            check(jreq.journey.journey_id is not None,
+                  "warm-restarted stream lost its journey identity")
+            jidx = JourneyIndex().add(sched2.journeys)
+            jidx.add_spool(dur2.journey_spool)
+            wj = jidx.get(jreq.journey.journey_id)
+            check(wj is not None and wj["complete"]
+                  and wj["n_roots"] == 1,
+                  f"warm-restart journey did not stitch gap-free: "
+                  f"{wj and (wj['n_roots'], [s['name'] for s in wj['spans']])}")
+            wnames = [s["name"] for s in wj["spans"]]
+            check("submit" in wnames and "adopt" in wnames
+                  and "warm_restart" in wnames,
+                  f"warm-restart journey missing pre-crash or bridge "
+                  f"hops: {wnames}")
+            wids = {s["span_id"] for s in wj["spans"]}
+            check(not [s for s in wj["spans"]
+                       if s["parent_id"] and s["parent_id"] not in wids],
+                  "warm-restart journey has dangling parent links")
             dur2.close()
         finally:
             shutil.rmtree(wal_root, ignore_errors=True)
@@ -970,8 +1237,12 @@ def selfcheck() -> int:
           "executor program, a scaled calibration entry tripped the "
           "drift alarm with correct blame, the step-anatomy profiler "
           "reported a finite bubble ratio + overlap headroom with a "
-          "successful forced two-lane capture, and an abandoned durable "
-          "journal warm-restarted with a non-empty replay report")
+          "successful forced two-lane capture, an abandoned durable "
+          "journal warm-restarted with a non-empty replay report, and "
+          "request journeys joined the client traceparent, stitched "
+          "gap-free through a forced failover AND a warm restart "
+          "(pre-crash spans recovered from the on-disk spool alone), "
+          "with tail-latency exemplars linking to stitchable ids")
     return 0
 
 
@@ -980,7 +1251,8 @@ def main() -> int:
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("command", nargs="?", default="summary",
                     choices=("summary", "cache", "slo", "predict", "anatomy",
-                             "overload", "disagg", "constrained", "durable"),
+                             "overload", "disagg", "constrained", "durable",
+                             "journey", "slow"),
                     help="view: summary (default), cache (block "
                          "residency), slo (burn rates), predict "
                          "(cost-model truth: error table + drift alarms), "
@@ -991,7 +1263,12 @@ def main() -> int:
                          "in-flight transfers), constrained (grammar-cache "
                          "economics, masked steps, dead-end quarantines), "
                          "durable (WAL watermark, replay totals, resume "
-                         "index)")
+                         "index), journey [<id>] (one request's "
+                         "cross-replica hop table, or the stitchable-id "
+                         "listing; --slow p99 narrows to tail exemplars), "
+                         "slow (tail-latency exemplar table)")
+    ap.add_argument("ident", nargs="?", default=None,
+                    help="with `journey`: the journey id to stitch")
     ap.add_argument("--url", default="", help="base URL of a running server")
     ap.add_argument("--request", type=int, default=None,
                     help="print one request's trace waterfall")
@@ -1006,6 +1283,9 @@ def main() -> int:
                     help="with `predict`: write the ledger snapshot as "
                          "a flexflow-ledger-export-v1 JSON document "
                          "(the sim cost-table calibration artifact)")
+    ap.add_argument("--slow", default="",
+                    help="with `journey` (no id): list only the "
+                         "tail-latency exemplar journeys, e.g. --slow p99")
     ap.add_argument("--selfcheck", action="store_true",
                     help="in-process end-to-end observability check (CI)")
     args = ap.parse_args()
@@ -1017,6 +1297,13 @@ def main() -> int:
     base = args.url.rstrip("/")
     if args.request is not None:
         return show_request(base, args.request)
+    if args.command == "journey":
+        # --timeline-out here means the journey's chrome trace, not the
+        # engine flight recorder
+        return show_journey(base, journey_id=args.ident, slow=args.slow,
+                            timeline_out=args.timeline_out)
+    if args.command == "slow":
+        return show_slow(base)
     if args.timeline_out:
         return dump_timeline(base, args.timeline_out)
     if args.command == "cache":

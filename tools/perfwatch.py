@@ -54,6 +54,11 @@ METRICS: Dict[str, Tuple[str, str, float]] = {
     "ttft_p50_s": ("lower", "rel", 0.25),
     "mfu": ("higher", "rel", 0.25),
     "tracing_overhead": ("lower", "abs", 0.02),
+    # request journeys (ISSUE 20): the journeys-on vs journeys-off arm
+    # delta. Lives near zero like tracing_overhead, so it gets the same
+    # absolute floor — a rise past baseline + 2 points means the
+    # journey layer's per-hop cost crept onto the decode hot path.
+    "journey_overhead_pct": ("lower", "abs", 0.02),
     # step anatomy (ISSUE 12): the tracing_overhead series now measures
     # the anatomy-on observability arm. The gated trajectory is the
     # UNCLAMPED hidden-host seconds per hot step — a RISE past the
